@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Headline benchmark: ResNet-50 synthetic-data training throughput.
+
+Reference parity: examples/pytorch/pytorch_synthetic_benchmark.py and
+examples/tensorflow2/tensorflow2_synthetic_benchmark.py — the scripts the
+reference's own docs point at for measuring img/sec (BASELINE.md).  Same
+protocol: synthetic ImageNet-shaped data, warmup then timed steps, report
+images/sec.
+
+Baseline constant: the reference repo publishes no absolute number
+(BASELINE.md: "user-measured"); the widely reported figure for its
+pytorch_synthetic_benchmark on the reference-era flagship (V100, fp32,
+batch 32) is ~330 img/sec, which we use as vs_baseline's denominator.
+
+Output: ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.models.resnet import ResNet50
+from horovod_tpu import training
+
+BASELINE_IMG_PER_SEC = 330.0  # reference pytorch_synthetic_benchmark, 1x V100 fp32
+
+
+def main():
+    hvd.init()
+    on_tpu = jax.default_backend() not in ("cpu",)
+    batch = 128 if on_tpu else 16
+    image_size = 224 if on_tpu else 64
+    warmup, iters = (3, 20) if on_tpu else (1, 2)
+
+    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    rng = jax.random.PRNGKey(0)
+    images = jnp.asarray(
+        np.random.RandomState(0)
+        .randn(batch, image_size, image_size, 3)
+        .astype(np.float32)
+    )
+    labels = jnp.asarray(
+        np.random.RandomState(1).randint(0, 1000, size=(batch,))
+    )
+
+    optimizer = optax.sgd(0.1, momentum=0.9)
+    state = training.create_train_state(
+        model, optimizer, rng, images[:2]
+    )
+    state = training.replicate_state(state)
+    step = training.data_parallel_train_step(model, optimizer)
+
+    for _ in range(warmup):
+        state, loss = step(state, images, labels)
+    # fetch the scalar (not just block_until_ready): a device->host
+    # roundtrip is the only sync some remote backends honor
+    float(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, loss = step(state, images, labels)
+    final_loss = float(loss)
+    dt = time.perf_counter() - t0
+    assert np.isfinite(final_loss), f"non-finite loss {final_loss}"
+
+    img_per_sec = batch * iters / dt
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_synthetic_train_throughput",
+                "value": round(img_per_sec, 2),
+                "unit": "images/sec",
+                "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,112 @@
+"""horovod_tpu: a TPU-native distributed training framework with the
+capabilities of Horovod (reference: rondogency/horovod — see SURVEY.md).
+
+Public surface mirrors ``horovod.torch`` / ``horovod.tensorflow``
+(SURVEY.md §2.3): ``init``/``shutdown``, rank/size topology queries, eager
+async collectives with handles, ``DistributedOptimizer``,
+``broadcast_parameters``, elastic state, process sets — plus the TPU-native
+additions: the in-jit SPMD collective module (``hvd.spmd``), mesh access,
+and the per-rank ``run_per_rank`` harness.
+
+Typical JAX use::
+
+    import horovod_tpu as hvd
+    hvd.init()
+    mesh = hvd.world_mesh()
+    # ... shard batch over mesh axis "hvd"; inside the train step:
+    grads = hvd.spmd.allreduce(grads)           # psum over ICI
+    # or wrap the optimizer once:
+    opt = hvd.DistributedOptimizer(optax.adam(1e-3))
+"""
+
+from __future__ import annotations
+
+from .common import basics as _basics
+from .common.basics import (
+    init,
+    shutdown,
+    is_initialized,
+    rank,
+    local_rank,
+    size,
+    local_size,
+    cross_rank,
+    cross_size,
+    is_homogeneous,
+    xla_built,
+    nccl_built,
+    mpi_enabled,
+    gloo_built,
+    ccl_built,
+    native_built,
+)
+from .common.exceptions import (
+    HorovodInternalError,
+    HostsUpdatedInterrupt,
+    HorovodTpuError,
+)
+from .common.process_sets import ProcessSet, global_process_set
+from .common.topology import WORLD_AXIS, DCN_AXIS, ICI_AXIS
+from .ops import spmd_ops as spmd
+from .ops.collective_ops import (
+    Handle,
+    allgather,
+    allgather_async,
+    allreduce,
+    allreduce_async,
+    alltoall,
+    alltoall_async,
+    barrier,
+    broadcast,
+    broadcast_async,
+    grouped_allgather,
+    grouped_allreduce,
+    grouped_allreduce_async,
+    join,
+    poll,
+    reducescatter,
+    reducescatter_async,
+    synchronize,
+)
+from .ops.reduce_ops import Adasum, Average, Max, Min, Product, ReduceOp, Sum
+from .ops.spmd_ops import run_per_rank
+from .functions import (
+    allgather_object,
+    broadcast_object,
+    broadcast_optimizer_state,
+    broadcast_parameters,
+)
+from .optim import (
+    DistributedOptimizer,
+    allreduce_gradients,
+    with_gradient_accumulation,
+)
+
+__version__ = "0.1.0"
+
+
+def add_process_set(ranks) -> ProcessSet:
+    """Register a new process set (reference: horovod/common/process_sets.py
+    add_process_set)."""
+    ps = ranks if isinstance(ranks, ProcessSet) else ProcessSet(ranks)
+    return _basics._require_init().process_set_registry.add(ps)
+
+
+def remove_process_set(process_set: ProcessSet) -> None:
+    """Reference: horovod/common/process_sets.py remove_process_set."""
+    _basics._require_init().process_set_registry.remove(process_set)
+
+
+def process_set_ids():
+    return _basics._require_init().process_set_registry.ids()
+
+
+def world_mesh():
+    """The 1-D world mesh (every chip, axis ``"hvd"``)."""
+    return _basics._require_init().process_set_registry.get(0).mesh
+
+
+def hierarchical_mesh(num_groups=None):
+    """2-D (dcn, ici) mesh for two-level reductions (reference analog:
+    local/cross communicators of NCCLHierarchicalAllreduce)."""
+    return _basics._require_init().topology.hierarchical_mesh(num_groups)

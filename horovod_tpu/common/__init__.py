@@ -1,0 +1,1 @@
+"""Framework-agnostic core (reference analog: horovod/common/)."""

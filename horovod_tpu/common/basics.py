@@ -1,0 +1,229 @@
+"""Core lifecycle and rank/size API.
+
+Reference parity: horovod/common/basics.py (HorovodBasics) + the C API it
+fronts in horovod/common/operations.cc (horovod_init / horovod_rank /
+horovod_size / horovod_local_rank / ... — SURVEY.md §3.1).  The reference's
+``init()`` spawns the C++ background thread and runs a network rendezvous;
+on TPU the PJRT runtime already holds the pod topology, so ``init()`` is a
+local bootstrap: discover devices, build the world mesh, attach process
+sets, load the native controller, and read env config.
+
+Multi-process (one process per TPU host, the reference's one-process-per-GPU
+analog) is established *before* ``init()`` via ``jax.distributed.initialize``
+— the ``tpurun`` launcher exports the coordinator address the same way
+``horovodrun`` exports HOROVOD_GLOO_RENDEZVOUS_ADDR (SURVEY.md §3.3).
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import threading
+from typing import Optional, Sequence
+
+import jax
+
+from ..utils.env_parser import Config
+from ..utils.logging import get_logger
+from . import topology as _topology
+from .exceptions import NotInitializedError
+from .process_sets import ProcessSetRegistry, global_process_set
+from .topology import Topology
+
+
+class _GlobalState:
+    """Singleton mirroring horovod/common/global_state.h (HorovodGlobalState):
+    holds topology, config, process-set table, the collective engine and the
+    native controller handle."""
+
+    def __init__(self):
+        self.lock = threading.RLock()
+        self.initialized = False
+        self.topology: Optional[Topology] = None
+        self.config: Optional[Config] = None
+        self.process_set_registry = ProcessSetRegistry()
+        self.engine = None  # ops.engine.CollectiveEngine, set by init()
+        self.controller = None  # native controller (ctypes), set by init()
+        self.timeline = None
+
+
+_state = _GlobalState()
+
+
+def _maybe_init_distributed() -> None:
+    """Join the multi-process world if the launcher configured one.
+
+    ``tpurun`` exports HVD_TPU_COORDINATOR / HVD_TPU_NUM_PROCESSES /
+    HVD_TPU_PROCESS_ID (SURVEY.md §3.3's env-plumbing step); on managed TPU
+    pods ``jax.distributed.initialize()`` auto-detects and these are unset.
+    """
+    coord = os.environ.get("HVD_TPU_COORDINATOR")
+    if not coord:
+        return
+    # NB: do NOT call jax.process_count()/jax.devices() here — that forces
+    # backend initialization and jax.distributed.initialize must run first.
+    from jax._src import distributed as _jax_distributed
+
+    if getattr(_jax_distributed.global_state, "client", None) is not None:
+        return  # coordination service already joined (runtime or prior init)
+    num = int(os.environ["HVD_TPU_NUM_PROCESSES"])
+    pid = int(os.environ["HVD_TPU_PROCESS_ID"])
+    if num <= 1:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coord, num_processes=num, process_id=pid
+    )
+
+
+def init(devices: Optional[Sequence] = None) -> None:
+    """Initialize the framework (idempotent).
+
+    Reference: horovod/common/operations.cc InitializeHorovodOnce — but with
+    no rendezvous and no blocking wait: topology comes from PJRT, and the
+    native background controller starts immediately.
+
+    Args:
+      devices: optional explicit device list (defaults to ``jax.devices()``);
+        mainly for tests that carve up a virtual CPU mesh.
+    """
+    with _state.lock:
+        if _state.initialized:
+            return
+        _maybe_init_distributed()
+        _state.config = Config.from_env()
+        _state.topology = _topology.discover(devices)
+        _state.process_set_registry.attach_world(_state.topology)
+
+        from ..ops.engine import CollectiveEngine  # deferred: avoids cycle
+
+        _state.engine = CollectiveEngine(_state.topology, _state.config)
+
+        from ..native import load_controller  # deferred: optional native core
+
+        _state.controller = load_controller(_state.topology, _state.config)
+
+        if _state.config.timeline_filename:
+            from ..utils.timeline import Timeline
+
+            _state.timeline = Timeline(
+                _state.config.timeline_filename, rank=_state.topology.rank
+            )
+
+        _state.initialized = True
+        get_logger().info(
+            "initialized: size=%d local_size=%d rank=%d processes=%d backend=%s",
+            _state.topology.size,
+            _state.topology.local_size,
+            _state.topology.rank,
+            _state.topology.num_processes,
+            jax.default_backend(),
+        )
+
+
+def shutdown() -> None:
+    """Tear down (reference: horovod_shutdown in operations.cc)."""
+    with _state.lock:
+        if not _state.initialized:
+            return
+        if _state.controller is not None:
+            _state.controller.shutdown()
+            _state.controller = None
+        if _state.timeline is not None:
+            _state.timeline.close()
+            _state.timeline = None
+        _state.engine = None
+        _state.topology = None
+        _state.initialized = False
+
+
+atexit.register(shutdown)
+
+
+def is_initialized() -> bool:
+    """Reference: horovod_is_initialized (operations.cc)."""
+    return _state.initialized
+
+
+def _require_init() -> _GlobalState:
+    if not _state.initialized:
+        raise NotInitializedError()
+    return _state
+
+
+def topology() -> Topology:
+    return _require_init().topology
+
+
+def size() -> int:
+    """Total number of workers == TPU chips (reference: horovod_size)."""
+    return _require_init().topology.size
+
+
+def rank() -> int:
+    """Global rank of this process's lead chip (reference: horovod_rank).
+
+    Equals the classic Horovod rank when each process drives one chip; with
+    multiple local chips it is still unique per process and 0 on the
+    coordinator, so ``if hvd.rank() == 0`` checkpoint gating works unchanged.
+    """
+    return _require_init().topology.rank
+
+
+def local_size() -> int:
+    """Chips driven by this process (reference: horovod_local_size)."""
+    return _require_init().topology.local_size
+
+
+def local_rank() -> int:
+    """Index of this process among processes on the same host
+    (reference: horovod_local_rank).  One process per host on TPU pods, so
+    this is almost always 0; kept for API parity."""
+    return 0
+
+
+def cross_size() -> int:
+    """Number of processes (reference: horovod_cross_size — number of nodes)."""
+    return _require_init().topology.num_processes
+
+
+def cross_rank() -> int:
+    """This process's index (reference: horovod_cross_rank)."""
+    return _require_init().topology.process_index
+
+
+def is_homogeneous() -> bool:
+    """Reference: horovod_is_homogeneous — equal local sizes everywhere.
+    TPU slices are homogeneous by construction unless a device subset was
+    passed to init()."""
+    st = _require_init()
+    return st.topology.size == st.topology.local_size * max(
+        st.topology.num_processes, 1
+    )
+
+
+# Build-capability probes (reference: horovod/common/basics.py
+# mpi_enabled/gloo_built/nccl_built — used by tests for feature-gated skips).
+def xla_built() -> bool:
+    return True
+
+
+def nccl_built() -> bool:
+    return False
+
+
+def mpi_enabled() -> bool:
+    return False
+
+
+def gloo_built() -> bool:
+    return False
+
+
+def ccl_built() -> bool:
+    return False
+
+
+def native_built() -> bool:
+    """True when the C++ controller core is loaded (no Python fallback)."""
+    st = _require_init()
+    return st.controller is not None and st.controller.is_native

@@ -1,0 +1,56 @@
+"""Framework exceptions.
+
+Reference parity: horovod/common/exceptions.py — ``HorovodInternalError`` is
+raised when a collective fails mid-flight (NCCL abort in the reference; a
+failed XLA collective / coordination-service loss here) and is the signal the
+elastic ``run`` wrapper catches to trigger state rollback.  See SURVEY.md §5.3.
+"""
+
+from __future__ import annotations
+
+
+class HorovodTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class HorovodInternalError(HorovodTpuError):
+    """A collective operation failed and the communicator must be rebuilt.
+
+    Reference: horovod/common/exceptions.py (HorovodInternalError).
+    Elastic mode catches this, restores the last committed state, and
+    re-initializes (SURVEY.md §3.4).
+    """
+
+
+class HostsUpdatedInterrupt(HorovodTpuError):
+    """Raised when the elastic driver notifies of a membership change.
+
+    Reference: horovod/common/elastic.py (HostsUpdatedInterrupt).  Unlike
+    ``HorovodInternalError`` the current state is intact: the elastic loop
+    keeps it and merely re-runs rendezvous.
+    """
+
+    def __init__(self, skip_sync: bool = False):
+        super().__init__()
+        self.skip_sync = skip_sync
+
+
+class NotInitializedError(HorovodTpuError):
+    """An API needing ``hvd.init()`` was called before initialization.
+
+    Reference: horovod/common/basics.py raises a ValueError with the message
+    'Horovod has not been initialized; use hvd.init().' — we keep a dedicated
+    type but the same contract.
+    """
+
+    def __init__(self, what: str = "Framework"):
+        super().__init__(
+            f"{what} has not been initialized; call horovod_tpu.init() first."
+        )
+
+
+class ProcessSetError(HorovodTpuError):
+    """Invalid process-set operation (unknown set, duplicate ranks, ...).
+
+    Reference: horovod/common/process_sets.py.
+    """

@@ -1,0 +1,155 @@
+"""Process sets: named device subsets with their own collective scope.
+
+Reference parity: horovod/common/process_set.h/.cc + horovod/common/
+process_sets.py (SURVEY.md §2.1).  In the reference each ProcessSet owns a
+separate Controller, TensorQueue and communicators; here a process set owns a
+sub-``Mesh`` (a subset of chips) and collectives scoped to it compile over
+that sub-mesh.  Set 0 is always the global (world) set.
+
+TPU-first note: "rank" in a process set is a *chip* index into the world
+device order, mirroring the reference's global-rank lists, so a process set
+is literally a named sub-mesh of the pod.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+from jax.sharding import Mesh
+
+from .exceptions import ProcessSetError
+from .topology import WORLD_AXIS, Topology
+
+
+class ProcessSet:
+    """A named subset of world ranks (chips) with its own sub-mesh.
+
+    Reference: horovod/common/process_set.h (ProcessSet struct holding its
+    own controller + tensor queue); here the compiled-executable cache is
+    keyed by the process-set id instead (SURVEY.md §7.1).
+    """
+
+    def __init__(self, ranks: Optional[Sequence[int]] = None):
+        self.process_set_id: Optional[int] = None
+        self.ranks: Optional[List[int]] = sorted(ranks) if ranks is not None else None
+        self._mesh: Optional[Mesh] = None
+
+    def _attach(self, set_id: int, topology: Topology) -> None:
+        self.process_set_id = set_id
+        if self.ranks is None:  # world set
+            self.ranks = list(range(topology.size))
+        for r in self.ranks:
+            if not 0 <= r < topology.size:
+                raise ProcessSetError(
+                    f"rank {r} out of range for world size {topology.size}"
+                )
+        if len(set(self.ranks)) != len(self.ranks):
+            raise ProcessSetError(f"duplicate ranks in process set: {self.ranks}")
+        devs = np.asarray([topology.devices[r] for r in self.ranks], dtype=object)
+        self._mesh = Mesh(devs, (WORLD_AXIS,))
+
+    @property
+    def mesh(self) -> Mesh:
+        if self._mesh is None:
+            raise ProcessSetError("process set is not attached (call add_process_set)")
+        return self._mesh
+
+    def size(self) -> int:
+        if self.ranks is None:
+            raise ProcessSetError("process set is not attached")
+        return len(self.ranks)
+
+    def rank_in_set(self, world_rank: int) -> int:
+        """Position of a world rank inside this set (reference:
+        ProcessSet::controller->GetRank relative numbering)."""
+        try:
+            return self.ranks.index(world_rank)
+        except (ValueError, AttributeError):
+            raise ProcessSetError(
+                f"world rank {world_rank} is not a member of process set "
+                f"{self.process_set_id}"
+            )
+
+    def included(self, world_rank: int) -> bool:
+        return self.ranks is not None and world_rank in self.ranks
+
+    def __repr__(self) -> str:
+        return f"ProcessSet(id={self.process_set_id}, ranks={self.ranks})"
+
+
+#: The world process set, always id 0 (reference: global_process_set).
+global_process_set = ProcessSet()
+
+
+class ProcessSetRegistry:
+    """Registry mapping set ids to :class:`ProcessSet`.
+
+    Reference: horovod/common/process_set.cc (ProcessSetTable) — ids are
+    assigned monotonically, id 0 is the world, removal frees the id.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._table: Dict[int, ProcessSet] = {}
+        self._next_id = 0
+
+    def attach_world(self, topology: Topology) -> None:
+        with self._lock:
+            self._table.clear()
+            self._next_id = 0
+            global_process_set.process_set_id = None
+            global_process_set.ranks = None
+            global_process_set._mesh = None
+            global_process_set._attach(0, topology)
+            self._table[0] = global_process_set
+            self._next_id = 1
+            self._topology = topology
+
+    def add(self, process_set: ProcessSet) -> ProcessSet:
+        with self._lock:
+            if process_set.process_set_id is not None:
+                raise ProcessSetError("process set is already registered")
+            # compare against the post-attach expansion (ranks=None means
+            # the full world, which must collide with set 0)
+            effective = (
+                sorted(process_set.ranks)
+                if process_set.ranks is not None
+                else list(range(self._topology.size))
+            )
+            for existing in self._table.values():
+                if existing.ranks == effective:
+                    raise ProcessSetError(
+                        f"a process set with ranks {existing.ranks} already exists"
+                    )
+            set_id = self._next_id
+            self._next_id += 1
+            process_set._attach(set_id, self._topology)
+            self._table[set_id] = process_set
+            return process_set
+
+    def remove(self, process_set: ProcessSet) -> None:
+        with self._lock:
+            set_id = process_set.process_set_id
+            if set_id == 0:
+                raise ProcessSetError("cannot remove the global process set")
+            if set_id is None or set_id not in self._table:
+                raise ProcessSetError("process set is not registered")
+            del self._table[set_id]
+            process_set.process_set_id = None
+            process_set._mesh = None
+
+    def get(self, set_id: int) -> ProcessSet:
+        with self._lock:
+            try:
+                return self._table[set_id]
+            except KeyError:
+                raise ProcessSetError(f"unknown process set id {set_id}")
+
+    def ids(self) -> List[int]:
+        with self._lock:
+            return sorted(self._table)
+
+    def resolve(self, process_set: Optional[ProcessSet]) -> ProcessSet:
+        return process_set if process_set is not None else global_process_set

@@ -1,0 +1,128 @@
+"""Pod topology discovery and mesh construction.
+
+TPU-native replacement for the reference's rank/communicator bootstrap
+(horovod/common/mpi/mpi_context.cc MPI_Comm_rank + host-hash allgather, and
+horovod/common/gloo/gloo_context.cc HTTP rendezvous — SURVEY.md §3.1): on TPU
+the runtime already knows the pod topology, so ``jax.devices()`` +
+``jax.process_index()`` replace the entire rendezvous dance.  Multi-host
+membership is established once via ``jax.distributed.initialize`` (the JAX
+coordination service plays the role of the Gloo HTTP store).
+
+The world is modelled as a 1-D ``jax.sharding.Mesh`` over every chip, axis
+name ``"hvd"`` — data parallelism is sharding over that axis and gradient
+reduction is ``psum`` riding ICI.  Hierarchical (intra-slice ICI +
+inter-slice DCN) layouts reshape the same devices into a 2-D
+``("dcn", "ici")`` mesh, the analog of the reference's local/cross
+communicators used by NCCLHierarchicalAllreduce
+(horovod/common/ops/nccl_operations.cc).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+#: Name of the world data-parallel mesh axis ("the ring" in reference terms).
+WORLD_AXIS = "hvd"
+#: Axis names of the hierarchical 2-D mesh (inter-slice DCN x intra-slice ICI).
+DCN_AXIS = "dcn"
+ICI_AXIS = "ici"
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """Immutable snapshot of the device world at ``init()`` time.
+
+    Plays the role of the reference's Controller rank bookkeeping
+    (horovod/common/controller.cc: rank/local_rank/cross_rank,
+    local_sizes/local_comm_ranks) but is computed directly from PJRT
+    topology instead of a host-hash allgather.
+    """
+
+    devices: tuple  # all devices, in global (iota) order
+    local_devices: tuple  # devices addressable by this process
+    process_index: int
+    num_processes: int
+
+    @property
+    def size(self) -> int:
+        """Number of chips == number of data-parallel workers."""
+        return len(self.devices)
+
+    @property
+    def local_size(self) -> int:
+        return len(self.local_devices)
+
+    @property
+    def rank(self) -> int:
+        """Global rank of this process's lead device.
+
+        In the reference one process drives one GPU, so rank == process
+        index.  On TPU one process drives ``local_size`` chips; we define
+        the process rank as the global index of its first device so that
+        (a) ranks are unique per process, (b) rank 0 is the coordinator,
+        and (c) it degenerates to the classic value when local_size == 1.
+        """
+        if not self.local_devices:
+            return 0
+        first = self.local_devices[0]
+        return self.devices.index(first)
+
+    def owns_rank(self, world_rank: int) -> bool:
+        """True when the chip at ``world_rank`` belongs to this process —
+        the ownership test root-rank semantics need (a root_rank names a
+        chip; its owning process supplies the data)."""
+        if not 0 <= world_rank < self.size:
+            raise ValueError(
+                f"rank {world_rank} out of range [0, {self.size})"
+            )
+        return self.devices[world_rank] in self.local_devices
+
+    def mesh(self) -> Mesh:
+        """The 1-D world mesh: every chip on axis ``"hvd"``."""
+        return Mesh(np.asarray(self.devices, dtype=object), (WORLD_AXIS,))
+
+    def hierarchical_mesh(self, num_groups: Optional[int] = None) -> Mesh:
+        """2-D ``(dcn, ici)`` mesh for two-level reductions.
+
+        ``num_groups`` defaults to the number of processes (one group per
+        host/slice).  Reference analog: the local/cross communicator split
+        in horovod/common/mpi/mpi_context.cc used by hierarchical allreduce.
+        """
+        groups = num_groups if num_groups is not None else max(self.num_processes, 1)
+        if groups <= 0 or self.size % groups != 0:
+            raise ValueError(
+                f"cannot split {self.size} devices into {groups} equal groups"
+            )
+        arr = np.asarray(self.devices, dtype=object).reshape(groups, self.size // groups)
+        return Mesh(arr, (DCN_AXIS, ICI_AXIS))
+
+    def replicated_sharding(self, mesh: Optional[Mesh] = None) -> NamedSharding:
+        return NamedSharding(mesh or self.mesh(), P())
+
+    def world_sharding(self, mesh: Optional[Mesh] = None) -> NamedSharding:
+        """Leading-axis sharding over all chips."""
+        return NamedSharding(mesh or self.mesh(), P(WORLD_AXIS))
+
+
+def discover(devices: Optional[Sequence] = None) -> Topology:
+    """Build a :class:`Topology` from the live JAX backend.
+
+    Replaces the reference init-time bootstrap in SURVEY.md §3.1
+    (horovod/common/operations.cc InitializeHorovodOnce): no rendezvous —
+    PJRT already knows everything.
+    """
+    devs = tuple(devices) if devices is not None else tuple(jax.devices())
+    local = tuple(d for d in devs if getattr(d, "process_index", 0) == jax.process_index())
+    if not local:  # explicit device subset may exclude this process
+        local = tuple(jax.local_devices())
+    return Topology(
+        devices=devs,
+        local_devices=local,
+        process_index=jax.process_index(),
+        num_processes=jax.process_count(),
+    )

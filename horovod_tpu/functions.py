@@ -1,0 +1,113 @@
+"""High-level state synchronization helpers.
+
+Reference parity: horovod/torch/functions.py (broadcast_parameters,
+broadcast_optimizer_state, broadcast_object) and the allgather_object
+helper (SURVEY.md §2.3).  These are the primitives checkpoints-resume and
+elastic ``State.sync()`` build on (SURVEY.md §5.3/§5.4).
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .common import basics
+from .common.process_sets import ProcessSet
+from .ops import collective_ops
+
+
+def broadcast_parameters(
+    params: Any, root_rank: int = 0,
+    process_set: Optional[ProcessSet] = None,
+) -> Any:
+    """Broadcast a parameter pytree from ``root_rank`` to all workers.
+
+    Reference: horovod/torch/functions.py broadcast_parameters — used at
+    train start so every worker begins from identical weights.  Functional
+    (returns the new pytree) because JAX arrays are immutable.
+    """
+    return collective_ops.broadcast(params, root_rank, process_set=process_set)
+
+
+def broadcast_optimizer_state(
+    opt_state: Any, root_rank: int = 0,
+    process_set: Optional[ProcessSet] = None,
+) -> Any:
+    """Reference: horovod/torch/functions.py broadcast_optimizer_state.
+
+    optax states are pytrees of arrays plus static leaves; array leaves are
+    broadcast, non-array leaves (step schedules etc.) are taken from the
+    local copy — they are deterministic replicas by construction.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(opt_state)
+    array_idx = [
+        i for i, l in enumerate(leaves)
+        if isinstance(l, (jax.Array, np.ndarray))
+    ]
+    if array_idx:
+        arrays = [leaves[i] for i in array_idx]
+        arrays = collective_ops.broadcast(
+            arrays, root_rank, process_set=process_set
+        )
+        for i, a in zip(array_idx, arrays):
+            leaves[i] = a
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def broadcast_object(
+    obj: Any, root_rank: int = 0, name: Optional[str] = None,
+    process_set: Optional[ProcessSet] = None,
+) -> Any:
+    """Pickle-based object broadcast (reference: horovod/torch/mpi_ops.py
+    broadcast_object: serialize on root, bcast size then payload)."""
+    st = basics._require_init()
+    if not st.engine.multi_process:
+        return obj
+    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    sz = collective_ops.broadcast(
+        jnp.asarray([payload.size], jnp.int32), root_rank,
+        process_set=process_set,
+    )
+    size = int(np.asarray(sz)[0])
+    # root_rank names a chip; its *owning process* supplies the payload
+    # (with multiple local chips, rank() != root_rank even on the owner)
+    if st.topology.owns_rank(root_rank):
+        buf = payload
+    else:
+        buf = np.zeros(size, dtype=np.uint8)
+    out = collective_ops.broadcast(
+        jnp.asarray(buf), root_rank, process_set=process_set
+    )
+    return pickle.loads(np.asarray(out).tobytes())
+
+
+def allgather_object(
+    obj: Any, name: Optional[str] = None,
+    process_set: Optional[ProcessSet] = None,
+) -> list:
+    """Reference: horovod/torch/mpi_ops.py allgather_object — returns the
+    list of every worker's object."""
+    st = basics._require_init()
+    if not st.engine.multi_process:
+        return [obj]
+    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    sizes = collective_ops.allgather(
+        jnp.asarray([payload.size], jnp.int32), process_set=process_set
+    )
+    sizes = np.asarray(sizes)
+    max_size = int(sizes.max())
+    padded = np.zeros(max_size, dtype=np.uint8)
+    padded[: payload.size] = payload
+    gathered = collective_ops.allgather(
+        jnp.asarray(padded)[None], process_set=process_set
+    )
+    gathered = np.asarray(gathered)
+    return [
+        pickle.loads(gathered[i, : int(sizes[i])].tobytes())
+        for i in range(gathered.shape[0])
+    ]

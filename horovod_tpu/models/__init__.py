@@ -1,0 +1,2 @@
+"""Model zoo used by benchmarks and examples (reference analog: examples/
+model definitions, e.g. pytorch_synthetic_benchmark's ResNet-50)."""

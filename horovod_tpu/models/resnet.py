@@ -1,0 +1,138 @@
+"""ResNet family (flax linen), TPU-first.
+
+Reference analog: the ResNet-50 used by the reference's headline benchmarks
+(examples/pytorch/pytorch_synthetic_benchmark.py loads torchvision
+resnet50; examples/tensorflow2/tensorflow2_synthetic_benchmark.py uses
+Keras ResNet50 — BASELINE.md tracked configs).  Written natively for TPU:
+
+  * bfloat16 activations by default (MXU-friendly), float32 params/BN stats;
+  * NHWC layout (XLA:TPU's native conv layout);
+  * ``bn_axis_name`` turns every BatchNorm into a cross-replica (sync) BN
+    via flax's ``axis_name`` — the TPU-native form of
+    horovod/torch/sync_batch_norm.py (one fused psum over the mesh axis
+    instead of hand-written allgather of moments).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 -> 3x3 -> 1x1 bottleneck with projection shortcut."""
+
+    features: int
+    strides: Tuple[int, int]
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.features, (1, 1))(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.features, (3, 3), self.strides)(y)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.features * 4, (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.features * 4, (1, 1), self.strides, name="conv_proj"
+            )(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return self.act(residual + y)
+
+
+class ResNetBlock(nn.Module):
+    """Basic 3x3 -> 3x3 block (ResNet-18/34)."""
+
+    features: int
+    strides: Tuple[int, int]
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.features, (3, 3), self.strides)(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.features, (3, 3))(y)
+        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.features, (1, 1), self.strides, name="conv_proj"
+            )(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return self.act(residual + y)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    block_cls: ModuleDef
+    num_classes: int = 1000
+    num_filters: int = 64
+    dtype: Any = jnp.bfloat16
+    bn_axis_name: Optional[str] = None  # set to mesh axis for sync-BN
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(
+            nn.Conv, use_bias=False, dtype=self.dtype,
+            kernel_init=nn.initializers.variance_scaling(
+                2.0, "fan_out", "normal"
+            ),
+        )
+        norm = functools.partial(
+            nn.BatchNorm, use_running_average=not train, momentum=0.9,
+            epsilon=1e-5, dtype=self.dtype, axis_name=self.bn_axis_name,
+        )
+        x = x.astype(self.dtype)
+        x = conv(self.num_filters, (7, 7), (2, 2),
+                 padding=[(3, 3), (3, 3)], name="conv_init")(x)
+        x = norm(name="bn_init")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for i, block_size in enumerate(self.stage_sizes):
+            for j in range(block_size):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = self.block_cls(
+                    features=self.num_filters * 2 ** i,
+                    strides=strides, conv=conv, norm=norm, act=nn.relu,
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32,
+                     name="head")(x.astype(jnp.float32))
+        return x
+
+
+ResNet18 = functools.partial(
+    ResNet, stage_sizes=[2, 2, 2, 2], block_cls=ResNetBlock
+)
+ResNet34 = functools.partial(
+    ResNet, stage_sizes=[3, 4, 6, 3], block_cls=ResNetBlock
+)
+ResNet50 = functools.partial(
+    ResNet, stage_sizes=[3, 4, 6, 3], block_cls=BottleneckBlock
+)
+ResNet101 = functools.partial(
+    ResNet, stage_sizes=[3, 4, 23, 3], block_cls=BottleneckBlock
+)
+ResNet152 = functools.partial(
+    ResNet, stage_sizes=[3, 8, 36, 3], block_cls=BottleneckBlock
+)
+# Tiny variant for CPU-mesh tests / multichip dry runs.
+ResNetTiny = functools.partial(
+    ResNet, stage_sizes=[1, 1], block_cls=ResNetBlock, num_filters=8,
+    num_classes=10,
+)

@@ -1,0 +1,47 @@
+"""Small models: MLP and LeNet.
+
+Reference analog: examples/pytorch/pytorch_mnist.py's LeNet-style Net (a
+BASELINE.md tracked config) and the MNIST MLPs across the reference's
+examples/ — used here for the minimum end-to-end slice (SURVEY.md §7.2
+step 3) and CI-speed training tests.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    features: Sequence[int] = (128, 64)
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        for f in self.features:
+            x = nn.relu(nn.Dense(f, dtype=self.dtype)(x))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+class LeNet(nn.Module):
+    """LeNet-5-style conv net matching the reference's pytorch_mnist.py Net:
+    two conv+pool stages then two dense layers."""
+
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        # expects NHWC (e.g. (B, 28, 28, 1))
+        x = x.astype(self.dtype)
+        x = nn.Conv(10, (5, 5), dtype=self.dtype)(x)
+        x = nn.max_pool(nn.relu(x), (2, 2), strides=(2, 2))
+        x = nn.Conv(20, (5, 5), dtype=self.dtype)(x)
+        x = nn.max_pool(nn.relu(x), (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(50, dtype=self.dtype)(x))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)

@@ -1,0 +1,177 @@
+"""GPT-style decoder-only transformer (flax linen), TPU-first.
+
+Reference analog: the BERT-Large SQuAD fine-tune and Llama-7B pretrain
+configs tracked in BASELINE.json — the reference trains these data-parallel
+via DistributedOptimizer; this model is the framework's flagship for the
+same role, designed so sequence parallelism can shard the context:
+
+  * ``attention_impl='dot'`` — plain causal attention (default);
+  * ``attention_impl='ring'`` — ring attention over a mesh axis
+    (parallel/ring_attention.py): the sequence dimension is sharded and
+    KV blocks rotate via ``ppermute``, enabling contexts far beyond one
+    chip's HBM.  The reference has no analog (SURVEY.md §5.7) — it only
+    ships the alltoall/allgather primitives such schemes build on.
+
+bfloat16 activations, float32 params; RoPE positions; pre-norm blocks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    num_layers: int = 12
+    num_heads: int = 12
+    head_dim: int = 64
+    mlp_ratio: int = 4
+    max_seq_len: int = 2048
+    dtype: Any = jnp.bfloat16
+    # 'dot' | 'ring'; 'ring' requires seq_axis_name and running inside
+    # shard_map with the sequence sharded over that axis.
+    attention_impl: str = "dot"
+    seq_axis_name: Optional[str] = None
+
+    @property
+    def d_model(self) -> int:
+        return self.num_heads * self.head_dim
+
+
+def rope(x: jax.Array, positions: jax.Array) -> jax.Array:
+    """Rotary position embedding; x: (B, S, H, D), positions: (B, S)."""
+    d = x.shape[-1]
+    freqs = 1.0 / (10000.0 ** (np.arange(0, d, 2) / d))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # (B, S, D/2)
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], -1)
+    return out.astype(x.dtype)
+
+
+def causal_dot_attention(q, k, v, *, q_offset=0, k_offset=0):
+    """Standard causal attention; offsets support sequence-sharded blocks.
+
+    q, k, v: (B, S, H, D).  Softmax in float32 (TPU numerics), matmuls in
+    the input dtype so they hit the MXU in bf16.
+    """
+    d = q.shape[-1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(d).astype(q.dtype)
+    q_pos = q_offset + jnp.arange(q.shape[1])
+    k_pos = k_offset + jnp.arange(k.shape[1])
+    mask = q_pos[:, None] >= k_pos[None, :]
+    logits = jnp.where(mask[None, None], logits.astype(jnp.float32), -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.cfg
+        dense = functools.partial(
+            nn.DenseGeneral, dtype=cfg.dtype, use_bias=False
+        )
+        q = dense(features=(cfg.num_heads, cfg.head_dim), name="q")(x)
+        k = dense(features=(cfg.num_heads, cfg.head_dim), name="k")(x)
+        v = dense(features=(cfg.num_heads, cfg.head_dim), name="v")(x)
+        q = rope(q, positions)
+        k = rope(k, positions)
+        if cfg.attention_impl == "ring":
+            from ..parallel.ring_attention import ring_attention
+
+            out = ring_attention(q, k, v, axis_name=cfg.seq_axis_name)
+        else:
+            out = causal_dot_attention(q, k, v)
+        return nn.DenseGeneral(
+            features=cfg.d_model, axis=(-2, -1), dtype=cfg.dtype,
+            use_bias=False, name="o",
+        )(out)
+
+
+class MlpBlock(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        hidden = cfg.d_model * cfg.mlp_ratio
+        gate = nn.Dense(hidden, dtype=cfg.dtype, use_bias=False, name="gate")(x)
+        up = nn.Dense(hidden, dtype=cfg.dtype, use_bias=False, name="up")(x)
+        return nn.Dense(
+            cfg.d_model, dtype=cfg.dtype, use_bias=False, name="down"
+        )(nn.silu(gate) * up)
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.cfg
+        norm = functools.partial(
+            nn.RMSNorm, dtype=cfg.dtype, epsilon=1e-5
+        )
+        x = x + Attention(cfg, name="attn")(norm(name="ln1")(x), positions)
+        x = x + MlpBlock(cfg, name="mlp")(norm(name="ln2")(x))
+        return x
+
+
+class Transformer(nn.Module):
+    """Decoder-only LM.  ``__call__(tokens, positions=None) -> logits``."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens, positions=None, train: bool = True):
+        cfg = self.cfg
+        if positions is None:
+            local = jnp.arange(tokens.shape[1])
+            if cfg.attention_impl == "ring" and cfg.seq_axis_name:
+                # sequence is sharded over the axis: global position =
+                # shard_index * S_local + local offset (RoPE must match
+                # the global causal offsets ring_attention masks with)
+                local = (
+                    jax.lax.axis_index(cfg.seq_axis_name) * tokens.shape[1]
+                    + local
+                )
+            positions = jnp.broadcast_to(local, tokens.shape)
+        emb = nn.Embed(
+            cfg.vocab_size, cfg.d_model,
+            dtype=cfg.dtype, name="embed",
+        )
+        x = emb(tokens)
+        for i in range(cfg.num_layers):
+            x = Block(cfg, name=f"layer_{i}")(x, positions)
+        x = nn.RMSNorm(dtype=cfg.dtype, epsilon=1e-5, name="ln_f")(x)
+        return emb.attend(x.astype(jnp.float32))
+
+
+# Named sizes (flagship family; Llama-ish shapes for the pretrain config).
+def gpt_small(**kw) -> TransformerConfig:
+    return TransformerConfig(num_layers=12, num_heads=12, head_dim=64, **kw)
+
+
+def gpt_tiny(**kw) -> TransformerConfig:
+    return TransformerConfig(
+        vocab_size=256, num_layers=2, num_heads=2, head_dim=16,
+        max_seq_len=128, **kw,
+    )
+
+
+def llama_7b(**kw) -> TransformerConfig:
+    return TransformerConfig(
+        vocab_size=32000, num_layers=32, num_heads=32, head_dim=128,
+        max_seq_len=4096, **kw,
+    )

@@ -1,0 +1,68 @@
+"""Native C++ core loader.
+
+Reference parity: horovod/common/basics.py loading the compiled
+``mpi_lib_v2`` extension (SURVEY.md §2.1 'HorovodBasics').  The native
+library (``libhvd_tpu_core.so``, built from ``horovod_tpu/native/src``)
+holds the background controller: TensorQueue, negotiation Controller,
+ResponseCache, FusionBufferManager accounting, Timeline writer,
+StallInspector and ParameterManager — the C++ components SURVEY.md §7.1
+requires as native, dispatching into XLA executables owned by the Python
+engine.
+
+Until the library is built (or on platforms where it fails to load) a
+Python fallback controller with the same interface keeps the framework
+fully functional — mirroring how the reference degrades from NCCL to MPI to
+Gloo (operation_manager.cc priority list).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+from ..common.topology import Topology
+from ..utils.env_parser import Config
+from ..utils.logging import get_logger
+
+_LIB_NAME = "libhvd_tpu_core.so"
+
+
+class PyFallbackController:
+    """Interface-compatible stand-in while the native core is unavailable.
+
+    Single-controller SPMD needs no negotiation (every collective is a
+    deterministic compiled program), so the fallback only tracks lifecycle.
+    """
+
+    is_native = False
+
+    def __init__(self, topology: Topology, config: Config):
+        self._topology = topology
+        self._config = config
+        self._shutdown = False
+
+    def shutdown(self) -> None:
+        self._shutdown = True
+
+
+def _lib_path() -> str:
+    return os.path.join(os.path.dirname(__file__), _LIB_NAME)
+
+
+def load_controller(topology: Topology, config: Config):
+    """Load the native controller, falling back to Python.
+
+    Reference: horovod/common/basics.py __init__ (extension dlopen) +
+    horovod_init (operations.cc).
+    """
+    path = _lib_path()
+    if os.path.exists(path):
+        try:
+            from .controller import NativeController  # deferred: needs lib
+
+            return NativeController(path, topology, config)
+        except OSError as e:
+            get_logger().warning("native core failed to load (%s); using "
+                                 "python fallback controller", e)
+    return PyFallbackController(topology, config)

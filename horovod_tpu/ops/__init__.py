@@ -1,0 +1,1 @@
+"""Collective op implementations (reference analog: horovod/common/ops/)."""

@@ -1,0 +1,76 @@
+"""Adasum: scale-invariant gradient combination.
+
+Reference parity: horovod/common/ops/adasum/adasum.h (the templated
+recursive vector-halving adasum kernel) and adasum_mpi_operations.cc
+(SURVEY.md §2.2).  The algorithm combines two gradients a, b as
+
+    adasum(a, b) = (1 - a·b / (2‖a‖²)) a  +  (1 - a·b / (2‖b‖²)) b
+
+which discounts the parallel component (both workers pushing the same
+direction counts once) while keeping orthogonal components additive, and is
+applied pairwise over a hypercube: at step k every rank combines with the
+partner whose rank differs in bit k, so after log2(n) rounds all ranks hold
+the full combination.
+
+TPU-native: the reference runs this over MPI send/recv between nodes; here
+the pairwise exchange is ``lax.ppermute`` with an XOR pairing inside the
+compiled program — each round is one ICI neighbor exchange plus fused
+elementwise math, no host involvement.  Dot products accumulate in float32
+regardless of gradient dtype (matching the reference's fp16 care in
+adasum.h's DispatchComputeDotAndNormSqrds).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..common.topology import WORLD_AXIS
+
+
+def _adasum_pair(v: jax.Array, pv: jax.Array) -> jax.Array:
+    f32 = jnp.float32
+    d = jnp.sum(v.astype(f32) * pv.astype(f32))
+    na = jnp.sum(v.astype(f32) * v.astype(f32))
+    nb = jnp.sum(pv.astype(f32) * pv.astype(f32))
+    ca = jnp.where(na > 0, 1.0 - d / (2.0 * na), 1.0).astype(v.dtype)
+    cb = jnp.where(nb > 0, 1.0 - d / (2.0 * nb), 1.0).astype(v.dtype)
+    return ca * v + cb * pv
+
+
+def adasum_allreduce(tensor: Any, axis: str = WORLD_AXIS) -> Any:
+    """Adasum-allreduce a pytree across the mesh axis (inside shard_map).
+
+    The pytree is flattened into one vector so the dot products span the
+    whole gradient, matching the reference's whole-buffer semantics for a
+    fused entry set.  Axis size must be a power of two (the reference's
+    recursive-halving has the same requirement and pads ranks otherwise —
+    we raise instead and document the restriction).
+    """
+    n = jax.lax.axis_size(axis)
+    if n & (n - 1):
+        raise ValueError(f"Adasum requires a power-of-two axis size, got {n}")
+    leaves, treedef = jax.tree_util.tree_flatten(tensor)
+    if not leaves:
+        return tensor
+    sizes = [l.size for l in leaves]
+    shapes = [l.shape for l in leaves]
+    dtype = leaves[0].dtype
+    vec = jnp.concatenate([jnp.ravel(l).astype(dtype) for l in leaves])
+
+    step = 1
+    while step < n:
+        perm = [(i, i ^ step) for i in range(n)]
+        pvec = jax.lax.ppermute(vec, axis, perm=perm)
+        vec = _adasum_pair(vec, pvec)
+        step <<= 1
+
+    out, offset = [], 0
+    for sz, shape in zip(sizes, shapes):
+        out.append(jax.lax.dynamic_slice_in_dim(vec, offset, sz).reshape(shape))
+        offset += sz
+    return jax.tree_util.tree_unflatten(
+        treedef, [o.astype(l.dtype) for o, l in zip(out, leaves)]
+    )

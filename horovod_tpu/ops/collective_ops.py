@@ -1,0 +1,284 @@
+"""Public eager collective API with Horovod's async-handle semantics.
+
+Reference parity: horovod/torch/mpi_ops.py (allreduce / allreduce_async /
+synchronize / poll, grouped variants) and the HandleManager in
+horovod/torch/handle_manager.h (SURVEY.md §2.3).  JAX dispatch is already
+asynchronous — a compiled collective returns immediately with futures for
+its outputs — so a "handle" simply owns the result arrays:
+``synchronize`` maps to ``jax.block_until_ready``, and the reference's
+ReadyEvent machinery (torch/ready_event.cc: a cudaEvent marking when the
+producer stream has actually materialized the gradient) has no equivalent
+because XLA sequences producer and collective in one program order.
+
+Pytree-first: every op accepts an arbitrary pytree and fuses its leaves
+into dtype buckets (one collective per bucket — ops/fusion.py), which is
+the grouped/fused execution path the reference reaches via
+grouped_allreduce + the FusionBufferManager.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..common import basics
+from ..common.process_sets import ProcessSet
+from .fusion import FusionPlan, fuse, unfuse
+from .reduce_ops import Average, ReduceOp, Sum
+
+
+class Handle:
+    """Async op handle (reference: horovod/torch/handle_manager.h — int
+    handles mapped to futures; here the handle owns its results directly)."""
+
+    __slots__ = ("_value",)
+
+    def __init__(self, value: Any):
+        self._value = value
+
+    def wait(self) -> Any:
+        leaves = jax.tree_util.tree_leaves(self._value)
+        if leaves:
+            jax.block_until_ready(leaves)
+        return self._value
+
+    def done(self) -> bool:
+        leaves = jax.tree_util.tree_leaves(self._value)
+        return all(
+            getattr(leaf, "is_ready", lambda: True)() for leaf in leaves
+        )
+
+
+def synchronize(handle: Handle) -> Any:
+    """Reference: horovod/torch/mpi_ops.py synchronize()."""
+    return handle.wait()
+
+
+def poll(handle: Handle) -> bool:
+    """Reference: horovod/torch/mpi_ops.py poll()."""
+    return handle.done()
+
+
+def _engine():
+    return basics._require_init().engine
+
+
+def _normalize_op(op: Optional[ReduceOp], average: Optional[bool]) -> ReduceOp:
+    """Mirror the reference's average/op argument reconciliation
+    (horovod/torch/mpi_ops.py handle_average_backwards_compatibility)."""
+    if op is not None and average is not None:
+        raise ValueError("specify either op or average, not both")
+    if op is None:
+        op = Average if (average is None or average) else Sum
+    return op
+
+
+def _fused_map(tree: Any, leaf_fn) -> Any:
+    """Apply a bucket-level collective to every dtype bucket of ``tree``."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if not leaves:
+        return tree
+    leaves = [jnp.asarray(x) for x in leaves]
+    cfg = basics._require_init().config
+    plan = FusionPlan(leaves, cfg.fusion_threshold_bytes)
+    fused = fuse(leaves, plan)
+    out_fused = [leaf_fn(buf) for buf in fused]
+    return jax.tree_util.tree_unflatten(treedef, unfuse(out_fused, plan))
+
+
+# -- allreduce ---------------------------------------------------------------
+
+
+def allreduce(
+    tensor: Any,
+    average: Optional[bool] = None,
+    name: Optional[str] = None,
+    op: Optional[ReduceOp] = None,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+    process_set: Optional[ProcessSet] = None,
+) -> Any:
+    """Fused allreduce of a tensor or pytree (reference:
+    horovod/torch/mpi_ops.py allreduce)."""
+    return allreduce_async(
+        tensor, average, name, op, prescale_factor, postscale_factor,
+        process_set,
+    ).wait()
+
+
+def allreduce_async(
+    tensor: Any,
+    average: Optional[bool] = None,
+    name: Optional[str] = None,
+    op: Optional[ReduceOp] = None,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+    process_set: Optional[ProcessSet] = None,
+) -> Handle:
+    rop = _normalize_op(op, average)
+    eng = _engine()
+    result = _fused_map(
+        tensor,
+        lambda buf: eng.allreduce(
+            buf, rop, prescale_factor, postscale_factor, process_set
+        ),
+    )
+    return Handle(result)
+
+
+def grouped_allreduce(
+    tensors: Sequence[Any],
+    average: Optional[bool] = None,
+    name: Optional[str] = None,
+    op: Optional[ReduceOp] = None,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+    process_set: Optional[ProcessSet] = None,
+) -> List[Any]:
+    """Reference: grouped_allreduce (horovod/torch/mpi_ops.py +
+    common/group_table.cc): the group executes atomically as shared fused
+    buffers — here the list *is* the pytree, so grouping falls out of
+    pytree fusion."""
+    return list(
+        allreduce(
+            list(tensors), average, name, op, prescale_factor,
+            postscale_factor, process_set,
+        )
+    )
+
+
+def grouped_allreduce_async(
+    tensors: Sequence[Any], **kwargs
+) -> Handle:
+    return allreduce_async(list(tensors), **kwargs)
+
+
+# -- allgather ---------------------------------------------------------------
+
+
+def allgather(
+    tensor: Any,
+    name: Optional[str] = None,
+    process_set: Optional[ProcessSet] = None,
+) -> Any:
+    """Reference: horovod/torch/mpi_ops.py allgather — concat along dim 0."""
+    return allgather_async(tensor, name, process_set).wait()
+
+
+def allgather_async(
+    tensor: Any,
+    name: Optional[str] = None,
+    process_set: Optional[ProcessSet] = None,
+) -> Handle:
+    eng = _engine()
+    result = jax.tree_util.tree_map(
+        lambda x: eng.allgather(jnp.asarray(x), process_set), tensor
+    )
+    return Handle(result)
+
+
+def grouped_allgather(
+    tensors: Sequence[Any], name: Optional[str] = None,
+    process_set: Optional[ProcessSet] = None,
+) -> List[Any]:
+    return [allgather(t, name, process_set) for t in tensors]
+
+
+# -- broadcast ---------------------------------------------------------------
+
+
+def broadcast(
+    tensor: Any,
+    root_rank: int,
+    name: Optional[str] = None,
+    process_set: Optional[ProcessSet] = None,
+) -> Any:
+    """Reference: horovod/torch/mpi_ops.py broadcast."""
+    return broadcast_async(tensor, root_rank, name, process_set).wait()
+
+
+def broadcast_async(
+    tensor: Any,
+    root_rank: int,
+    name: Optional[str] = None,
+    process_set: Optional[ProcessSet] = None,
+) -> Handle:
+    eng = _engine()
+    result = _fused_map(
+        tensor, lambda buf: eng.broadcast(buf, root_rank, process_set)
+    )
+    return Handle(result)
+
+
+# -- alltoall ----------------------------------------------------------------
+
+
+def alltoall(
+    tensor: jax.Array,
+    splits: Optional[Sequence[int]] = None,
+    name: Optional[str] = None,
+    process_set: Optional[ProcessSet] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Reference: horovod/torch/mpi_ops.py alltoall — returns
+    (received, received_splits)."""
+    return alltoall_async(tensor, splits, name, process_set).wait()
+
+
+def alltoall_async(
+    tensor: jax.Array,
+    splits: Optional[Sequence[int]] = None,
+    name: Optional[str] = None,
+    process_set: Optional[ProcessSet] = None,
+) -> Handle:
+    eng = _engine()
+    return Handle(eng.alltoall(jnp.asarray(tensor), splits, process_set))
+
+
+# -- reducescatter -----------------------------------------------------------
+
+
+def reducescatter(
+    tensor: Any,
+    op: ReduceOp = Sum,
+    name: Optional[str] = None,
+    process_set: Optional[ProcessSet] = None,
+) -> Any:
+    """Reference: horovod/torch/mpi_ops.py reducescatter."""
+    return reducescatter_async(tensor, op, name, process_set).wait()
+
+
+def reducescatter_async(
+    tensor: Any,
+    op: ReduceOp = Sum,
+    name: Optional[str] = None,
+    process_set: Optional[ProcessSet] = None,
+) -> Handle:
+    eng = _engine()
+    result = jax.tree_util.tree_map(
+        lambda x: eng.reducescatter(jnp.asarray(x), op, process_set), tensor
+    )
+    return Handle(result)
+
+
+# -- barrier / join ----------------------------------------------------------
+
+
+def barrier(process_set: Optional[ProcessSet] = None) -> None:
+    """Reference: horovod_barrier (operations.cc BarrierOp)."""
+    _engine().barrier(process_set)
+
+
+def join() -> int:
+    """Reference: horovod/torch/mpi_ops.py join() — signals this worker is
+    out of data; returns the last joining rank.  Meaningful only in
+    multi-process deployments; lands with the native controller's
+    negotiation (it must pump zero-contributions for peers' collectives).
+    """
+    st = basics._require_init()
+    if not st.engine.multi_process:
+        return st.topology.rank
+    raise NotImplementedError(
+        "join() over processes requires the native controller (M3+)"
+    )

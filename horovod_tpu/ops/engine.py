@@ -1,0 +1,323 @@
+"""Eager collective engine: compiled XLA programs as the data plane.
+
+This is the TPU-native replacement for the whole of the reference's op stack
+(horovod/common/ops/: nccl_operations.cc, mpi_operations.cc,
+gloo_operations.cc + operation_manager.cc — SURVEY.md §2.2).  Where the
+reference hand-runs NCCL/MPI rings from a background thread, here every
+collective is a *compiled XLA executable* over the world ``Mesh``: ICI/DCN
+routing, ring vs tree selection, and fusion are the compiler's job.
+
+Key design point (SURVEY.md §7.1): the reference negotiates dynamic tensor
+readiness every cycle; XLA needs static shapes.  The bridge is an
+**executable cache** keyed by (op, shape, dtype, scale, process-set) — the
+moral equivalent of the reference's ResponseCache
+(horovod/common/response_cache.cc), except a hit returns a ready-to-launch
+compiled collective rather than skipping a metadata gather.  After one warm
+step every collective launch is a cache hit.
+
+Eager semantics: one *contribution per process* (the reference's one
+contribution per rank; on TPU a process drives ``local_size`` chips, whose
+replicas count once).  With a single process the ops degenerate exactly as
+the reference's np=1 ops do.  In-jit per-chip collectives live in
+``ops.spmd_ops`` instead.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..common.exceptions import HorovodInternalError
+from ..common.process_sets import ProcessSet, global_process_set
+from ..common.topology import Topology, WORLD_AXIS
+from ..utils.env_parser import Config
+from .reduce_ops import ReduceOp
+
+
+def _reduce_unique(u: jax.Array, op: ReduceOp, num: int,
+                   prescale: jax.Array, postscale: jax.Array) -> jax.Array:
+    """Reduce axis 0 of the (num_contributions, ...) stack ``u``."""
+    if op in (ReduceOp.AVERAGE, ReduceOp.SUM):
+        r = jnp.sum(u * prescale, axis=0)
+        if op == ReduceOp.AVERAGE:
+            r = r / num
+        return r * postscale
+    if op == ReduceOp.MIN:
+        return jnp.min(u, axis=0)
+    if op == ReduceOp.MAX:
+        return jnp.max(u, axis=0)
+    if op == ReduceOp.PRODUCT:
+        return jnp.prod(u, axis=0)
+    raise NotImplementedError(f"eager reduce op {op!r}")
+
+
+class CollectiveEngine:
+    """Dispatches eager collectives as cached compiled XLA programs.
+
+    Reference analog: OperationManager::ExecuteOperation
+    (horovod/common/ops/operation_manager.cc) + the per-backend Execute
+    methods; 'backend selection' collapses to one backend — XLA — per
+    BASELINE.json's HOROVOD_TPU_OPERATIONS=XLA contract.
+    """
+
+    def __init__(self, topology: Topology, config: Config):
+        self.topology = topology
+        self.config = config
+        self._mesh = topology.mesh()
+        self._cache = {}  # signature -> compiled callable
+        # Global slot index of each process's lead device ("unique rows" of
+        # the tiled contribution stack).
+        self._lead_slots = self._compute_lead_slots()
+
+    # -- topology helpers ---------------------------------------------------
+
+    def _compute_lead_slots(self) -> Tuple[int, ...]:
+        slots = {}
+        for i, d in enumerate(self.topology.devices):
+            p = getattr(d, "process_index", 0)
+            if p not in slots:
+                slots[p] = i
+        return tuple(slots[p] for p in sorted(slots))
+
+    @property
+    def num_contributors(self) -> int:
+        return max(self.topology.num_processes, 1)
+
+    @property
+    def multi_process(self) -> bool:
+        return self.topology.num_processes > 1
+
+    # -- global-array plumbing ---------------------------------------------
+
+    def _stacked_global(self, x: jax.Array) -> jax.Array:
+        """Tile this process's contribution onto each local chip and view
+        the result as one global (size, ...) array sharded over the world
+        axis.  This is the 'memcpy into the fusion buffer' moment of the
+        reference (gpu_operations.cc MemcpyInFusionBuffer) — except it is a
+        zero-copy resharding hint, not a copy kernel."""
+        x = jnp.asarray(x)
+        shards = [
+            jax.device_put(x[None], d) for d in self.topology.local_devices
+        ]
+        global_shape = (self.topology.size,) + tuple(x.shape)
+        sharding = NamedSharding(self._mesh, P(WORLD_AXIS))
+        return jax.make_array_from_single_device_arrays(
+            global_shape, sharding, shards
+        )
+
+    def _replicated(self):
+        return NamedSharding(self._mesh, P())
+
+    def _local_view(self, global_arr: jax.Array) -> jax.Array:
+        """Local copy of a fully replicated global array."""
+        return global_arr.addressable_data(0)
+
+    def _compile(self, key, fn, *example_args):
+        cached = self._cache.get(key)
+        if cached is None:
+            cached = jax.jit(fn, out_shardings=self._replicated())
+            self._cache[key] = cached
+        return cached
+
+    def _unique_rows(self, a: jax.Array) -> jax.Array:
+        """(size, ...) tiled stack -> (num_processes, ...) unique rows."""
+        return a[jnp.asarray(self._lead_slots)]
+
+    # -- collectives --------------------------------------------------------
+
+    def allreduce(
+        self,
+        x: jax.Array,
+        op: ReduceOp = ReduceOp.AVERAGE,
+        prescale_factor: float = 1.0,
+        postscale_factor: float = 1.0,
+        process_set: Optional[ProcessSet] = None,
+    ) -> jax.Array:
+        """Reference: AllreduceOp::Execute (collective_operations.cc) /
+        NCCLAllreduce (nccl_operations.cc)."""
+        self._check_process_set(process_set)
+        x = jnp.asarray(x)
+        if op not in (ReduceOp.AVERAGE, ReduceOp.SUM) and (
+            prescale_factor != 1.0 or postscale_factor != 1.0
+        ):
+            raise ValueError(
+                f"prescale/postscale factors are not supported with op={op!r}"
+            )
+        if op == ReduceOp.ADASUM and self.multi_process:
+            raise NotImplementedError(
+                "eager Adasum over processes lands with the native controller"
+            )
+        if not self.multi_process:
+            if op in (ReduceOp.AVERAGE, ReduceOp.SUM):
+                if prescale_factor != 1.0 or postscale_factor != 1.0:
+                    return x * jnp.asarray(
+                        prescale_factor * postscale_factor, x.dtype
+                    )
+            return x
+        key = ("allreduce", x.shape, str(x.dtype), int(op))
+        n = self.num_contributors
+
+        def fn(a, pre, post):
+            u = self._unique_rows(a)
+            return _reduce_unique(u, op, n, pre, post)
+
+        compiled = self._compile(key, fn)
+        try:
+            g = compiled(
+                self._stacked_global(x),
+                jnp.asarray(prescale_factor, x.dtype),
+                jnp.asarray(postscale_factor, x.dtype),
+            )
+        except jax.errors.JaxRuntimeError as e:  # comm failure => elastic
+            raise HorovodInternalError(str(e)) from e
+        return self._local_view(g)
+
+    def allgather(
+        self, x: jax.Array, process_set: Optional[ProcessSet] = None
+    ) -> jax.Array:
+        """Concatenate contributions along dim 0 (reference:
+        AllgatherOp / NCCLAllgather).  Even first dims for now; uneven
+        first-dim support arrives with the native controller's shape
+        negotiation (MPIAllgather's recvcounts path)."""
+        self._check_process_set(process_set)
+        x = jnp.asarray(x)
+        if not self.multi_process:
+            return x
+        key = ("allgather", x.shape, str(x.dtype))
+
+        def fn(a):
+            u = self._unique_rows(a)  # (P, d0, ...)
+            return u.reshape((-1,) + u.shape[2:])
+
+        compiled = self._compile(key, fn)
+        return self._local_view(compiled(self._stacked_global(x)))
+
+    def broadcast(
+        self,
+        x: jax.Array,
+        root_rank: int,
+        process_set: Optional[ProcessSet] = None,
+    ) -> jax.Array:
+        """Reference: BroadcastOp / NCCLBroadcast.  ``root_rank`` is a world
+        (chip) rank; the owning process's contribution wins."""
+        self._check_process_set(process_set)
+        x = jnp.asarray(x)
+        root_slot = self._root_slot(root_rank)
+        if not self.multi_process:
+            return x
+        key = ("broadcast", x.shape, str(x.dtype), root_slot)
+
+        def fn(a):
+            return a[root_slot]
+
+        compiled = self._compile(key, fn)
+        return self._local_view(compiled(self._stacked_global(x)))
+
+    def alltoall(
+        self,
+        x: jax.Array,
+        splits: Optional[Sequence[int]] = None,
+        process_set: Optional[ProcessSet] = None,
+    ) -> Tuple[jax.Array, jax.Array]:
+        """Reference: AlltoallOp / NCCLAlltoall.  Returns (received,
+        received_splits) like horovod/torch/mpi_ops.py alltoall."""
+        self._check_process_set(process_set)
+        x = jnp.asarray(x)
+        n = self.num_contributors
+        if splits is not None:
+            splits = np.asarray(splits, dtype=np.int32)
+            if splits.shape != (n,) or int(splits.sum()) != (
+                x.shape[0] if x.ndim else 0
+            ):
+                raise ValueError(
+                    f"splits must be shape ({n},) summing to dim0 of the input"
+                )
+        if not self.multi_process:
+            recv_splits = (
+                jnp.asarray(splits)
+                if splits is not None
+                else jnp.asarray([x.shape[0]], dtype=jnp.int32)
+            )
+            return x, recv_splits
+        if splits is not None:
+            raise NotImplementedError(
+                "uneven alltoall splits over processes land with the native "
+                "controller's shape negotiation"
+            )
+        if x.shape[0] % n != 0:
+            raise ValueError(
+                f"alltoall dim0 ({x.shape[0]}) must divide evenly by {n}"
+            )
+        me = self.topology.process_index
+        key = ("alltoall", x.shape, str(x.dtype), me)
+        chunk = x.shape[0] // n
+
+        def fn(a):
+            u = self._unique_rows(a)  # (P, d0, ...)
+            c = u.reshape((n, n, chunk) + u.shape[2:])  # (src, dst, chunk,...)
+            return c[:, me].reshape((-1,) + u.shape[2:])
+
+        compiled = self._compile(key, fn)
+        out = self._local_view(compiled(self._stacked_global(x)))
+        return out, jnp.full((n,), chunk, dtype=jnp.int32)
+
+    def reducescatter(
+        self,
+        x: jax.Array,
+        op: ReduceOp = ReduceOp.SUM,
+        process_set: Optional[ProcessSet] = None,
+    ) -> jax.Array:
+        """Reference: ReducescatterOp / NCCLReducescatter — reduce then
+        scatter dim-0 chunks; this process keeps its own chunk."""
+        self._check_process_set(process_set)
+        x = jnp.asarray(x)
+        if not self.multi_process:
+            return x
+        n = self.num_contributors
+        if x.shape[0] % n != 0:
+            raise ValueError(
+                f"reducescatter dim0 ({x.shape[0]}) must divide evenly by {n}"
+            )
+        me = self.topology.process_index
+        key = ("reducescatter", x.shape, str(x.dtype), int(op), me)
+        chunk = x.shape[0] // n
+        one = jnp.asarray(1.0, x.dtype)
+
+        def fn(a):
+            u = self._unique_rows(a)
+            r = _reduce_unique(u, op, n, one, one)
+            return jax.lax.dynamic_slice_in_dim(r, me * chunk, chunk, axis=0)
+
+        compiled = self._compile(key, fn)
+        return self._local_view(compiled(self._stacked_global(x)))
+
+    def barrier(self, process_set: Optional[ProcessSet] = None) -> None:
+        """Reference: BarrierOp (collective_operations.cc)."""
+        self._check_process_set(process_set)
+        if not self.multi_process:
+            return
+        token = jnp.zeros((), jnp.int32)
+        jax.block_until_ready(self.allreduce(token, ReduceOp.SUM))
+
+    # -- helpers ------------------------------------------------------------
+
+    def _root_slot(self, root_rank: int) -> int:
+        if not 0 <= root_rank < self.topology.size:
+            raise ValueError(
+                f"root_rank {root_rank} out of range [0, {self.topology.size})"
+            )
+        return root_rank
+
+    def _check_process_set(self, process_set: Optional[ProcessSet]) -> None:
+        ps = process_set if process_set is not None else global_process_set
+        if ps.process_set_id not in (0, None) and self.multi_process:
+            raise NotImplementedError(
+                "eager process-set collectives across processes land with "
+                "the native controller; in-jit process sets work today via "
+                "ops.spmd_ops over the set's sub-mesh"
+            )

@@ -1,0 +1,96 @@
+"""Tensor fusion: dtype-bucketed pytree flattening.
+
+TPU-native rethink of the reference's FusionBufferManager
+(horovod/common/fusion_buffer_manager.cc, SURVEY.md §2.1): the reference
+memcpys many small tensors into one persistent 64 MB device buffer so a
+single NCCL call amortizes launch + ring latency.  Under XLA the concat and
+split fuse into the collective's prologue/epilogue, so "the fusion buffer"
+is simply ``concatenate`` inside the compiled program — no persistent
+allocation, no memcpy kernels (cuda/cuda_kernels.cu BatchedD2DMemcpy has no
+equivalent because XLA emits the batched copy itself).
+
+What still matters on TPU and is kept:
+  * one collective per dtype bucket (launch overhead, DCN message rate);
+  * a byte threshold splitting huge buckets so a single fused psum does not
+    blow HBM working-set limits (HOROVOD_FUSION_THRESHOLD semantics);
+  * deterministic bucket assignment so every rank fuses identically — the
+    invariant the reference's Controller negotiation exists to enforce.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class FusionPlan:
+    """Deterministic partition of a flat tensor list into dtype buckets.
+
+    Equivalent role to the Response fusion built by the reference's
+    Controller (horovod/common/controller.cc: tensors fused into Responses
+    up to the fusion threshold), but computed locally: bucket layout is a
+    pure function of (shapes, dtypes, threshold), identical on every rank
+    because SPMD programs are identical — no negotiation required.
+    """
+
+    def __init__(self, leaves: Sequence[jax.Array], threshold_bytes: int):
+        self.specs: List[Tuple[Tuple[int, ...], Any]] = [
+            (tuple(x.shape), x.dtype) for x in leaves
+        ]
+        buckets: Dict[Any, List[int]] = {}
+        bucket_bytes: Dict[Any, int] = {}
+        self.buckets: List[Tuple[Any, List[int]]] = []
+        if threshold_bytes <= 0:
+            # HOROVOD_FUSION_THRESHOLD=0 disables fusion entirely
+            # (reference contract): one bucket per tensor.
+            self.buckets = [
+                (jnp.dtype(dtype), [i])
+                for i, (_, dtype) in enumerate(self.specs)
+            ]
+            return
+        for i, (shape, dtype) in enumerate(self.specs):
+            nbytes = int(np.prod(shape, dtype=np.int64)) * jnp.dtype(dtype).itemsize
+            key = jnp.dtype(dtype)
+            if key in buckets and (
+                bucket_bytes[key] + nbytes <= threshold_bytes
+                or bucket_bytes[key] == 0
+            ):
+                buckets[key].append(i)
+                bucket_bytes[key] += nbytes
+            else:
+                if key in buckets:
+                    self.buckets.append((key, buckets[key]))
+                buckets[key] = [i]
+                bucket_bytes[key] = nbytes
+        for key, idxs in buckets.items():
+            self.buckets.append((key, idxs))
+
+    def signature(self) -> Tuple:
+        """Hashable cache key (reference analog: the ResponseCache entry —
+        SURVEY.md §7.1 maps negotiation caching onto executable caching)."""
+        return tuple(self.specs)
+
+
+def fuse(leaves: Sequence[jax.Array], plan: FusionPlan) -> List[jax.Array]:
+    """Flatten + concat each bucket into one 1-D buffer.  Traceable."""
+    fused = []
+    for _, idxs in plan.buckets:
+        parts = [jnp.ravel(leaves[i]) for i in idxs]
+        fused.append(parts[0] if len(parts) == 1 else jnp.concatenate(parts))
+    return fused
+
+
+def unfuse(fused: Sequence[jax.Array], plan: FusionPlan) -> List[jax.Array]:
+    """Inverse of :func:`fuse`.  Traceable."""
+    out: List[jax.Array] = [None] * len(plan.specs)  # type: ignore[list-item]
+    for (dtype, idxs), buf in zip(plan.buckets, fused):
+        offset = 0
+        for i in idxs:
+            shape, _ = plan.specs[i]
+            n = int(np.prod(shape, dtype=np.int64))
+            out[i] = jax.lax.dynamic_slice_in_dim(buf, offset, n).reshape(shape)
+            offset += n
+    return out

@@ -1,0 +1,29 @@
+"""Reduction op identifiers.
+
+Reference parity: horovod/torch/mpi_ops.py & horovod/common/message.h expose
+Average / Sum / Adasum (plus Min / Max / Product for allreduce in later
+reference versions).  Values are stable small ints so they can cross the
+ctypes boundary into the native controller unchanged.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class ReduceOp(enum.IntEnum):
+    AVERAGE = 0
+    SUM = 1
+    ADASUM = 2
+    MIN = 3
+    MAX = 4
+    PRODUCT = 5
+
+
+# Module-level aliases matching ``hvd.Average`` / ``hvd.Sum`` / ``hvd.Adasum``.
+Average = ReduceOp.AVERAGE
+Sum = ReduceOp.SUM
+Adasum = ReduceOp.ADASUM
+Min = ReduceOp.MIN
+Max = ReduceOp.MAX
+Product = ReduceOp.PRODUCT

@@ -1,0 +1,210 @@
+"""In-jit (SPMD) collectives: the per-chip view of the world.
+
+This module is where the TPU-first reinterpretation of Horovod lives.  The
+reference's "rank" is a process driving one GPU; on TPU the natural worker
+is a *chip inside a compiled SPMD program*, so the per-rank programming
+model becomes: write your per-worker code as a function, run it under
+``shard_map`` over the world mesh, and call these collectives inside it.
+XLA lowers them onto ICI rings/trees — the hand-written NCCL ring of
+horovod/common/ops/nccl_operations.cc is replaced by the compiler
+(SURVEY.md §5.8 backend mapping).
+
+All ops accept pytrees (XLA fuses the resulting collectives — the in-program
+analog of the reference's fusion buffer) and mirror the eager API's
+signatures so user code moves between the two with an ``axis=`` argument.
+
+Prior art note: the reference's own TF XLA path
+(horovod/tensorflow/xla_mpi_ops.cc) is the closest thing it has to this
+module — custom-calls surviving jit compilation.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..common import basics
+from ..common.process_sets import ProcessSet
+from ..common.topology import WORLD_AXIS
+from .reduce_ops import Average, ReduceOp, Sum
+
+
+def rank(axis: str = WORLD_AXIS) -> jax.Array:
+    """Per-chip rank inside a shard_map'ped program (reference:
+    horovod_rank, reinterpreted per-chip)."""
+    return jax.lax.axis_index(axis)
+
+
+def size(axis: str = WORLD_AXIS) -> int:
+    """Static axis size (reference: horovod_size)."""
+    return jax.lax.axis_size(axis)
+
+
+def _scale(x, factor):
+    if isinstance(factor, (int, float)) and factor == 1.0:
+        return x
+    return jax.tree_util.tree_map(
+        lambda t: t * jnp.asarray(factor, t.dtype), x
+    )
+
+
+def allreduce(
+    tensor: Any,
+    average: Optional[bool] = None,
+    op: Optional[ReduceOp] = None,
+    axis: str = WORLD_AXIS,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+) -> Any:
+    """Allreduce a pytree across the mesh axis.
+
+    Reference: NCCLAllreduce::Execute (nccl_operations.cc) — a single
+    ``psum`` here; XLA chooses ring vs tree and rides ICI.  ``op`` follows
+    horovod/torch/mpi_ops.py (Average default, Sum, Min, Max, Product).
+    """
+    if op is not None and average is not None:
+        raise ValueError("specify either op or average, not both")
+    if op is None:
+        op = Average if (average is None or average) else Sum
+    if op not in (ReduceOp.AVERAGE, ReduceOp.SUM) and (
+        prescale_factor != 1.0 or postscale_factor != 1.0
+    ):
+        # reference contract (horovod/torch/mpi_ops.py): scaling factors
+        # are only defined for sum-based reductions
+        raise ValueError(
+            f"prescale/postscale factors are not supported with op={op!r}"
+        )
+    if op in (ReduceOp.AVERAGE, ReduceOp.SUM):
+        x = _scale(tensor, prescale_factor)
+        red = jax.lax.psum(x, axis)
+        if op == ReduceOp.AVERAGE:
+            n = jax.lax.axis_size(axis)
+            red = jax.tree_util.tree_map(
+                lambda t: t / jnp.asarray(n, t.dtype), red
+            )
+        return _scale(red, postscale_factor)
+    if op == ReduceOp.MIN:
+        return jax.lax.pmin(tensor, axis)
+    if op == ReduceOp.MAX:
+        return jax.lax.pmax(tensor, axis)
+    if op == ReduceOp.PRODUCT:
+        # No native pprod; exp-sum-log is lossy, so gather+reduce instead.
+        return jax.tree_util.tree_map(
+            lambda t: jnp.prod(jax.lax.all_gather(t, axis), axis=0), tensor
+        )
+    if op == ReduceOp.ADASUM:
+        from .adasum import adasum_allreduce  # deferred: optional dependency
+
+        return adasum_allreduce(tensor, axis)
+    raise ValueError(f"unknown reduce op {op!r}")
+
+
+def allgather(tensor: Any, axis: str = WORLD_AXIS) -> Any:
+    """Concat along dim 0 across the axis (reference: NCCLAllgather;
+    ``tiled=True`` reproduces horovod's concat-not-stack semantics)."""
+    return jax.tree_util.tree_map(
+        lambda t: jax.lax.all_gather(t, axis, tiled=True), tensor
+    )
+
+
+def broadcast(tensor: Any, root_rank: int, axis: str = WORLD_AXIS) -> Any:
+    """Every chip receives the root chip's value (reference:
+    NCCLBroadcast).  Implemented as a masked psum — one allreduce, which
+    XLA lowers to an ICI broadcast when the mask is static."""
+    idx = jax.lax.axis_index(axis)
+    mask = (idx == root_rank)
+
+    def bcast_leaf(t):
+        t = jnp.asarray(t)
+        if t.dtype == jnp.bool_:
+            return jax.lax.psum(
+                jnp.where(mask, t.astype(jnp.int32), 0), axis
+            ).astype(jnp.bool_)
+        return jax.lax.psum(jnp.where(mask, t, jnp.zeros_like(t)), axis)
+
+    return jax.tree_util.tree_map(bcast_leaf, tensor)
+
+
+def alltoall(
+    tensor: jax.Array,
+    axis: str = WORLD_AXIS,
+    split_axis: int = 0,
+    concat_axis: int = 0,
+) -> jax.Array:
+    """Reference: NCCLAlltoall — dim-``split_axis`` chunks exchanged, chunk
+    i going to rank i, received chunks concatenated along ``concat_axis``.
+    This is the Ulysses sequence-parallel building block (SURVEY.md §5.7).
+    """
+    return jax.lax.all_to_all(
+        tensor, axis, split_axis, concat_axis, tiled=True
+    )
+
+
+def reducescatter(
+    tensor: Any, op: ReduceOp = Sum, axis: str = WORLD_AXIS
+) -> Any:
+    """Reference: NCCLReducescatter — reduce then keep this rank's dim-0
+    chunk.  ``psum_scatter`` maps directly onto the ICI reduce-scatter."""
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError("reducescatter supports Sum and Average")
+
+    def rs_leaf(t):
+        r = jax.lax.psum_scatter(t, axis, scatter_dimension=0, tiled=True)
+        if op == ReduceOp.AVERAGE:
+            r = r / jnp.asarray(jax.lax.axis_size(axis), r.dtype)
+        return r
+
+    return jax.tree_util.tree_map(rs_leaf, tensor)
+
+
+def barrier(axis: str = WORLD_AXIS) -> None:
+    """In-program barrier: a zero-byte-ish psum orders the program against
+    the axis (reference: BarrierOp)."""
+    jax.lax.psum(jnp.zeros((), jnp.int32), axis)
+
+
+# -- per-rank harness --------------------------------------------------------
+
+
+def run_per_rank(
+    fn: Callable[[jax.Array], Any],
+    mesh: Optional[Mesh] = None,
+    axis: str = WORLD_AXIS,
+    process_set: Optional[ProcessSet] = None,
+):
+    """Run a per-rank program on every chip; the Horovod programming model
+    as a function transform.
+
+    ``fn(rank_scalar) -> pytree`` executes once per chip under
+    ``shard_map``; collectives from this module work inside it.  Returns
+    the per-rank outputs stacked on a leading axis — which is exactly what
+    the reference's `horovodrun -np N pytest` per-rank test pattern
+    produces across processes (SURVEY.md §4), making single-process parity
+    tests possible on a virtual device mesh.
+    """
+    if mesh is None:
+        st = basics._require_init()
+        mesh = (
+            process_set.mesh
+            if process_set is not None
+            else st.process_set_registry.get(0).mesh
+        )
+    n = int(np.prod(mesh.devices.shape))
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=P(axis),
+        out_specs=P(axis),
+        check_vma=False,
+    )
+    def body(r):
+        out = fn(r[0])
+        return jax.tree_util.tree_map(lambda t: jnp.asarray(t)[None], out)
+
+    return body(jnp.arange(n, dtype=jnp.int32))

@@ -1,0 +1,5 @@
+"""Parallelism strategies beyond data parallel: hierarchical ICI/DCN
+reduction, ring attention, Ulysses sequence parallelism (SURVEY.md §2.6).
+The reference is data-parallel only; these modules exist because on TPU the
+same mesh machinery makes them cheap and they are first-class in this
+framework's scope."""

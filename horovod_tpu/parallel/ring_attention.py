@@ -1,0 +1,100 @@
+"""Ring attention: exact causal attention over a sequence-sharded mesh axis.
+
+No reference analog — the reference is data-parallel only and explicitly
+lacks sequence/context parallelism (SURVEY.md §5.7); it ships only the
+primitives (alltoall, allgather).  This module is the long-context pillar
+of the framework: the sequence dimension is sharded over a mesh axis, each
+chip keeps its Q shard resident, and K/V shards rotate around the ring via
+``lax.ppermute`` (ICI neighbor exchange) while a flash-style online softmax
+accumulates exact results — memory per chip is O(S/n), enabling contexts
+that cannot fit a single chip's HBM.  (Liu et al., "Ring Attention with
+Blockwise Transformers", 2023 — PAPERS.md.)
+
+TPU mapping: each of the n steps is one ppermute (ICI hop, overlappable
+with the block matmuls by XLA's latency-hiding scheduler) plus two MXU
+matmuls in the compute dtype; softmax statistics stay in float32.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..common.topology import WORLD_AXIS
+
+_NEG_INF = -1e30
+
+
+def _block_update(o, l, m, q, k, v, q_offset, k_offset):
+    """One online-softmax accumulation step over a K/V block.
+
+    o: (B,H,Sq,D) f32 accumulator; l: (B,H,Sq) row sums; m: (B,H,Sq) row
+    maxes; q: (B,Sq,H,D); k,v: (B,Sk,H,D).
+    """
+    d = q.shape[-1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    logits = logits / jnp.sqrt(d)
+    q_pos = q_offset + jnp.arange(q.shape[1])
+    k_pos = k_offset + jnp.arange(k.shape[1])
+    mask = q_pos[:, None] >= k_pos[None, :]  # (Sq, Sk)
+    logits = jnp.where(mask[None, None], logits, _NEG_INF)
+    block_max = jnp.max(logits, axis=-1)  # (B,H,Sq)
+    new_m = jnp.maximum(m, block_max)
+    # exp of masked entries is zeroed explicitly so fully-masked blocks
+    # contribute nothing even when new_m is still the -inf sentinel.
+    p = jnp.where(
+        mask[None, None], jnp.exp(logits - new_m[..., None]), 0.0
+    )
+    corr = jnp.exp(m - new_m)
+    new_l = l * corr + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bhqd", p.astype(v.dtype), v)
+    new_o = o * corr[..., None] + pv.astype(jnp.float32)
+    return new_o, new_l, new_m
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: Optional[str] = None,
+) -> jax.Array:
+    """Exact causal attention with K/V rotating around the mesh axis.
+
+    Args:
+      q, k, v: (B, S_local, H, D) — this chip's sequence shard; global
+        sequence order follows the axis index.
+      axis_name: mesh axis the sequence is sharded over (must be bound,
+        i.e. called inside shard_map).  ``None`` falls back to the world
+        axis.
+    Returns:
+      (B, S_local, H, D) attention output for the local Q shard.
+    """
+    axis = axis_name or WORLD_AXIS
+    n = jax.lax.axis_size(axis)
+    idx = jax.lax.axis_index(axis)
+    b, s_local, h, d = q.shape
+    if n == 1:
+        from ..models.transformer import causal_dot_attention
+
+        return causal_dot_attention(q, k, v)
+
+    q_offset = idx * s_local
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(t, carry):
+        o, l, m, kk, vv = carry
+        src = (idx - t) % n  # which shard's K/V we currently hold
+        o, l, m = _block_update(o, l, m, q, kk, vv, q_offset, src * s_local)
+        kk = jax.lax.ppermute(kk, axis, perm)
+        vv = jax.lax.ppermute(vv, axis, perm)
+        return o, l, m, kk, vv
+
+    o = jnp.zeros((b, h, s_local, d), jnp.float32)
+    l = jnp.zeros((b, h, s_local), jnp.float32)
+    m = jnp.full((b, h, s_local), _NEG_INF, jnp.float32)
+    o, l, m, _, _ = jax.lax.fori_loop(0, n, step, (o, l, m, k, v))
+    # causal rows always see at least the diagonal, so l > 0 everywhere
+    out = o / l[..., None]
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)

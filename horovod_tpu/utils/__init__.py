@@ -1,0 +1,1 @@
+"""Utilities (reference analog: horovod/common/utils/ + logging/timeline)."""

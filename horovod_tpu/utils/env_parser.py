@@ -1,0 +1,97 @@
+"""Environment-variable configuration.
+
+Reference parity: horovod/common/utils/env_parser.cc + SURVEY.md §5.6 — env
+is the single source of truth at init time; the launcher CLI and YAML config
+file both converge on these variables.  Knob names keep the reference's
+spelling with an ``HVD_TPU_`` prefix (the launcher also accepts the classic
+``HOROVOD_`` spelling for drop-in compatibility).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+
+def _get(name: str, default: Optional[str] = None) -> Optional[str]:
+    """Look up ``HVD_TPU_<name>`` falling back to ``HOROVOD_<name>``."""
+    v = os.environ.get(f"HVD_TPU_{name}")
+    if v is None:
+        v = os.environ.get(f"HOROVOD_{name}")
+    return v if v is not None else default
+
+
+def _get_int(name: str, default: int) -> int:
+    v = _get(name)
+    try:
+        return int(v) if v is not None else default
+    except ValueError:
+        return default
+
+
+def _get_float(name: str, default: float) -> float:
+    v = _get(name)
+    try:
+        return float(v) if v is not None else default
+    except ValueError:
+        return default
+
+
+def _get_bool(name: str, default: bool) -> bool:
+    v = _get(name)
+    if v is None:
+        return default
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+@dataclasses.dataclass
+class Config:
+    """Runtime knobs, mirroring the reference's ~40 HOROVOD_* env vars
+    (SURVEY.md §5.6).  Only the knobs meaningful on TPU are kept; the rest
+    are accepted and ignored by the launcher for compatibility."""
+
+    # Tensor fusion (horovod/common/fusion_buffer_manager.cc):
+    fusion_threshold_bytes: int = 64 * 1024 * 1024  # HOROVOD_FUSION_THRESHOLD
+    # Background controller cycle (horovod/common/operations.cc RunLoopOnce):
+    cycle_time_ms: float = 1.0  # HOROVOD_CYCLE_TIME
+    # Response cache (horovod/common/response_cache.cc):
+    cache_capacity: int = 1024  # HOROVOD_CACHE_CAPACITY
+    # Timeline (horovod/common/timeline.cc):
+    timeline_filename: str = ""  # HOROVOD_TIMELINE
+    timeline_mark_cycles: bool = False  # HOROVOD_TIMELINE_MARK_CYCLES
+    # Stall inspector (horovod/common/stall_inspector.cc):
+    stall_check_disable: bool = False  # HOROVOD_STALL_CHECK_DISABLE
+    stall_warning_time_seconds: float = 60.0  # HOROVOD_STALL_CHECK_TIME_SECONDS
+    stall_shutdown_time_seconds: float = 0.0  # HOROVOD_STALL_SHUTDOWN_TIME_SECONDS
+    # Autotune (horovod/common/parameter_manager.cc):
+    autotune: bool = False  # HOROVOD_AUTOTUNE
+    autotune_log: str = ""  # HOROVOD_AUTOTUNE_LOG
+    # Hierarchical allreduce (nccl_operations.cc NCCLHierarchicalAllreduce):
+    hierarchical_allreduce: bool = False  # HOROVOD_HIERARCHICAL_ALLREDUCE
+    # Elastic:
+    elastic: bool = False  # HOROVOD_ELASTIC
+    # Logging:
+    log_level: str = "warning"  # HOROVOD_LOG_LEVEL
+    # TPU specific: dispatch collectives via XLA (the only backend; kept for
+    # BASELINE.json's HOROVOD_TPU_OPERATIONS=XLA contract).
+    tpu_operations: str = "XLA"
+
+    @staticmethod
+    def from_env() -> "Config":
+        return Config(
+            fusion_threshold_bytes=_get_int("FUSION_THRESHOLD", 64 * 1024 * 1024),
+            cycle_time_ms=_get_float("CYCLE_TIME", 1.0),
+            cache_capacity=_get_int("CACHE_CAPACITY", 1024),
+            timeline_filename=_get("TIMELINE", "") or "",
+            timeline_mark_cycles=_get_bool("TIMELINE_MARK_CYCLES", False),
+            stall_check_disable=_get_bool("STALL_CHECK_DISABLE", False),
+            stall_warning_time_seconds=_get_float("STALL_CHECK_TIME_SECONDS", 60.0),
+            stall_shutdown_time_seconds=_get_float("STALL_SHUTDOWN_TIME_SECONDS", 0.0),
+            autotune=_get_bool("AUTOTUNE", False),
+            autotune_log=_get("AUTOTUNE_LOG", "") or "",
+            hierarchical_allreduce=_get_bool("HIERARCHICAL_ALLREDUCE", False),
+            elastic=_get_bool("ELASTIC", False),
+            log_level=(_get("LOG_LEVEL", "warning") or "warning").lower(),
+            tpu_operations=(_get("TPU_OPERATIONS", "XLA") or "XLA").upper(),
+        )

@@ -1,0 +1,43 @@
+"""Logging setup.
+
+Reference parity: horovod/common/logging.cc (glog-style levels selected by
+HOROVOD_LOG_LEVEL) — here a thin shim over :mod:`logging` with the same
+level names, shared by the Python layer and surfaced to the native core.
+Env lookup goes through utils.env_parser so HVD_TPU_*/HOROVOD_* fallback
+and bool grammar stay consistent framework-wide.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+from .env_parser import _get, _get_bool
+
+_LEVELS = {
+    "trace": logging.DEBUG,  # python logging has no TRACE; map to DEBUG
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "fatal": logging.CRITICAL,
+}
+
+_LOGGER = logging.getLogger("horovod_tpu")
+_configured = False
+
+
+def get_logger() -> logging.Logger:
+    global _configured
+    if not _configured:
+        level_name = (_get("LOG_LEVEL", "warning") or "warning").lower()
+        handler = logging.StreamHandler(sys.stderr)
+        hide_time = _get_bool("LOG_HIDE_TIME", False)
+        fmt = "[%(levelname)s] hvd_tpu: %(message)s" if hide_time else \
+            "%(asctime)s [%(levelname)s] hvd_tpu: %(message)s"
+        handler.setFormatter(logging.Formatter(fmt))
+        _LOGGER.addHandler(handler)
+        _LOGGER.setLevel(_LEVELS.get(level_name, logging.WARNING))
+        _LOGGER.propagate = False
+        _configured = True
+    return _LOGGER

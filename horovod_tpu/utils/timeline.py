@@ -1,0 +1,102 @@
+"""Chrome-trace timeline writer.
+
+Reference parity: horovod/common/timeline.h/.cc (SURVEY.md §5.1) — a JSON
+``about:tracing`` file with one row per tensor and spans for each phase of
+its life.  The reference's phases are NEGOTIATE → QUEUE → MEMCPY_IN → COMM
+→ MEMCPY_OUT; under XLA negotiation and memcpys don't exist, so the emitted
+phases are ENQUEUE (python-side submit), COMPILE (executable-cache miss) and
+XLA_COMM (dispatch→ready).  File format is identical, so the same
+chrome://tracing / Perfetto workflow applies.
+
+This Python writer is the fallback; the native core's C++ writer thread
+(native/src/timeline.cc) takes over when loaded, matching the reference's
+dedicated writer thread design.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+
+class Timeline:
+    def __init__(self, filename: str, rank: int = 0):
+        self._filename = filename
+        self._rank = rank
+        self._lock = threading.Lock()
+        self._file = open(filename, "w")
+        self._file.write("[\n")
+        self._first = True
+        self._t0 = time.monotonic_ns()
+        self._closed = False
+        self._emit(
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": rank,
+                "args": {"name": f"hvd_tpu rank {rank}"},
+            }
+        )
+
+    def _now_us(self) -> float:
+        return (time.monotonic_ns() - self._t0) / 1e3
+
+    def _emit(self, event: dict) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            if not self._first:
+                self._file.write(",\n")
+            self._first = False
+            json.dump(event, self._file)
+
+    def start(self, tensor_name: str, activity: str) -> None:
+        """Reference: Timeline::ActivityStart."""
+        self._emit(
+            {
+                "name": activity,
+                "cat": "hvd_tpu",
+                "ph": "B",
+                "pid": self._rank,
+                "tid": hash(tensor_name) % (1 << 31),
+                "ts": self._now_us(),
+                "args": {"tensor": tensor_name},
+            }
+        )
+
+    def end(self, tensor_name: str, activity: str) -> None:
+        """Reference: Timeline::ActivityEnd."""
+        self._emit(
+            {
+                "name": activity,
+                "cat": "hvd_tpu",
+                "ph": "E",
+                "pid": self._rank,
+                "tid": hash(tensor_name) % (1 << 31),
+                "ts": self._now_us(),
+            }
+        )
+
+    def instant(self, name: str) -> None:
+        """Reference: Timeline::MarkCycleStart (HOROVOD_TIMELINE_MARK_CYCLES)."""
+        self._emit(
+            {
+                "name": name,
+                "cat": "hvd_tpu",
+                "ph": "i",
+                "s": "g",
+                "pid": self._rank,
+                "ts": self._now_us(),
+            }
+        )
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._file.write("\n]\n")
+            self._file.close()

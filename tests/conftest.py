@@ -1,0 +1,41 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Reference test-strategy parity (SURVEY.md §4): the reference simulates
+multi-node on one box via N processes + Gloo over loopback; the TPU-native
+equivalent is one process with N virtual CPU devices
+(``--xla_force_host_platform_device_count``) — per-rank semantics are then
+exercised through ``hvd.run_per_rank`` (shard_map), reproducing the
+``horovodrun -np N pytest`` per-rank pattern in-process.
+
+NOTE: the axon sitecustomize registers a TPU backend before we run, so
+setting JAX_PLATFORMS alone is not enough — we must also override the
+already-applied jax config (verified: config.update('jax_platforms','cpu')
+after registration yields the CPU backend).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _hvd_init():
+    import horovod_tpu as hvd
+
+    hvd.init()
+    assert hvd.size() == 8, (
+        f"expected 8 virtual CPU devices, got {hvd.size()} "
+        f"(backend={jax.default_backend()})"
+    )
+    yield

@@ -1,0 +1,85 @@
+"""Lifecycle + topology API tests.
+
+Reference analog: the rank/size assertions at the top of every
+test/parallel/test_torch.py case plus test/single/ launcher-free checks
+(SURVEY.md §4).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.common.topology import WORLD_AXIS
+
+
+def test_initialized():
+    assert hvd.is_initialized()
+    assert hvd.size() == 8
+    assert hvd.local_size() == 8
+    assert hvd.rank() == 0
+    assert hvd.cross_size() == 1
+    assert hvd.cross_rank() == 0
+    assert hvd.local_rank() == 0
+    assert hvd.is_homogeneous()
+
+
+def test_init_idempotent():
+    hvd.init()
+    assert hvd.size() == 8
+
+
+def test_build_probes():
+    assert hvd.xla_built()
+    assert not hvd.nccl_built()
+    assert not hvd.mpi_enabled()
+    assert not hvd.gloo_built()
+
+
+def test_world_mesh():
+    mesh = hvd.world_mesh()
+    assert mesh.axis_names == (WORLD_AXIS,)
+    assert mesh.devices.size == 8
+
+
+def test_hierarchical_mesh():
+    mesh = hvd.hierarchical_mesh(num_groups=2)
+    assert mesh.axis_names == (hvd.DCN_AXIS, hvd.ICI_AXIS)
+    assert mesh.devices.shape == (2, 4)
+    with pytest.raises(ValueError):
+        hvd.hierarchical_mesh(num_groups=3)
+
+
+def test_process_sets():
+    ps = hvd.add_process_set([0, 1, 2, 3])
+    try:
+        assert ps.process_set_id is not None and ps.process_set_id > 0
+        assert ps.size() == 4
+        assert ps.mesh.devices.size == 4
+        assert ps.included(2)
+        assert not ps.included(7)
+        assert ps.rank_in_set(3) == 3
+        assert ps.process_set_id in hvd.process_set_ids()
+        # duplicate ranks rejected
+        with pytest.raises(hvd.HorovodTpuError):
+            hvd.add_process_set([0, 1, 2, 3])
+    finally:
+        hvd.remove_process_set(ps)
+    assert ps.process_set_id is None
+    # cannot remove the world set
+    with pytest.raises(hvd.HorovodTpuError):
+        hvd.remove_process_set(hvd.global_process_set)
+
+
+def test_world_duplicate_process_set_rejected():
+    from horovod_tpu.common.process_sets import ProcessSet
+
+    with pytest.raises(hvd.HorovodTpuError):
+        hvd.add_process_set(ProcessSet())  # ranks=None == world == set 0
+
+
+def test_owns_rank():
+    topo = hvd.common.basics.topology()
+    assert topo.owns_rank(0) and topo.owns_rank(7)
+    with pytest.raises(ValueError):
+        topo.owns_rank(8)

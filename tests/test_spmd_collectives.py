@@ -1,0 +1,263 @@
+"""Per-rank collective parity tests over the virtual 8-chip mesh.
+
+This file reproduces the reference's test/parallel/test_torch.py matrix
+(every collective x dtype x shape, SURVEY.md §4) using ``hvd.run_per_rank``
+— the shard_map harness standing in for `horovodrun -np 8 pytest`.
+Assertions compare against locally computed references built from the
+deterministic per-rank tensors, the reference's no-golden-files technique.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+
+DTYPES = [jnp.float32, jnp.bfloat16, jnp.int32]
+SHAPES = [(4,), (2, 3), (2, 2, 2)]
+N = 8
+
+
+def per_rank_tensor(r, shape, dtype):
+    """Deterministic per-rank content, distinct across ranks."""
+    base = jnp.arange(int(np.prod(shape)), dtype=jnp.float32).reshape(shape)
+    return ((base + 1.0) * (r + 1)).astype(dtype)
+
+
+def host_stack(shape, dtype):
+    return np.stack(
+        [np.asarray(per_rank_tensor(jnp.asarray(i), shape, dtype),
+                    dtype=np.float32) for i in range(N)]
+    )
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+@pytest.mark.parametrize("shape", SHAPES)
+def test_allreduce_sum(shape, dtype):
+    out = hvd.run_per_rank(
+        lambda r: hvd.spmd.allreduce(
+            per_rank_tensor(r, shape, dtype), op=hvd.Sum
+        )
+    )
+    expected = host_stack(shape, dtype).sum(0)
+    for r in range(N):
+        np.testing.assert_allclose(
+            np.asarray(out[r], dtype=np.float32), expected, rtol=2e-2
+        )
+
+
+@pytest.mark.parametrize("shape", SHAPES)
+def test_allreduce_average(shape):
+    out = hvd.run_per_rank(
+        lambda r: hvd.spmd.allreduce(per_rank_tensor(r, shape, jnp.float32))
+    )
+    expected = host_stack(shape, jnp.float32).mean(0)
+    for r in range(N):
+        np.testing.assert_allclose(np.asarray(out[r]), expected, rtol=1e-5)
+
+
+def test_allreduce_min_max_product():
+    shape = (3, 2)
+    for op, red in [(hvd.Min, np.min), (hvd.Max, np.max),
+                    (hvd.Product, np.prod)]:
+        out = hvd.run_per_rank(
+            lambda r: hvd.spmd.allreduce(
+                per_rank_tensor(r, shape, jnp.float32), op=op
+            )
+        )
+        expected = red(host_stack(shape, jnp.float32), axis=0)
+        np.testing.assert_allclose(np.asarray(out[0]), expected, rtol=1e-4)
+
+
+def test_allreduce_prescale_postscale():
+    shape = (4,)
+    out = hvd.run_per_rank(
+        lambda r: hvd.spmd.allreduce(
+            per_rank_tensor(r, shape, jnp.float32),
+            op=hvd.Sum, prescale_factor=0.5, postscale_factor=2.0,
+        )
+    )
+    expected = host_stack(shape, jnp.float32).sum(0)  # 0.5 * 2 cancels
+    np.testing.assert_allclose(np.asarray(out[0]), expected, rtol=1e-5)
+
+
+def test_allreduce_pytree_fused():
+    def fn(r):
+        tree = {
+            "w": per_rank_tensor(r, (3,), jnp.float32),
+            "b": per_rank_tensor(r, (2, 2), jnp.float32),
+        }
+        return hvd.spmd.allreduce(tree, op=hvd.Sum)
+
+    out = hvd.run_per_rank(fn)
+    np.testing.assert_allclose(
+        np.asarray(out["w"][0]), host_stack((3,), jnp.float32).sum(0)
+    )
+    np.testing.assert_allclose(
+        np.asarray(out["b"][0]), host_stack((2, 2), jnp.float32).sum(0)
+    )
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+def test_allgather(dtype):
+    shape = (2, 3)
+    out = hvd.run_per_rank(
+        lambda r: hvd.spmd.allgather(per_rank_tensor(r, shape, dtype))
+    )
+    # horovod semantics: concat along dim0 -> (N*2, 3) on every rank
+    stacked = host_stack(shape, dtype).reshape(N * 2, 3)
+    for r in range(N):
+        np.testing.assert_allclose(
+            np.asarray(out[r], dtype=np.float32), stacked, rtol=1e-2
+        )
+
+
+@pytest.mark.parametrize("root", [0, 3, 7])
+def test_broadcast(root):
+    shape = (2, 2)
+    out = hvd.run_per_rank(
+        lambda r: hvd.spmd.broadcast(
+            per_rank_tensor(r, shape, jnp.float32), root_rank=root
+        )
+    )
+    expected = host_stack(shape, jnp.float32)[root]
+    for r in range(N):
+        np.testing.assert_allclose(np.asarray(out[r]), expected)
+
+
+def test_broadcast_bool():
+    out = hvd.run_per_rank(
+        lambda r: hvd.spmd.broadcast(r % 2 == 0, root_rank=3)
+    )
+    assert not bool(np.asarray(out[0]))  # rank 3: 3 % 2 != 0
+
+
+def test_alltoall():
+    # rank r sends value r*10+dst to each dst; after alltoall rank d holds
+    # [src*10+d for src in ranks]
+    def fn(r):
+        send = r * 10 + jnp.arange(N, dtype=jnp.int32)
+        return hvd.spmd.alltoall(send)
+
+    out = hvd.run_per_rank(fn)
+    for d in range(N):
+        expected = np.arange(N) * 10 + d
+        np.testing.assert_array_equal(np.asarray(out[d]), expected)
+
+
+def test_alltoall_multi_chunk():
+    # two rows per destination
+    def fn(r):
+        send = jnp.stack([
+            jnp.full((2,), r * 100 + d, dtype=jnp.int32)
+            for d in range(N) for _ in (0,)
+        ]).reshape(N, 2) if False else (
+            (r * 100 + jnp.repeat(jnp.arange(N, dtype=jnp.int32), 2))[:, None]
+            * jnp.ones((1, 3), jnp.int32)
+        )
+        return hvd.spmd.alltoall(send)
+
+    out = hvd.run_per_rank(fn)
+    for d in range(N):
+        col = np.asarray(out[d])[:, 0]
+        expected = np.repeat(np.arange(N) * 100 + d, 2)
+        np.testing.assert_array_equal(col, expected)
+
+
+def test_reducescatter():
+    shape = (N * 2, 3)
+
+    def fn(r):
+        return hvd.spmd.reducescatter(
+            per_rank_tensor(r, shape, jnp.float32), op=hvd.Sum
+        )
+
+    out = hvd.run_per_rank(fn)
+    total = host_stack(shape, jnp.float32).sum(0)  # (16, 3)
+    for r in range(N):
+        np.testing.assert_allclose(
+            np.asarray(out[r]), total[r * 2:(r + 1) * 2], rtol=1e-5
+        )
+
+
+def test_reducescatter_average():
+    shape = (N, 2)
+
+    def fn(r):
+        return hvd.spmd.reducescatter(
+            per_rank_tensor(r, shape, jnp.float32), op=hvd.Average
+        )
+
+    out = hvd.run_per_rank(fn)
+    mean = host_stack(shape, jnp.float32).mean(0)
+    for r in range(N):
+        np.testing.assert_allclose(
+            np.asarray(out[r]), mean[r:r + 1], rtol=1e-5
+        )
+
+
+def test_rank_and_size():
+    out = hvd.run_per_rank(
+        lambda r: (hvd.spmd.rank(), jnp.asarray(hvd.spmd.size()))
+    )
+    np.testing.assert_array_equal(np.asarray(out[0]), np.arange(N))
+    assert int(np.asarray(out[1])[0]) == N
+
+
+def test_adasum_two_rank_identity():
+    # With two orthogonal gradients adasum == sum; with identical gradients
+    # adasum == the gradient itself (scale invariance). Check on a 2-rank
+    # process set... the world is 8 ranks, so check the identical case:
+    # all ranks send the same vector -> result equals that vector.
+    def fn(r):
+        v = jnp.asarray([1.0, 2.0, 3.0, 4.0])
+        return hvd.spmd.allreduce(v, op=hvd.Adasum)
+
+    out = hvd.run_per_rank(fn)
+    np.testing.assert_allclose(
+        np.asarray(out[0]), [1.0, 2.0, 3.0, 4.0], rtol=1e-5
+    )
+
+
+def test_adasum_orthogonal_sums():
+    # rank r contributes a one-hot basis vector e_r: all contributions are
+    # mutually orthogonal, so adasum degenerates to a plain sum.
+    def fn(r):
+        return hvd.spmd.allreduce(
+            jax.nn.one_hot(r, N, dtype=jnp.float32), op=hvd.Adasum
+        )
+
+    out = hvd.run_per_rank(fn)
+    np.testing.assert_allclose(np.asarray(out[0]), np.ones(N), rtol=1e-5)
+
+
+def test_barrier_traces():
+    out = hvd.run_per_rank(
+        lambda r: (hvd.spmd.barrier(), jnp.asarray(1))[1]
+    )
+    assert np.asarray(out).sum() == N
+
+
+def test_process_set_submesh_collective():
+    ps = hvd.add_process_set([0, 1, 2, 3])
+    try:
+        out = hvd.run_per_rank(
+            lambda r: hvd.spmd.allreduce(
+                jnp.asarray([1.0]), op=hvd.Sum
+            ),
+            process_set=ps,
+        )
+        assert out.shape[0] == 4
+        np.testing.assert_allclose(np.asarray(out[0]), [4.0])
+    finally:
+        hvd.remove_process_set(ps)
+
+
+def test_spmd_prescale_rejected_for_min():
+    with pytest.raises(ValueError):
+        hvd.run_per_rank(
+            lambda r: hvd.spmd.allreduce(
+                jnp.ones(2), op=hvd.Min, prescale_factor=2.0
+            )
+        )
