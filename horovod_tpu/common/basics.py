@@ -101,8 +101,11 @@ def init(devices: Optional[Sequence] = None) -> None:
         from ..native import load_controller  # deferred: optional native core
 
         _state.controller = load_controller(_state.topology, _state.config)
-
-        if _state.config.timeline_filename:
+        if _state.controller.is_native:
+            _state.controller.set_engine(_state.engine)
+        elif _state.config.timeline_filename:
+            # python fallback timeline; the native core owns the file when
+            # loaded (its C++ writer thread, reference-style)
             from ..utils.timeline import Timeline
 
             _state.timeline = Timeline(

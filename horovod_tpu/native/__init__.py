@@ -50,12 +50,41 @@ def _lib_path() -> str:
     return os.path.join(os.path.dirname(__file__), _LIB_NAME)
 
 
+_build_attempted = False
+
+
+def _maybe_build() -> None:
+    """Lazy build: compile the core on first use when a toolchain exists
+    (reference analog: setup.py's build_ext compiling the CMake tree —
+    §2.5; here a plain Makefile, no third-party deps)."""
+    global _build_attempted
+    if _build_attempted or os.path.exists(_lib_path()):
+        return
+    _build_attempted = True
+    import shutil
+    import subprocess
+
+    if shutil.which("make") is None or shutil.which("g++") is None:
+        return
+    src = os.path.join(os.path.dirname(__file__), "src")
+    try:
+        subprocess.run(
+            ["make"], cwd=src, check=True, capture_output=True, timeout=120
+        )
+        get_logger().info("built native core at %s", _lib_path())
+    except (subprocess.SubprocessError, OSError) as e:
+        get_logger().warning("native core build failed (%s)", e)
+
+
 def load_controller(topology: Topology, config: Config):
     """Load the native controller, falling back to Python.
 
     Reference: horovod/common/basics.py __init__ (extension dlopen) +
     horovod_init (operations.cc).
     """
+    if os.environ.get("HVD_TPU_DISABLE_NATIVE", "0") in ("1", "true"):
+        return PyFallbackController(topology, config)
+    _maybe_build()
     path = _lib_path()
     if os.path.exists(path):
         try:

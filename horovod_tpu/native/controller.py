@@ -1,0 +1,332 @@
+"""ctypes binding to the native background controller.
+
+Reference parity: horovod/torch/mpi_ops_v2.cc + handle_manager (SURVEY.md
+§2.3) — the glue between the Python op layer and the C++ core.  The
+reference builds a pybind11 module per framework; this image has no
+pybind11, so the binding is ctypes over the flat C API (c_api.cc), which
+is also closer to the reference's own `horovod/common/basics.py` ctypes
+pattern for the C API.
+
+Flow (the §3.2 hot path, TPU edition):
+  Python enqueue -> C++ TensorQueue -> background thread negotiates ->
+  fused Response -> exec callback (this module, on the C++ thread) ->
+  CollectiveEngine launches the cached compiled XLA collective ->
+  per-entry futures resolve -> Handle.wait() returns.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.exceptions import HorovodInternalError
+from ..common.topology import Topology
+from ..utils.env_parser import Config
+from ..utils.logging import get_logger
+
+# Enum values must match native/src/common.h.
+OP_ALLREDUCE, OP_ALLGATHER, OP_BROADCAST, OP_ALLTOALL, OP_REDUCESCATTER, \
+    OP_BARRIER, OP_JOIN = range(7)
+
+_DTYPES = [
+    ("uint8", 0), ("int8", 1), ("int32", 2), ("int64", 3),
+    ("float16", 4), ("bfloat16", 5), ("float32", 6), ("float64", 7),
+    ("bool", 8), ("uint16", 9), ("uint32", 10), ("uint64", 11),
+    ("int16", 12), ("complex64", 13), ("complex128", 14),
+]
+_DTYPE_TO_ENUM = {name: val for name, val in _DTYPES}
+_ENUM_TO_DTYPE = {val: name for name, val in _DTYPES}
+
+_EXEC_CB = ctypes.CFUNCTYPE(
+    None, ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+    ctypes.c_int, ctypes.c_double, ctypes.c_double,
+    ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_char_p,
+)
+
+
+class Future:
+    """Reference analog: the handle slots of torch/handle_manager.h."""
+
+    __slots__ = ("_event", "_result", "_error")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._result = None
+        self._error: Optional[BaseException] = None
+
+    def set_result(self, value) -> None:
+        self._result = value
+        self._event.set()
+
+    def set_error(self, err: BaseException) -> None:
+        self._error = err
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("collective did not complete in time")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class _Entry:
+    __slots__ = ("payload", "future", "op", "extra")
+
+    def __init__(self, payload, future, op, extra=None):
+        self.payload = payload
+        self.future = future
+        self.op = op
+        self.extra = extra
+
+
+class NativeController:
+    is_native = True
+
+    def __init__(self, lib_path: str, topology: Topology, config: Config):
+        self._topology = topology
+        self._config = config
+        self._engine = None  # set via set_engine after engine construction
+        self._entries: Dict[int, _Entry] = {}
+        self._entries_lock = threading.Lock()
+        self._name_counter = 0
+        self._lib = ctypes.CDLL(lib_path)
+        self._declare(self._lib)
+        # the callback object must outlive the native thread: keep the ref
+        self._cb = _EXEC_CB(self._on_exec)
+        self._lib.hvdtpu_set_exec_callback(self._cb, None)
+        rc = self._lib.hvdtpu_init(
+            topology.rank,
+            max(topology.num_processes, 1),
+            ctypes.c_double(config.cycle_time_ms),
+            ctypes.c_longlong(config.fusion_threshold_bytes),
+            config.cache_capacity,
+            config.timeline_filename.encode(),
+            ctypes.c_double(
+                0.0 if config.stall_check_disable
+                else config.stall_warning_time_seconds
+            ),
+            ctypes.c_double(config.stall_shutdown_time_seconds),
+            1 if config.autotune else 0,
+            config.autotune_log.encode(),
+        )
+        if rc != 0:
+            raise OSError(f"hvdtpu_init failed with {rc}")
+
+    @staticmethod
+    def _declare(lib) -> None:
+        lib.hvdtpu_init.restype = ctypes.c_int
+        lib.hvdtpu_init.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_double, ctypes.c_longlong,
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_double, ctypes.c_double,
+            ctypes.c_int, ctypes.c_char_p,
+        ]
+        lib.hvdtpu_set_exec_callback.restype = None
+        lib.hvdtpu_set_exec_callback.argtypes = [_EXEC_CB, ctypes.c_void_p]
+        lib.hvdtpu_enqueue.restype = ctypes.c_longlong
+        lib.hvdtpu_enqueue.argtypes = [
+            ctypes.c_longlong, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_double, ctypes.c_double,
+        ]
+        lib.hvdtpu_register_group.restype = ctypes.c_int
+        lib.hvdtpu_register_group.argtypes = [ctypes.c_int]
+        lib.hvdtpu_shutdown.restype = None
+        lib.hvdtpu_initialized.restype = ctypes.c_int
+        lib.hvdtpu_cache_hits.restype = ctypes.c_longlong
+        lib.hvdtpu_cache_misses.restype = ctypes.c_longlong
+        lib.hvdtpu_fusion_threshold.restype = ctypes.c_longlong
+        lib.hvdtpu_cycle_time_ms.restype = ctypes.c_double
+        lib.hvdtpu_pending_count.restype = ctypes.c_int
+        lib.hvdtpu_timeline_activity.restype = None
+        lib.hvdtpu_timeline_activity.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+        ]
+
+    # -- wiring -------------------------------------------------------------
+
+    def set_engine(self, engine) -> None:
+        self._engine = engine
+
+    def shutdown(self) -> None:
+        self._lib.hvdtpu_shutdown()
+
+    # -- stats (reference: horovod_* C getters) -----------------------------
+
+    def cache_hits(self) -> int:
+        return int(self._lib.hvdtpu_cache_hits())
+
+    def cache_misses(self) -> int:
+        return int(self._lib.hvdtpu_cache_misses())
+
+    def fusion_threshold(self) -> int:
+        return int(self._lib.hvdtpu_fusion_threshold())
+
+    def cycle_time_ms(self) -> float:
+        return float(self._lib.hvdtpu_cycle_time_ms())
+
+    def pending_count(self) -> int:
+        return int(self._lib.hvdtpu_pending_count())
+
+    def register_group(self, size: int) -> int:
+        return int(self._lib.hvdtpu_register_group(size))
+
+    def timeline_activity(self, tensor: str, activity: str,
+                          begin: bool) -> None:
+        self._lib.hvdtpu_timeline_activity(
+            tensor.encode(), activity.encode(), 1 if begin else 0
+        )
+
+    # -- submission ---------------------------------------------------------
+
+    def enqueue(
+        self,
+        array: jax.Array,
+        op_type: int,
+        reduce_op: int = 0,
+        name: Optional[str] = None,
+        process_set_id: int = 0,
+        group_id: int = -1,
+        root_rank: int = 0,
+        prescale: float = 1.0,
+        postscale: float = 1.0,
+        extra: Any = None,
+    ) -> Future:
+        """Submit one tensor; returns a Future resolved by the background
+        thread (reference: EnqueueTensorAllreduce in operations.cc)."""
+        with self._entries_lock:
+            self._name_counter += 1
+            counter = self._name_counter
+        if name is None:
+            name = f"op{op_type}.auto.{counter}"
+        arr = jnp.asarray(array)
+        dtype_enum = _DTYPE_TO_ENUM.get(str(arr.dtype))
+        if dtype_enum is None:
+            raise TypeError(
+                f"dtype {arr.dtype} is not supported on the native "
+                "collective path"
+            )
+        shape = (ctypes.c_longlong * max(arr.ndim, 1))(*(
+            list(arr.shape) or [0]
+        ))
+        fut = Future()
+        # Register the future under a caller-assigned id BEFORE the entry
+        # becomes visible to the background thread — the 1 ms cycle can
+        # execute the entry before control returns from the ctypes call.
+        entry_id = counter
+        with self._entries_lock:
+            self._entries[entry_id] = _Entry(arr, fut, op_type, extra)
+        # reduce_op rides in the root_rank field for allreduce (the C core
+        # treats both as opaque fuse keys); keep them separate fields here.
+        rc = self._lib.hvdtpu_enqueue(
+            ctypes.c_longlong(entry_id), name.encode(), op_type, dtype_enum,
+            shape, arr.ndim, process_set_id, group_id,
+            root_rank if op_type == OP_BROADCAST else int(reduce_op),
+            prescale, postscale,
+        )
+        if rc < 0:
+            with self._entries_lock:
+                self._entries.pop(entry_id, None)
+            if rc == -1:
+                raise ValueError(
+                    f"a collective named {name!r} is already pending "
+                    "(reference: duplicate-name check in TensorQueue)"
+                )
+            raise HorovodInternalError("native controller not initialized")
+        return fut
+
+    # -- executor callback (runs on the C++ background thread) --------------
+
+    def _on_exec(self, _user, op, dtype, process_set, root_or_rop, prescale,
+                 postscale, ids_ptr, n_ids, error):
+        entries: List[_Entry] = []
+        try:
+            ids = [int(ids_ptr[i]) for i in range(n_ids)]
+            with self._entries_lock:
+                entries = [
+                    self._entries.pop(i) for i in ids
+                    if i != -1 and i in self._entries
+                ]
+            if not entries:
+                return
+            if error:
+                err = HorovodInternalError(error.decode())
+                for e in entries:
+                    e.future.set_error(err)
+                return
+            self._execute(op, process_set, root_or_rop, prescale, postscale,
+                          entries)
+        except BaseException as exc:  # never let exceptions cross into C++
+            get_logger().error("native exec callback failed: %s", exc)
+            try:
+                for e in entries:
+                    e.future.set_error(exc)
+            except Exception:
+                pass
+
+    def _execute(self, op, process_set, root_or_rop, prescale, postscale,
+                 entries: List[_Entry]) -> None:
+        from ..common import basics as _basics
+        from ..ops.reduce_ops import ReduceOp
+
+        eng = self._engine
+        # resolve the response's process set so the engine applies its own
+        # scoping rules (world = None fast path)
+        ps = (
+            None if process_set == 0
+            else _basics._require_init().process_set_registry.get(process_set)
+        )
+        if op == OP_ALLREDUCE:
+            # fused execution: one flat buffer, one collective (the native
+            # fusion decision made by the controller)
+            arrays = [e.payload for e in entries]
+            sizes = [a.size for a in arrays]
+            shapes = [a.shape for a in arrays]
+            buf = (
+                jnp.concatenate([jnp.ravel(a) for a in arrays])
+                if len(arrays) > 1 else jnp.ravel(arrays[0])
+            )
+            out = eng.allreduce(
+                buf, ReduceOp(root_or_rop), prescale, postscale, ps
+            )
+            offset = 0
+            for e, sz, shp in zip(entries, sizes, shapes):
+                e.future.set_result(
+                    jax.lax.dynamic_slice_in_dim(out, offset, sz)
+                    .reshape(shp)
+                )
+                offset += sz
+        elif op == OP_ALLGATHER:
+            for e in entries:
+                e.future.set_result(eng.allgather(e.payload, ps))
+        elif op == OP_BROADCAST:
+            for e in entries:
+                e.future.set_result(
+                    eng.broadcast(e.payload, root_or_rop, ps)
+                )
+        elif op == OP_ALLTOALL:
+            for e in entries:
+                e.future.set_result(
+                    eng.alltoall(e.payload, e.extra, ps)
+                )
+        elif op == OP_REDUCESCATTER:
+            for e in entries:
+                e.future.set_result(
+                    eng.reducescatter(e.payload, ReduceOp(root_or_rop), ps)
+                )
+        elif op == OP_BARRIER:
+            for e in entries:
+                eng.barrier(ps)
+                e.future.set_result(None)
+        else:
+            err = HorovodInternalError(f"unknown native op {op}")
+            for e in entries:
+                e.future.set_error(err)

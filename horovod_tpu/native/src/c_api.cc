@@ -1,0 +1,257 @@
+// Flat C API + background thread loop.
+//
+// Reference parity: horovod/common/operations.h/.cc (SURVEY.md §2.1
+// "Background loop & C API"): InitializeHorovodOnce spawns the background
+// thread, RunLoopOnce drives one coordination cycle, Enqueue* feeds the
+// TensorQueue, and the flat C surface (horovod_init / horovod_rank / ...)
+// is what the Python shim dlopens.  Consumed from Python via ctypes
+// (native/controller.py), the pybind11-free binding path.
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <cstring>
+#include <memory>
+#include <mutex>
+#include <set>
+#include <string>
+#include <thread>
+
+#include "common.h"
+#include "controller.h"
+#include "group_table.h"
+#include "parameter_manager.h"
+#include "response_cache.h"
+#include "stall_inspector.h"
+#include "tensor_queue.h"
+#include "timeline.h"
+#include "transport.h"
+
+namespace hvdtpu {
+namespace {
+
+// Executor callback into Python: one call per fused Response.
+// ids[i] == -1 when this rank holds no entry for names[i] (join fill).
+typedef void (*ExecCallback)(void* user, int op, int dtype, int process_set,
+                             int root_rank, double prescale, double postscale,
+                             const int64_t* ids, int n_ids,
+                             const char* error);
+
+struct GlobalState {
+  // Reference analog: horovod/common/global_state.h HorovodGlobalState.
+  std::unique_ptr<TensorQueue> queue;
+  std::unique_ptr<GroupTable> groups;
+  std::unique_ptr<ResponseCache> cache;
+  std::unique_ptr<StallInspector> stall;
+  std::unique_ptr<Timeline> timeline;
+  std::unique_ptr<ParameterManager> params;
+  std::unique_ptr<Controller> controller;
+  std::thread background;
+  std::atomic<bool> shutdown{false};
+  std::atomic<bool> initialized{false};
+  std::atomic<int64_t> next_id{1};
+  ExecCallback exec_cb = nullptr;
+  void* exec_user = nullptr;
+  std::mutex init_mu;
+  // Names claimed from enqueue until their response executed (reference:
+  // the tensor-table duplicate check spans the whole entry lifetime, not
+  // just the queue window).
+  std::mutex names_mu;
+  std::set<std::string> active_names;
+};
+
+GlobalState* g() {
+  static GlobalState state;
+  return &state;
+}
+
+void BackgroundThreadLoop() {
+  // Reference: BackgroundThreadLoop in operations.cc — cycle, then sleep
+  // the (possibly autotuned) cycle time.
+  auto* s = g();
+  while (!s->shutdown.load()) {
+    if (!s->controller->RunLoopOnce()) break;
+    auto ms = s->params->cycle_time_ms();
+    std::this_thread::sleep_for(
+        std::chrono::duration<double, std::milli>(ms));
+  }
+}
+
+void DefaultLog(int level, const std::string& msg) {
+  std::fprintf(stderr, "[%s] hvd_tpu_core: %s\n",
+               level >= 2 ? "ERROR" : "WARNING", msg.c_str());
+}
+
+}  // namespace
+}  // namespace hvdtpu
+
+extern "C" {
+
+using hvdtpu::DataType;
+using hvdtpu::OpType;
+using hvdtpu::Response;
+using hvdtpu::TensorTableEntry;
+
+int hvdtpu_init(int rank, int size, double cycle_time_ms,
+                long long fusion_threshold, int cache_capacity,
+                const char* timeline_path, double stall_warn_sec,
+                double stall_shutdown_sec, int autotune,
+                const char* autotune_log) {
+  auto* s = hvdtpu::g();
+  std::lock_guard<std::mutex> lk(s->init_mu);
+  if (s->initialized.load()) return 0;
+  (void)rank;
+  (void)size;
+  s->queue = std::make_unique<hvdtpu::TensorQueue>();
+  s->groups = std::make_unique<hvdtpu::GroupTable>();
+  s->cache = std::make_unique<hvdtpu::ResponseCache>(
+      cache_capacity > 0 ? cache_capacity : 1024);
+  s->stall = std::make_unique<hvdtpu::StallInspector>(stall_warn_sec,
+                                                      stall_shutdown_sec);
+  if (timeline_path && timeline_path[0])
+    s->timeline = std::make_unique<hvdtpu::Timeline>(timeline_path, rank);
+  s->params = std::make_unique<hvdtpu::ParameterManager>(
+      fusion_threshold, cycle_time_ms,
+      autotune_log ? autotune_log : "");
+  if (autotune) s->params->EnableTuning();
+
+  auto executor = [s](const Response& resp,
+                      const std::vector<int64_t>& ids) {
+    {
+      // release names before the callback resolves futures: a caller that
+      // wakes from wait() may immediately resubmit the same name.
+      // key matches enqueue: (name, process_set) — same-named tensors on
+      // different process sets are distinct entries (reference semantics)
+      std::lock_guard<std::mutex> lk(s->names_mu);
+      for (const auto& n : resp.names)
+        s->active_names.erase(n + "\x1f" +
+                              std::to_string(resp.process_set_id));
+    }
+    if (s->exec_cb)
+      s->exec_cb(s->exec_user, static_cast<int>(resp.op),
+                 static_cast<int>(resp.dtype), resp.process_set_id,
+                 resp.root_rank, resp.prescale, resp.postscale, ids.data(),
+                 static_cast<int>(ids.size()),
+                 resp.error.empty() ? nullptr : resp.error.c_str());
+  };
+  // Single-process loopback transport; the TCP star transport is wired in
+  // by hvdtpu_init_tcp (launcher-driven multi-process worlds).
+  s->controller = std::make_unique<hvdtpu::Controller>(
+      std::make_unique<hvdtpu::LoopbackTransport>(), s->queue.get(),
+      s->groups.get(), s->cache.get(), s->stall.get(), s->timeline.get(),
+      s->params.get(), executor, hvdtpu::DefaultLog);
+  s->shutdown.store(false);
+  s->background = std::thread(hvdtpu::BackgroundThreadLoop);
+  s->initialized.store(true);
+  return 0;
+}
+
+void hvdtpu_set_exec_callback(void (*cb)(void*, int, int, int, int, double,
+                                         double, const int64_t*, int,
+                                         const char*),
+                              void* user) {
+  hvdtpu::g()->exec_cb = cb;
+  hvdtpu::g()->exec_user = user;
+}
+
+long long hvdtpu_enqueue(long long entry_id, const char* name, int op,
+                         int dtype, const long long* shape, int ndim,
+                         int process_set, int group_id, int root_rank,
+                         double prescale, double postscale) {
+  // entry_id is caller-assigned so the Python side can register its future
+  // BEFORE the entry becomes visible to the background thread — otherwise
+  // a fast cycle could execute and drop the id between the enqueue call
+  // returning and the future registration (wait() would hang forever).
+  auto* s = hvdtpu::g();
+  if (!s->initialized.load()) return -2;
+  {
+    std::lock_guard<std::mutex> lk(s->names_mu);
+    if (!s->active_names
+             .insert(std::string(name) + "\x1f" +
+                     std::to_string(process_set))
+             .second)
+      return -1;  // duplicate
+  }
+  TensorTableEntry e;
+  e.id = entry_id > 0 ? entry_id : s->next_id.fetch_add(1);
+  e.name = name;
+  e.op = static_cast<OpType>(op);
+  e.dtype = static_cast<DataType>(dtype);
+  e.shape.assign(shape, shape + ndim);
+  e.process_set_id = process_set;
+  e.group_id = group_id;
+  e.root_rank = root_rank;
+  e.prescale = prescale;
+  e.postscale = postscale;
+  e.enqueued_at = hvdtpu::Clock::now();
+  int64_t id = e.id;
+  if (!s->queue->Add(std::move(e))) return -1;  // duplicate name pending
+  return id;
+}
+
+int hvdtpu_register_group(int group_size) {
+  return hvdtpu::g()->groups->RegisterGroup(group_size);
+}
+
+void hvdtpu_shutdown() {
+  auto* s = hvdtpu::g();
+  std::lock_guard<std::mutex> lk(s->init_mu);
+  if (!s->initialized.load()) return;
+  s->shutdown.store(true);
+  if (s->background.joinable()) s->background.join();
+  if (s->timeline) s->timeline->Close();
+  s->controller.reset();
+  s->timeline.reset();
+  s->params.reset();
+  s->stall.reset();
+  s->cache.reset();
+  s->groups.reset();
+  s->queue.reset();
+  s->exec_cb = nullptr;
+  {
+    std::lock_guard<std::mutex> nlk(s->names_mu);
+    s->active_names.clear();
+  }
+  s->initialized.store(false);
+}
+
+int hvdtpu_initialized() { return hvdtpu::g()->initialized.load() ? 1 : 0; }
+
+long long hvdtpu_cache_hits() {
+  auto* s = hvdtpu::g();
+  return s->initialized.load() ? s->cache->hits() : 0;
+}
+
+long long hvdtpu_cache_misses() {
+  auto* s = hvdtpu::g();
+  return s->initialized.load() ? s->cache->misses() : 0;
+}
+
+long long hvdtpu_fusion_threshold() {
+  auto* s = hvdtpu::g();
+  return s->initialized.load() ? s->params->fusion_threshold() : -1;
+}
+
+double hvdtpu_cycle_time_ms() {
+  auto* s = hvdtpu::g();
+  return s->initialized.load() ? s->params->cycle_time_ms() : -1.0;
+}
+
+int hvdtpu_pending_count() {
+  auto* s = hvdtpu::g();
+  return s->initialized.load()
+             ? static_cast<int>(s->stall->PendingCount())
+             : 0;
+}
+
+void hvdtpu_timeline_activity(const char* tensor, const char* activity,
+                              int begin) {
+  auto* s = hvdtpu::g();
+  if (!s->initialized.load() || !s->timeline || !s->timeline->active())
+    return;
+  if (begin)
+    s->timeline->ActivityStart(tensor, activity);
+  else
+    s->timeline->ActivityEnd(tensor, activity);
+}
+
+}  // extern "C"

@@ -1,0 +1,115 @@
+// Core types shared across the native controller.
+//
+// Reference parity: horovod/common/common.h (TensorTableEntry, DataType,
+// framework-agnostic core types — SURVEY.md §2.1).  TPU-native difference:
+// entries carry no device pointers — tensor payloads stay on the Python/XLA
+// side and the native core coordinates *metadata only*, invoking a
+// registered executor callback that launches the compiled XLA collective.
+// That split (C++ control plane / XLA data plane) is the §5.8 backend
+// mapping.
+#pragma once
+
+#include <chrono>
+#include <cstdint>
+#include <functional>
+#include <string>
+#include <vector>
+
+namespace hvdtpu {
+
+// Matches horovod/common/message.h RequestType (subset meaningful on TPU).
+enum class OpType : int32_t {
+  ALLREDUCE = 0,
+  ALLGATHER = 1,
+  BROADCAST = 2,
+  ALLTOALL = 3,
+  REDUCESCATTER = 4,
+  BARRIER = 5,
+  JOIN = 6,
+};
+
+// Matches horovod/common/common.h DataType ordering loosely; values are
+// stable across the ctypes boundary.
+enum class DataType : int32_t {
+  UINT8 = 0,
+  INT8 = 1,
+  INT32 = 2,
+  INT64 = 3,
+  FLOAT16 = 4,
+  BFLOAT16 = 5,
+  FLOAT32 = 6,
+  FLOAT64 = 7,
+  BOOL = 8,
+  UINT16 = 9,
+  UINT32 = 10,
+  UINT64 = 11,
+  INT16 = 12,
+  COMPLEX64 = 13,
+  COMPLEX128 = 14,
+};
+
+inline int64_t DataTypeSize(DataType t) {
+  switch (t) {
+    case DataType::UINT8:
+    case DataType::INT8:
+    case DataType::BOOL:
+      return 1;
+    case DataType::FLOAT16:
+    case DataType::BFLOAT16:
+    case DataType::UINT16:
+    case DataType::INT16:
+      return 2;
+    case DataType::INT32:
+    case DataType::FLOAT32:
+    case DataType::UINT32:
+      return 4;
+    case DataType::COMPLEX128:
+      return 16;
+    default:
+      return 8;
+  }
+}
+
+using Clock = std::chrono::steady_clock;
+
+// One pending collective submission (reference: TensorTableEntry in
+// common.h, minus the tensor/output/event members — metadata only).
+struct TensorTableEntry {
+  int64_t id = 0;           // handle assigned at enqueue
+  std::string name;         // dedup key during negotiation
+  OpType op = OpType::ALLREDUCE;
+  DataType dtype = DataType::FLOAT32;
+  std::vector<int64_t> shape;
+  int32_t process_set_id = 0;
+  int32_t group_id = -1;    // -1: ungrouped (GroupTable parity)
+  int32_t root_rank = 0;    // broadcast only
+  double prescale = 1.0;
+  double postscale = 1.0;
+  Clock::time_point enqueued_at;
+
+  int64_t NumBytes() const {
+    int64_t n = DataTypeSize(dtype);
+    for (auto d : shape) n *= d;
+    return n;
+  }
+};
+
+// A fused execution order: entries to run as ONE collective launch
+// (reference: Response in message.h — tensor_names fused up to the
+// threshold).  Carries names + shapes because responses travel across
+// ranks: each rank maps names back to its local entry ids, and a rank
+// that joined early (JOIN semantics) synthesizes zero contributions from
+// the shapes.
+struct Response {
+  OpType op = OpType::ALLREDUCE;
+  DataType dtype = DataType::FLOAT32;
+  int32_t process_set_id = 0;
+  int32_t root_rank = 0;
+  double prescale = 1.0;
+  double postscale = 1.0;
+  std::vector<std::string> names;
+  std::vector<std::vector<int64_t>> shapes;
+  std::string error;  // non-empty: fail these entries
+};
+
+}  // namespace hvdtpu

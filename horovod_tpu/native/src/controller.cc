@@ -1,0 +1,170 @@
+#include "controller.h"
+
+#include <algorithm>
+
+#include "message.h"
+
+namespace hvdtpu {
+
+bool Controller::RunLoopOnce() {
+  // 1. drain newly submitted entries (reference: PopMessagesFromQueue)
+  auto newly = queue_->PopAll();
+  for (auto& e : newly) {
+    if (timeline_ && timeline_->active())
+      timeline_->ActivityStart(e.name, "QUEUE");
+    stall_->RecordPending(e);
+    cache_->Lookup(e);  // warm the signature cache (stats + LRU order)
+    pending_.emplace(e.name, e);
+  }
+
+  // 2. report to the coordinator (reference: SendReadyTensors)
+  auto gathered = transport_->GatherRequests(wire::EncodeEntryList(newly));
+
+  // 3. coordinator: account reports, build fused responses
+  std::string payload;
+  if (rank() == 0) {
+    for (int32_t r = 0; r < static_cast<int32_t>(gathered.size()); ++r) {
+      std::vector<TensorTableEntry> reqs;
+      if (!wire::DecodeEntryList(gathered[r], &reqs)) continue;
+      for (auto& e : reqs) {
+        auto it = coord_table_.find(e.name);
+        if (it == coord_table_.end()) {
+          it = coord_table_
+                   .emplace(e.name, PendingCoord{e, {}, order_counter_++})
+                   .first;
+        }
+        it->second.reported.insert(r);
+      }
+    }
+    payload = wire::EncodeResponseList(BuildResponses());
+  }
+
+  // 4. broadcast the response list (reference: SendFinalTensors)
+  payload = transport_->BcastResponseList(payload);
+  std::vector<Response> responses;
+  wire::DecodeResponseList(payload, &responses);
+
+  // 5. execute: map names to local ids, invoke the XLA executor callback
+  int64_t cycle_bytes = 0;
+  for (const auto& resp : responses) {
+    std::vector<int64_t> local_ids;
+    local_ids.reserve(resp.names.size());
+    for (size_t i = 0; i < resp.names.size(); ++i) {
+      auto it = pending_.find(resp.names[i]);
+      if (it == pending_.end()) {
+        local_ids.push_back(-1);  // joined rank: zero contribution
+      } else {
+        local_ids.push_back(it->second.id);
+        cycle_bytes += it->second.NumBytes();
+        if (timeline_ && timeline_->active()) {
+          timeline_->ActivityEnd(resp.names[i], "QUEUE");
+          timeline_->ActivityStart(resp.names[i], "XLA_COMM");
+        }
+        pending_.erase(it);
+      }
+      stall_->RecordDone(resp.names[i]);
+    }
+    executor_(resp, local_ids);
+    if (timeline_ && timeline_->active())
+      for (const auto& n : resp.names) timeline_->ActivityEnd(n, "XLA_COMM");
+  }
+  if (cycle_bytes > 0) params_->Observe(cycle_bytes);
+  if (timeline_ && timeline_->active() && !responses.empty())
+    timeline_->MarkCycle();
+
+  // 6. stall inspection (reference: StallInspector::CheckForStalledTensors)
+  std::vector<std::string> warnings;
+  bool shutdown = stall_->Check(&warnings);
+  for (const auto& w : warnings)
+    logger_(1, "possible stall: tensor " + w +
+                   " submitted on this rank but not yet executed "
+                   "(waiting on peers?)");
+  if (shutdown) {
+    logger_(2, "stall shutdown threshold exceeded; aborting background loop");
+    return false;
+  }
+  return true;
+}
+
+void Controller::Join(int64_t) {
+  // Coordinator bookkeeping arrives via the JOIN op in the request stream;
+  // the loopback world is a single rank, so joining is immediate.
+  joined_ranks_.insert(rank());
+}
+
+std::vector<Response> Controller::BuildResponses() {
+  // Ready = reported by all non-joined ranks of the process set world.
+  // Deterministic order: FIFO by coordinator first-sight (reference:
+  // responses preserve request arrival order before fusion).
+  std::vector<const PendingCoord*> ready;
+  for (auto& [name, pc] : coord_table_) {
+    size_t need = 0;
+    for (int32_t r = 0; r < size(); ++r)
+      if (joined_ranks_.find(r) == joined_ranks_.end()) ++need;
+    std::set<int32_t> effective = pc.reported;
+    for (auto r : joined_ranks_) effective.erase(r);
+    if (effective.size() >= need && need > 0) ready.push_back(&pc);
+  }
+  // group atomicity (reference: GroupTable): only emit a group's entries
+  // when the whole group is ready
+  std::unordered_map<int32_t, int32_t> group_ready;
+  for (auto* pc : ready)
+    if (pc->meta.group_id >= 0) ++group_ready[pc->meta.group_id];
+  ready.erase(
+      std::remove_if(ready.begin(), ready.end(),
+                     [&](const PendingCoord* pc) {
+                       if (pc->meta.group_id < 0) return false;
+                       auto expected =
+                           groups_->ExpectedSize(pc->meta.group_id);
+                       return expected > 0 &&
+                              group_ready[pc->meta.group_id] < expected;
+                     }),
+      ready.end());
+  std::sort(ready.begin(), ready.end(),
+            [](const PendingCoord* a, const PendingCoord* b) {
+              return a->order < b->order;
+            });
+
+  // fuse: same (op, dtype, process_set, scale factors) bucket up to the
+  // fusion threshold (reference: Controller::FuseResponses)
+  std::vector<Response> out;
+  int64_t bucket_bytes = 0;
+  auto fusable = [&](const Response& r, const TensorTableEntry& e) {
+    return r.op == e.op && r.dtype == e.dtype &&
+           r.process_set_id == e.process_set_id &&
+           r.root_rank == e.root_rank && r.prescale == e.prescale &&
+           r.postscale == e.postscale && e.op == OpType::ALLREDUCE;
+  };
+  std::vector<std::string> emitted;
+  for (auto* pc : ready) {
+    const auto& e = pc->meta;
+    int64_t threshold = params_->fusion_threshold();
+    if (!out.empty() && fusable(out.back(), e) &&
+        (threshold <= 0 ? out.back().names.size() < 1  // fusion disabled
+                        : bucket_bytes + e.NumBytes() <= threshold)) {
+      out.back().names.push_back(e.name);
+      out.back().shapes.push_back(e.shape);
+      bucket_bytes += e.NumBytes();
+    } else {
+      Response r;
+      r.op = e.op;
+      r.dtype = e.dtype;
+      r.process_set_id = e.process_set_id;
+      r.root_rank = e.root_rank;
+      r.prescale = e.prescale;
+      r.postscale = e.postscale;
+      r.names = {e.name};
+      r.shapes = {e.shape};
+      out.push_back(std::move(r));
+      bucket_bytes = e.NumBytes();
+    }
+    emitted.push_back(e.name);
+    // a group's members emit atomically in one cycle, so the group id is
+    // dead after emission — free it (GroupTable otherwise grows per step)
+    if (e.group_id >= 0) groups_->Forget(e.group_id);
+  }
+  for (const auto& name : emitted) coord_table_.erase(name);
+  return out;
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,94 @@
+// The negotiation controller: the brain of the background thread.
+//
+// Reference parity: horovod/common/controller.h/.cc (SURVEY.md §2.1): each
+// cycle every rank reports newly-pending tensors; the coordinator (rank 0)
+// marks a tensor ready when ALL participating ranks have reported it,
+// fuses ready tensors into Responses up to the fusion threshold, and
+// broadcasts the ResponseList; every rank then executes the same fused
+// collectives in the same order.  Join/Barrier ride the same protocol.
+//
+// TPU-native difference: "execute" means invoking the registered executor
+// callback, which launches a cached compiled XLA collective — the
+// controller never touches tensor bytes (SURVEY.md §7.1).
+#pragma once
+
+#include <functional>
+#include <map>
+#include <memory>
+#include <set>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+#include "group_table.h"
+#include "parameter_manager.h"
+#include "response_cache.h"
+#include "stall_inspector.h"
+#include "tensor_queue.h"
+#include "timeline.h"
+#include "transport.h"
+
+namespace hvdtpu {
+
+// Executor: runs one fused Response on the data plane.  local_ids[i] is
+// the local entry id for names[i], or -1 when this rank has no such entry
+// (post-join zero contribution).
+using Executor = std::function<void(const Response&,
+                                    const std::vector<int64_t>& local_ids)>;
+using Logger = std::function<void(int level, const std::string&)>;
+
+class Controller {
+ public:
+  Controller(std::unique_ptr<Transport> transport, TensorQueue* queue,
+             GroupTable* groups, ResponseCache* cache,
+             StallInspector* stall, Timeline* timeline,
+             ParameterManager* params, Executor executor, Logger logger)
+      : transport_(std::move(transport)),
+        queue_(queue),
+        groups_(groups),
+        cache_(cache),
+        stall_(stall),
+        timeline_(timeline),
+        params_(params),
+        executor_(std::move(executor)),
+        logger_(std::move(logger)) {}
+
+  // One coordination cycle (reference: RunLoopOnce in operations.cc).
+  // Returns false when a shutdown condition tripped (stall hard-limit).
+  bool RunLoopOnce();
+
+  // Rank declares it has no more data (reference: Join op).  Subsequent
+  // tensors become ready without this rank's report.
+  void Join(int64_t entry_id);
+
+  int rank() const { return transport_->rank(); }
+  int size() const { return transport_->size(); }
+
+ private:
+  struct PendingCoord {  // coordinator-side per-name state
+    TensorTableEntry meta;
+    std::set<int32_t> reported;
+    int64_t order;  // FIFO tie-break for deterministic fusion order
+  };
+
+  std::vector<Response> BuildResponses();
+
+  std::unique_ptr<Transport> transport_;
+  TensorQueue* queue_;
+  GroupTable* groups_;
+  ResponseCache* cache_;
+  StallInspector* stall_;
+  Timeline* timeline_;
+  ParameterManager* params_;
+  Executor executor_;
+  Logger logger_;
+
+  // local entries awaiting a response, by name
+  std::unordered_map<std::string, TensorTableEntry> pending_;
+  // coordinator state (rank 0 only)
+  std::map<std::string, PendingCoord> coord_table_;
+  std::set<int32_t> joined_ranks_;
+  int64_t order_counter_ = 0;
+};
+
+}  // namespace hvdtpu

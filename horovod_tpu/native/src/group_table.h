@@ -1,0 +1,42 @@
+// Grouped-collective bookkeeping.
+//
+// Reference parity: horovod/common/group_table.h/.cc (SURVEY.md §2.1) —
+// entries sharing a group id must execute atomically: none is eligible for
+// fusion/execution until every member of the group is pending, and they
+// fuse together.
+#pragma once
+
+#include <cstdint>
+#include <mutex>
+#include <unordered_map>
+
+namespace hvdtpu {
+
+class GroupTable {
+ public:
+  // Register a group of `size` members; returns the group id.
+  int32_t RegisterGroup(int32_t size) {
+    std::lock_guard<std::mutex> lk(mu_);
+    int32_t id = next_id_++;
+    expected_[id] = size;
+    return id;
+  }
+
+  int32_t ExpectedSize(int32_t group_id) const {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = expected_.find(group_id);
+    return it == expected_.end() ? -1 : it->second;
+  }
+
+  void Forget(int32_t group_id) {
+    std::lock_guard<std::mutex> lk(mu_);
+    expected_.erase(group_id);
+  }
+
+ private:
+  mutable std::mutex mu_;
+  std::unordered_map<int32_t, int32_t> expected_;
+  int32_t next_id_ = 0;
+};
+
+}  // namespace hvdtpu

@@ -1,0 +1,113 @@
+#include "message.h"
+
+namespace hvdtpu {
+namespace wire {
+
+std::string EncodeEntry(const TensorTableEntry& e) {
+  Writer w;
+  w.U8(kWireVersion);
+  w.I64(e.id);
+  w.Str(e.name);
+  w.I32(static_cast<int32_t>(e.op));
+  w.I32(static_cast<int32_t>(e.dtype));
+  w.I32(static_cast<int32_t>(e.shape.size()));
+  for (auto d : e.shape) w.I64(d);
+  w.I32(e.process_set_id);
+  w.I32(e.group_id);
+  w.I32(e.root_rank);
+  w.F64(e.prescale);
+  w.F64(e.postscale);
+  return w.str();
+}
+
+bool DecodeEntry(Reader& r, TensorTableEntry* e) {
+  uint8_t ver;
+  if (!r.U8(&ver) || ver != kWireVersion) return false;
+  int32_t op, dtype, ndim;
+  if (!r.I64(&e->id) || !r.Str(&e->name) || !r.I32(&op) || !r.I32(&dtype) ||
+      !r.I32(&ndim) || ndim < 0 || ndim > 64)
+    return false;
+  e->op = static_cast<OpType>(op);
+  e->dtype = static_cast<DataType>(dtype);
+  e->shape.resize(ndim);
+  for (auto& d : e->shape)
+    if (!r.I64(&d)) return false;
+  return r.I32(&e->process_set_id) && r.I32(&e->group_id) &&
+         r.I32(&e->root_rank) && r.F64(&e->prescale) && r.F64(&e->postscale);
+}
+
+std::string EncodeEntryList(const std::vector<TensorTableEntry>& v) {
+  Writer w;
+  w.I32(static_cast<int32_t>(v.size()));
+  for (const auto& e : v) w.Str(EncodeEntry(e));
+  return w.str();
+}
+
+bool DecodeEntryList(const std::string& s, std::vector<TensorTableEntry>* v) {
+  Reader r(s.data(), s.size());
+  int32_t n;
+  if (!r.I32(&n) || n < 0) return false;
+  v->resize(n);
+  for (auto& e : *v) {
+    std::string payload;
+    if (!r.Str(&payload)) return false;
+    Reader er(payload.data(), payload.size());
+    if (!DecodeEntry(er, &e)) return false;
+  }
+  return true;
+}
+
+std::string EncodeResponseList(const std::vector<Response>& v) {
+  Writer w;
+  w.U8(kWireVersion);
+  w.I32(static_cast<int32_t>(v.size()));
+  for (const auto& resp : v) {
+    w.I32(static_cast<int32_t>(resp.op));
+    w.I32(static_cast<int32_t>(resp.dtype));
+    w.I32(resp.process_set_id);
+    w.I32(resp.root_rank);
+    w.F64(resp.prescale);
+    w.F64(resp.postscale);
+    w.Str(resp.error);
+    w.I32(static_cast<int32_t>(resp.names.size()));
+    for (size_t i = 0; i < resp.names.size(); ++i) {
+      w.Str(resp.names[i]);
+      const auto& shape = resp.shapes[i];
+      w.I32(static_cast<int32_t>(shape.size()));
+      for (auto d : shape) w.I64(d);
+    }
+  }
+  return w.str();
+}
+
+bool DecodeResponseList(const std::string& s, std::vector<Response>* v) {
+  Reader r(s.data(), s.size());
+  uint8_t ver;
+  int32_t n;
+  if (!r.U8(&ver) || ver != kWireVersion || !r.I32(&n) || n < 0) return false;
+  v->resize(n);
+  for (auto& resp : *v) {
+    int32_t op, dtype, nnames;
+    if (!r.I32(&op) || !r.I32(&dtype) || !r.I32(&resp.process_set_id) ||
+        !r.I32(&resp.root_rank) || !r.F64(&resp.prescale) ||
+        !r.F64(&resp.postscale) || !r.Str(&resp.error) || !r.I32(&nnames) ||
+        nnames < 0)
+      return false;
+    resp.op = static_cast<OpType>(op);
+    resp.dtype = static_cast<DataType>(dtype);
+    resp.names.resize(nnames);
+    resp.shapes.resize(nnames);
+    for (int32_t i = 0; i < nnames; ++i) {
+      int32_t ndim;
+      if (!r.Str(&resp.names[i]) || !r.I32(&ndim) || ndim < 0 || ndim > 64)
+        return false;
+      resp.shapes[i].resize(ndim);
+      for (auto& d : resp.shapes[i])
+        if (!r.I64(&d)) return false;
+    }
+  }
+  return true;
+}
+
+}  // namespace wire
+}  // namespace hvdtpu

@@ -1,0 +1,80 @@
+// Wire format for cross-process negotiation.
+//
+// Reference parity: horovod/common/message.h/.cc + wire/message.fbs
+// (SURVEY.md §2.1 "Message / wire format").  The reference serializes with
+// flatbuffers; this image carries no flatc, so the format is a hand-rolled
+// length-prefixed little-endian encoding with a version byte — same role
+// (Request/Response negotiation over the controller transport), simpler
+// dependency story, documented divergence.
+#pragma once
+
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "common.h"
+
+namespace hvdtpu {
+namespace wire {
+
+constexpr uint8_t kWireVersion = 1;
+
+class Writer {
+ public:
+  void U8(uint8_t v) { buf_.push_back(static_cast<char>(v)); }
+  void I32(int32_t v) { Raw(&v, 4); }
+  void I64(int64_t v) { Raw(&v, 8); }
+  void F64(double v) { Raw(&v, 8); }
+  void Str(const std::string& s) {
+    I32(static_cast<int32_t>(s.size()));
+    buf_.append(s);
+  }
+  void Raw(const void* p, size_t n) {
+    buf_.append(reinterpret_cast<const char*>(p), n);
+  }
+  const std::string& str() const { return buf_; }
+
+ private:
+  std::string buf_;
+};
+
+class Reader {
+ public:
+  Reader(const char* data, size_t len) : p_(data), end_(data + len) {}
+  bool U8(uint8_t* v) { return Raw(v, 1); }
+  bool I32(int32_t* v) { return Raw(v, 4); }
+  bool I64(int64_t* v) { return Raw(v, 8); }
+  bool F64(double* v) { return Raw(v, 8); }
+  bool Str(std::string* s) {
+    int32_t n;
+    if (!I32(&n) || n < 0 || p_ + n > end_) return false;
+    s->assign(p_, n);
+    p_ += n;
+    return true;
+  }
+  bool Raw(void* v, size_t n) {
+    if (p_ + n > end_) return false;
+    std::memcpy(v, p_, n);
+    p_ += n;
+    return true;
+  }
+
+ private:
+  const char* p_;
+  const char* end_;
+};
+
+// Request = what one rank reports as ready (reference: Request in
+// message.h: name, op, dtype, shape, device, scale factors).
+std::string EncodeEntry(const TensorTableEntry& e);
+bool DecodeEntry(Reader& r, TensorTableEntry* e);
+std::string EncodeEntryList(const std::vector<TensorTableEntry>& v);
+bool DecodeEntryList(const std::string& s, std::vector<TensorTableEntry>* v);
+
+// ResponseList = coordinator's fused execution orders (reference:
+// ResponseList in message.h).
+std::string EncodeResponseList(const std::vector<Response>& v);
+bool DecodeResponseList(const std::string& s, std::vector<Response>* v);
+
+}  // namespace wire
+}  // namespace hvdtpu

@@ -1,0 +1,109 @@
+// Online autotuning of fusion threshold and cycle time.
+//
+// Reference parity: horovod/common/parameter_manager.h/.cc (SURVEY.md
+// §2.1): warm-up / sample / hold phases scoring throughput, tuning
+// HOROVOD_FUSION_THRESHOLD and HOROVOD_CYCLE_TIME.  The reference runs
+// Bayesian optimization (vendored lbfgs); here a cyclic coordinate descent
+// over a discrete grid — documented divergence, same contract (scores by
+// observed bytes/sec, converges then holds, optional CSV log à la
+// HOROVOD_AUTOTUNE_LOG).
+#pragma once
+
+#include <array>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <string>
+#include <vector>
+
+namespace hvdtpu {
+
+class ParameterManager {
+ public:
+  ParameterManager(int64_t fusion_threshold, double cycle_time_ms,
+                   const std::string& log_path)
+      : tuning_(false),
+        fusion_threshold_(fusion_threshold),
+        cycle_time_ms_(cycle_time_ms) {
+    if (!log_path.empty()) log_ = std::fopen(log_path.c_str(), "w");
+    if (log_)
+      std::fputs("sample,fusion_threshold_bytes,cycle_time_ms,score_bytes_per_sec\n",
+                 log_);
+  }
+  ~ParameterManager() {
+    if (log_) std::fclose(log_);
+  }
+
+  void EnableTuning() {
+    tuning_ = true;
+    sample_start_ = std::chrono::steady_clock::now();
+  }
+  bool tuning() const { return tuning_; }
+
+  int64_t fusion_threshold() const { return fusion_threshold_; }
+  double cycle_time_ms() const { return cycle_time_ms_; }
+
+  // Called by the controller after dispatching responses.
+  void Observe(int64_t bytes) {
+    if (!tuning_) return;
+    sample_bytes_ += bytes;
+    auto now = std::chrono::steady_clock::now();
+    double elapsed =
+        std::chrono::duration<double>(now - sample_start_).count();
+    if (elapsed < kSampleSeconds) return;
+    double score = sample_bytes_ / elapsed;
+    Step(score);
+    sample_bytes_ = 0;
+    sample_start_ = now;
+  }
+
+ private:
+  static constexpr double kSampleSeconds = 2.0;
+  static constexpr int kMaxSamples = 24;  // then hold (reference: hold phase)
+
+  void Step(double score) {
+    if (log_)
+      std::fprintf(log_, "%d,%lld,%.3f,%.1f\n", samples_,
+                   static_cast<long long>(fusion_threshold_), cycle_time_ms_,
+                   score);
+    if (++samples_ >= kMaxSamples) {
+      // hold: keep the best seen
+      fusion_threshold_ = best_threshold_;
+      cycle_time_ms_ = best_cycle_;
+      tuning_ = false;
+      return;
+    }
+    if (score > best_score_) {
+      best_score_ = score;
+      best_threshold_ = fusion_threshold_;
+      best_cycle_ = cycle_time_ms_;
+    }
+    // cyclic coordinate descent over the discrete grids
+    if (samples_ % 2 == 0) {
+      threshold_idx_ = (threshold_idx_ + 1) % kThresholds.size();
+      fusion_threshold_ = kThresholds[threshold_idx_];
+    } else {
+      cycle_idx_ = (cycle_idx_ + 1) % kCycles.size();
+      cycle_time_ms_ = kCycles[cycle_idx_];
+    }
+  }
+
+  static constexpr std::array<int64_t, 6> kThresholds = {
+      2LL << 20, 8LL << 20, 16LL << 20, 32LL << 20, 64LL << 20, 128LL << 20};
+  static constexpr std::array<double, 5> kCycles = {0.5, 1.0, 2.5, 5.0, 10.0};
+
+  bool tuning_;
+  int64_t fusion_threshold_;
+  double cycle_time_ms_;
+  int64_t best_threshold_ = 64 << 20;
+  double best_cycle_ = 1.0;
+  double best_score_ = -1.0;
+  int samples_ = 0;
+  size_t threshold_idx_ = 0;
+  size_t cycle_idx_ = 0;
+  int64_t sample_bytes_ = 0;
+  std::chrono::steady_clock::time_point sample_start_;
+  std::FILE* log_ = nullptr;
+};
+
+}  // namespace hvdtpu

@@ -1,0 +1,74 @@
+// LRU cache of negotiated collective signatures.
+//
+// Reference parity: horovod/common/response_cache.h/.cc (SURVEY.md §2.1):
+// steady-state steps skip the full Request gather — ranks exchange only a
+// bit vector of cache positions.  TPU-native reinterpretation per SURVEY.md
+// §7.1: a hit ALSO means the XLA executable for that signature is warm, so
+// the cache key doubles as the compiled-collective cache key exported to
+// the Python engine.
+#pragma once
+
+#include <cstdint>
+#include <list>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <unordered_map>
+
+#include "common.h"
+
+namespace hvdtpu {
+
+class ResponseCache {
+ public:
+  explicit ResponseCache(size_t capacity) : capacity_(capacity) {}
+
+  static std::string Signature(const TensorTableEntry& e) {
+    std::ostringstream os;
+    os << e.name << '|' << static_cast<int>(e.op) << '|'
+       << static_cast<int>(e.dtype) << '|';
+    for (auto d : e.shape) os << d << ',';
+    os << '|' << e.process_set_id;
+    return os.str();
+  }
+
+  // Returns the cache position (bit index) or -1 on miss; records on miss.
+  int64_t Lookup(const TensorTableEntry& e) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto sig = Signature(e);
+    auto it = index_.find(sig);
+    if (it != index_.end()) {
+      lru_.splice(lru_.begin(), lru_, it->second.lru_it);
+      ++hits_;
+      return it->second.position;
+    }
+    ++misses_;
+    if (capacity_ > 0 && index_.size() >= capacity_) {
+      const auto& evict = lru_.back();
+      index_.erase(evict);
+      lru_.pop_back();
+    }
+    lru_.push_front(sig);
+    index_[sig] = {next_position_++, lru_.begin()};
+    return -1;
+  }
+
+  int64_t hits() const { std::lock_guard<std::mutex> lk(mu_); return hits_; }
+  int64_t misses() const { std::lock_guard<std::mutex> lk(mu_); return misses_; }
+  size_t size() const { std::lock_guard<std::mutex> lk(mu_); return index_.size(); }
+
+ private:
+  struct Slot {
+    int64_t position;
+    std::list<std::string>::iterator lru_it;
+  };
+  mutable std::mutex mu_;
+  size_t capacity_;
+  int64_t next_position_ = 0;
+  int64_t hits_ = 0;
+  int64_t misses_ = 0;
+  std::list<std::string> lru_;  // front = most recent
+  std::unordered_map<std::string, Slot> index_;
+};
+
+}  // namespace hvdtpu

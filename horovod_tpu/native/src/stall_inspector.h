@@ -1,0 +1,69 @@
+// Stall detection for pending collectives.
+//
+// Reference parity: horovod/common/stall_inspector.h/.cc (SURVEY.md §2.1,
+// §5.2): warn when a tensor has been submitted but not executed for longer
+// than the warning threshold (the distributed analog of a race detector —
+// it names exactly which tensors are stuck), optionally hard-abort after
+// the shutdown threshold (HOROVOD_STALL_SHUTDOWN_TIME_SECONDS).
+#pragma once
+
+#include <chrono>
+#include <cstdio>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+
+namespace hvdtpu {
+
+class StallInspector {
+ public:
+  StallInspector(double warn_seconds, double shutdown_seconds)
+      : warn_seconds_(warn_seconds), shutdown_seconds_(shutdown_seconds) {}
+
+  void RecordPending(const TensorTableEntry& e) {
+    std::lock_guard<std::mutex> lk(mu_);
+    pending_.emplace(e.name, e.enqueued_at);
+  }
+
+  void RecordDone(const std::string& name) {
+    std::lock_guard<std::mutex> lk(mu_);
+    pending_.erase(name);
+  }
+
+  // Returns true if the shutdown threshold tripped (caller aborts).
+  // Stalled tensor names are appended to `warnings` once per warn period.
+  bool Check(std::vector<std::string>* warnings) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (warn_seconds_ <= 0) return false;
+    auto now = Clock::now();
+    bool shutdown = false;
+    for (const auto& [name, t0] : pending_) {
+      double age =
+          std::chrono::duration<double>(now - t0).count();
+      if (age > warn_seconds_ && warned_.find(name) == warned_.end()) {
+        warnings->push_back(name + " (pending " +
+                            std::to_string(static_cast<int>(age)) + "s)");
+        warned_.insert({name, true});
+      }
+      if (shutdown_seconds_ > 0 && age > shutdown_seconds_) shutdown = true;
+    }
+    return shutdown;
+  }
+
+  size_t PendingCount() const {
+    std::lock_guard<std::mutex> lk(mu_);
+    return pending_.size();
+  }
+
+ private:
+  mutable std::mutex mu_;
+  double warn_seconds_;
+  double shutdown_seconds_;
+  std::unordered_map<std::string, Clock::time_point> pending_;
+  std::unordered_map<std::string, bool> warned_;
+};
+
+}  // namespace hvdtpu

@@ -1,0 +1,49 @@
+// Thread-safe pending-entry queue between framework threads and the
+// background controller thread.
+//
+// Reference parity: horovod/common/tensor_queue.h/.cc (SURVEY.md §2.1) —
+// same contract (AddToTensorQueue from any thread, PopMessagesFromQueue
+// from the background loop), without the tensor payloads (metadata-only
+// core).
+#pragma once
+
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+#include <vector>
+
+#include "common.h"
+
+namespace hvdtpu {
+
+class TensorQueue {
+ public:
+  // Returns false when a pending entry with the same name exists
+  // (reference: duplicate-name check in TensorQueue::AddToTensorQueue).
+  bool Add(TensorTableEntry entry) {
+    std::lock_guard<std::mutex> lk(mu_);
+    for (const auto& e : queue_)
+      if (e.name == entry.name && e.process_set_id == entry.process_set_id)
+        return false;
+    queue_.push_back(std::move(entry));
+    return true;
+  }
+
+  std::vector<TensorTableEntry> PopAll() {
+    std::lock_guard<std::mutex> lk(mu_);
+    std::vector<TensorTableEntry> out(queue_.begin(), queue_.end());
+    queue_.clear();
+    return out;
+  }
+
+  size_t Size() const {
+    std::lock_guard<std::mutex> lk(mu_);
+    return queue_.size();
+  }
+
+ private:
+  mutable std::mutex mu_;
+  std::deque<TensorTableEntry> queue_;
+};
+
+}  // namespace hvdtpu

@@ -1,0 +1,120 @@
+// Chrome-trace timeline writer with a dedicated writer thread.
+//
+// Reference parity: horovod/common/timeline.h/.cc (SURVEY.md §5.1) — JSON
+// about:tracing output, one row per tensor, spans per phase; records are
+// pushed from the controller/executor and drained by a writer thread so
+// the hot path never blocks on file IO.  Phases here are the TPU
+// lifecycle: QUEUE (pending in TensorQueue), NEGOTIATE (cycle coordination)
+// and XLA_COMM (executor callback running the compiled collective).
+#pragma once
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdio>
+#include <deque>
+#include <functional>
+#include <mutex>
+#include <string>
+#include <thread>
+
+namespace hvdtpu {
+
+class Timeline {
+ public:
+  Timeline(const std::string& path, int rank)
+      : rank_(rank), t0_(std::chrono::steady_clock::now()) {
+    file_ = std::fopen(path.c_str(), "w");
+    if (!file_) return;
+    std::fputs("[\n", file_);
+    Emit("{\"name\":\"process_name\",\"ph\":\"M\",\"pid\":" +
+         std::to_string(rank_) + ",\"args\":{\"name\":\"hvd_tpu rank " +
+         std::to_string(rank_) + "\"}}");
+    writer_ = std::thread([this] { Drain(); });
+  }
+
+  ~Timeline() { Close(); }
+
+  bool active() const { return file_ != nullptr; }
+
+  void ActivityStart(const std::string& tensor, const std::string& activity) {
+    Event("B", tensor, activity);
+  }
+  void ActivityEnd(const std::string& tensor, const std::string& activity) {
+    Event("E", tensor, activity);
+  }
+  void MarkCycle() {
+    if (!file_) return;
+    Emit("{\"name\":\"CYCLE\",\"cat\":\"hvd_tpu\",\"ph\":\"i\",\"s\":\"g\","
+         "\"pid\":" + std::to_string(rank_) + ",\"ts\":" + NowUs() + "}");
+  }
+
+  void Close() {
+    if (!file_) return;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      closing_ = true;
+    }
+    cv_.notify_all();
+    if (writer_.joinable()) writer_.join();
+    std::fputs("\n]\n", file_);
+    std::fclose(file_);
+    file_ = nullptr;
+  }
+
+ private:
+  std::string NowUs() {
+    auto us = std::chrono::duration_cast<std::chrono::nanoseconds>(
+                  std::chrono::steady_clock::now() - t0_)
+                  .count() / 1000.0;
+    char buf[32];
+    std::snprintf(buf, sizeof(buf), "%.3f", us);
+    return buf;
+  }
+
+  void Event(const char* ph, const std::string& tensor,
+             const std::string& activity) {
+    if (!file_) return;
+    // tid: stable per-tensor row, like the reference's per-tensor lanes
+    auto tid = std::hash<std::string>{}(tensor) % 2147483647;
+    Emit("{\"name\":\"" + activity + "\",\"cat\":\"hvd_tpu\",\"ph\":\"" + ph +
+         "\",\"pid\":" + std::to_string(rank_) + ",\"tid\":" +
+         std::to_string(tid) + ",\"ts\":" + NowUs() +
+         ",\"args\":{\"tensor\":\"" + tensor + "\"}}");
+  }
+
+  void Emit(std::string record) {
+    std::lock_guard<std::mutex> lk(mu_);
+    queue_.push_back(std::move(record));
+    cv_.notify_one();
+  }
+
+  void Drain() {
+    std::unique_lock<std::mutex> lk(mu_);
+    while (true) {
+      cv_.wait(lk, [this] { return closing_ || !queue_.empty(); });
+      while (!queue_.empty()) {
+        auto rec = std::move(queue_.front());
+        queue_.pop_front();
+        lk.unlock();
+        if (!first_) std::fputs(",\n", file_);
+        first_ = false;
+        std::fputs(rec.c_str(), file_);
+        lk.lock();
+      }
+      if (closing_) return;
+    }
+  }
+
+  int rank_;
+  std::chrono::steady_clock::time_point t0_;
+  std::FILE* file_ = nullptr;
+  bool first_ = true;
+  bool closing_ = false;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<std::string> queue_;
+  std::thread writer_;
+};
+
+}  // namespace hvdtpu

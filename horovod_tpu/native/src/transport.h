@@ -1,0 +1,51 @@
+// Negotiation transport: how ranks exchange Request/Response payloads.
+//
+// Reference parity: the controller transports of SURVEY.md §2.1 —
+// MPIController (MPI_Gatherv/MPI_Bcast) and GlooController (gloo gather /
+// HTTP store).  TPU-native mapping (§5.8): the in-process world needs no
+// transport at all (LoopbackTransport), and multi-process worlds talk over
+// a host-side TCP star rooted at rank 0 (tcp_transport.h) — the JAX
+// coordination-service analog for the C++ side, bootstrapped by the
+// tpurun launcher the same way horovodrun exports the Gloo rendezvous
+// address.
+#pragma once
+
+#include <string>
+#include <vector>
+
+namespace hvdtpu {
+
+class Transport {
+ public:
+  virtual ~Transport() = default;
+  virtual int rank() const = 0;
+  virtual int size() const = 0;
+
+  // Rank 0 receives every rank's encoded request list (index == rank);
+  // other ranks send `mine` and receive an empty vector.
+  // Reference: MPIController::SendReadyTensors / RecvReadyTensors.
+  virtual std::vector<std::string> GatherRequests(const std::string& mine) = 0;
+
+  // Rank 0 broadcasts `payload`; every rank returns the broadcast value.
+  // Reference: MPIController::SendFinalTensors / RecvFinalTensors.
+  virtual std::string BcastResponseList(const std::string& payload) = 0;
+
+  // True when the transport failed mid-collective => HorovodInternalError
+  // on the Python side (elastic recovery hook).
+  virtual bool failed() const { return false; }
+};
+
+// Single-process world: negotiation degenerates to identity.
+class LoopbackTransport : public Transport {
+ public:
+  int rank() const override { return 0; }
+  int size() const override { return 1; }
+  std::vector<std::string> GatherRequests(const std::string& mine) override {
+    return {mine};
+  }
+  std::string BcastResponseList(const std::string& payload) override {
+    return payload;
+  }
+};
+
+}  // namespace hvdtpu

@@ -31,20 +31,34 @@ from .reduce_ops import Average, ReduceOp, Sum
 
 class Handle:
     """Async op handle (reference: horovod/torch/handle_manager.h — int
-    handles mapped to futures; here the handle owns its results directly)."""
+    handles mapped to futures).
 
-    __slots__ = ("_value",)
+    Two backing modes mirroring the two dispatch paths:
+      * direct: owns result arrays (JAX dispatch is already async);
+      * native: owns Futures resolved by the C++ background thread, plus a
+        builder that reassembles the user's pytree.
+    """
 
-    def __init__(self, value: Any):
+    __slots__ = ("_value", "_futures", "_builder")
+
+    def __init__(self, value: Any = None, futures=None, builder=None):
         self._value = value
+        self._futures = futures
+        self._builder = builder
 
     def wait(self) -> Any:
+        if self._futures is not None:
+            vals = [f.result() for f in self._futures]
+            self._value = self._builder(vals)
+            self._futures = None
         leaves = jax.tree_util.tree_leaves(self._value)
         if leaves:
             jax.block_until_ready(leaves)
         return self._value
 
     def done(self) -> bool:
+        if self._futures is not None:
+            return all(f.done() for f in self._futures)
         leaves = jax.tree_util.tree_leaves(self._value)
         return all(
             getattr(leaf, "is_ready", lambda: True)() for leaf in leaves
@@ -63,6 +77,57 @@ def poll(handle: Handle) -> bool:
 
 def _engine():
     return basics._require_init().engine
+
+
+def _contains_tracer(tree) -> bool:
+    """True when any leaf is a JAX tracer — i.e. we were called inside a
+    jit/cond/scan trace (e.g. optax.MultiSteps' internal lax.cond).  Traced
+    values must never cross into the background controller; they take the
+    in-line traceable path instead."""
+    return any(
+        isinstance(l, jax.core.Tracer)
+        for l in jax.tree_util.tree_leaves(tree)
+    )
+
+
+def _native(tensor=None):
+    """The native background controller, or None when running on the
+    Python fallback (reference analog: nccl_built() backend selection),
+    when ``tensor`` holds tracers, or when a leaf dtype has no wire enum
+    (those fall back to the dtype-agnostic engine path)."""
+    ctrl = basics._require_init().controller
+    if ctrl is None or not ctrl.is_native:
+        return None
+    if tensor is not None:
+        from ..native.controller import _DTYPE_TO_ENUM
+
+        for l in jax.tree_util.tree_leaves(tensor):
+            if isinstance(l, jax.core.Tracer):
+                return None
+            if str(jnp.asarray(l).dtype) not in _DTYPE_TO_ENUM:
+                return None
+    return ctrl
+
+
+def _native_submit(tree, op_type, name, builder_extra=None, **enqueue_kw):
+    """Route a pytree through the C++ controller: one TensorQueue entry per
+    leaf; the background thread negotiates, fuses across entries, and the
+    exec callback launches the compiled XLA collective (§3.2 hot path)."""
+    ctrl = _native()
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    leaves = [jnp.asarray(x) for x in leaves]
+    futures = [
+        ctrl.enqueue(
+            leaf, op_type,
+            name=(f"{name}.{i}" if name else None),
+            **enqueue_kw,
+        )
+        for i, leaf in enumerate(leaves)
+    ]
+    builder = builder_extra or (
+        lambda vals: jax.tree_util.tree_unflatten(treedef, vals)
+    )
+    return Handle(futures=futures, builder=builder)
 
 
 def _normalize_op(op: Optional[ReduceOp], average: Optional[bool]) -> ReduceOp:
@@ -118,6 +183,17 @@ def allreduce_async(
     process_set: Optional[ProcessSet] = None,
 ) -> Handle:
     rop = _normalize_op(op, average)
+    if _native(tensor) is not None:
+        from ..native.controller import OP_ALLREDUCE
+
+        return _native_submit(
+            tensor, OP_ALLREDUCE, name,
+            reduce_op=int(rop),
+            process_set_id=(
+                process_set.process_set_id if process_set is not None else 0
+            ),
+            prescale=prescale_factor, postscale=postscale_factor,
+        )
     eng = _engine()
     result = _fused_map(
         tensor,
@@ -138,20 +214,38 @@ def grouped_allreduce(
     process_set: Optional[ProcessSet] = None,
 ) -> List[Any]:
     """Reference: grouped_allreduce (horovod/torch/mpi_ops.py +
-    common/group_table.cc): the group executes atomically as shared fused
-    buffers — here the list *is* the pytree, so grouping falls out of
-    pytree fusion."""
+    common/group_table.cc): the group executes atomically — on the native
+    path via a registered GroupTable id, on the fallback path because the
+    list *is* one pytree and fuses together."""
     return list(
-        allreduce(
-            list(tensors), average, name, op, prescale_factor,
-            postscale_factor, process_set,
-        )
+        grouped_allreduce_async(
+            tensors, average=average, name=name, op=op,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor, process_set=process_set,
+        ).wait()
     )
 
 
 def grouped_allreduce_async(
     tensors: Sequence[Any], **kwargs
 ) -> Handle:
+    ctrl = _native(list(tensors))
+    if ctrl is not None:
+        # native atomicity: register the group so the controller only
+        # releases these entries together (reference: GroupTable semantics)
+        n_leaves = len(jax.tree_util.tree_leaves(list(tensors)))
+        gid = ctrl.register_group(n_leaves)
+        rop = _normalize_op(kwargs.pop("op", None), kwargs.pop("average", None))
+        ps = kwargs.pop("process_set", None)
+        from ..native.controller import OP_ALLREDUCE
+
+        return _native_submit(
+            list(tensors), OP_ALLREDUCE, kwargs.pop("name", None),
+            reduce_op=int(rop), group_id=gid,
+            prescale=kwargs.pop("prescale_factor", 1.0),
+            postscale=kwargs.pop("postscale_factor", 1.0),
+            process_set_id=ps.process_set_id if ps is not None else 0,
+        )
     return allreduce_async(list(tensors), **kwargs)
 
 
@@ -172,6 +266,15 @@ def allgather_async(
     name: Optional[str] = None,
     process_set: Optional[ProcessSet] = None,
 ) -> Handle:
+    if _native(tensor) is not None:
+        from ..native.controller import OP_ALLGATHER
+
+        return _native_submit(
+            tensor, OP_ALLGATHER, name,
+            process_set_id=(
+                process_set.process_set_id if process_set is not None else 0
+            ),
+        )
     eng = _engine()
     result = jax.tree_util.tree_map(
         lambda x: eng.allgather(jnp.asarray(x), process_set), tensor
@@ -205,6 +308,18 @@ def broadcast_async(
     name: Optional[str] = None,
     process_set: Optional[ProcessSet] = None,
 ) -> Handle:
+    # validate eagerly (errors surface at call, not at wait)
+    _engine()._root_slot(root_rank)
+    if _native(tensor) is not None:
+        from ..native.controller import OP_BROADCAST
+
+        return _native_submit(
+            tensor, OP_BROADCAST, name,
+            root_rank=root_rank,
+            process_set_id=(
+                process_set.process_set_id if process_set is not None else 0
+            ),
+        )
     eng = _engine()
     result = _fused_map(
         tensor, lambda buf: eng.broadcast(buf, root_rank, process_set)
@@ -232,6 +347,17 @@ def alltoall_async(
     name: Optional[str] = None,
     process_set: Optional[ProcessSet] = None,
 ) -> Handle:
+    if _native(tensor) is not None:
+        from ..native.controller import OP_ALLTOALL
+
+        return _native_submit(
+            jnp.asarray(tensor), OP_ALLTOALL, name,
+            builder_extra=lambda vals: vals[0],
+            process_set_id=(
+                process_set.process_set_id if process_set is not None else 0
+            ),
+            extra=splits,
+        )
     eng = _engine()
     return Handle(eng.alltoall(jnp.asarray(tensor), splits, process_set))
 
@@ -255,6 +381,16 @@ def reducescatter_async(
     name: Optional[str] = None,
     process_set: Optional[ProcessSet] = None,
 ) -> Handle:
+    if _native(tensor) is not None:
+        from ..native.controller import OP_REDUCESCATTER
+
+        return _native_submit(
+            tensor, OP_REDUCESCATTER, name,
+            reduce_op=int(op),
+            process_set_id=(
+                process_set.process_set_id if process_set is not None else 0
+            ),
+        )
     eng = _engine()
     result = jax.tree_util.tree_map(
         lambda x: eng.reducescatter(jnp.asarray(x), op, process_set), tensor
@@ -267,6 +403,17 @@ def reducescatter_async(
 
 def barrier(process_set: Optional[ProcessSet] = None) -> None:
     """Reference: horovod_barrier (operations.cc BarrierOp)."""
+    ctrl = _native()
+    if ctrl is not None:
+        from ..native.controller import OP_BARRIER
+
+        ctrl.enqueue(
+            jnp.zeros((), jnp.int32), OP_BARRIER,
+            process_set_id=(
+                process_set.process_set_id if process_set is not None else 0
+            ),
+        ).result()
+        return
     _engine().barrier(process_set)
 
 

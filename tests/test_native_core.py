@@ -1,0 +1,125 @@
+"""Native C++ controller tests.
+
+Reference analog: the background-thread path every parallel test in the
+reference implicitly exercises (SURVEY.md §3.2) plus targeted unit checks
+for the aux components (response cache stats, group atomicity, timeline
+output, autotune knobs).  Skipped when the native core failed to build
+(feature-gated skips, reference test technique §4).
+"""
+
+import json
+import os
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+
+pytestmark = pytest.mark.skipif(
+    not (lambda: (hvd.init() or hvd.native_built()))(),
+    reason="native core not built",
+)
+
+
+def test_native_loaded():
+    assert hvd.native_built()
+
+
+def test_native_allreduce_roundtrip():
+    x = jnp.arange(16, dtype=jnp.float32)
+    out = hvd.allreduce(x, op=hvd.Sum)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+
+
+def test_native_async_future_handle():
+    h = hvd.allreduce_async({"a": jnp.ones((4,)), "b": jnp.zeros((2, 2))})
+    out = h.wait()
+    np.testing.assert_allclose(np.asarray(out["a"]), np.ones(4))
+    assert h.done()
+
+
+def test_native_fusion_across_entries():
+    # many small tensors in one async batch: the controller fuses them into
+    # one collective (observable via cache stats moving and results correct)
+    tensors = [jnp.full((8,), float(i)) for i in range(20)]
+    outs = hvd.grouped_allreduce(tensors, op=hvd.Sum)
+    for i, o in enumerate(outs):
+        np.testing.assert_allclose(np.asarray(o), np.full(8, float(i)))
+
+
+def test_native_response_cache_hits():
+    ctrl = hvd.common.basics._require_init().controller
+    before_h, before_m = ctrl.cache_hits(), ctrl.cache_misses()
+    for _ in range(3):
+        hvd.allreduce(jnp.ones((5,)), name="cache_probe")
+    after_h, after_m = ctrl.cache_hits(), ctrl.cache_misses()
+    # same name+signature resubmitted -> at least one hit, exactly one miss
+    assert after_m - before_m == 1
+    assert after_h - before_h >= 2
+
+
+def test_native_all_ops_roundtrip():
+    x = jnp.arange(8.0)
+    np.testing.assert_allclose(np.asarray(hvd.allgather(x)), np.asarray(x))
+    np.testing.assert_allclose(
+        np.asarray(hvd.broadcast(x, 0)), np.asarray(x)
+    )
+    out, splits = hvd.alltoall(x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+    np.testing.assert_allclose(np.asarray(hvd.reducescatter(x)),
+                               np.asarray(x))
+    hvd.barrier()
+
+
+def test_native_duplicate_name_rejected():
+    """Names stay claimed from enqueue until the response executes, so a
+    resubmission inside the negotiation window must be rejected
+    (reference: tensor-table duplicate check).  A slow cycle keeps the
+    window open deterministically."""
+    hvd.shutdown()
+    os.environ["HVD_TPU_CYCLE_TIME"] = "300"
+    try:
+        hvd.init()
+        h1 = hvd.allreduce_async(jnp.ones((8,)), name="dup")
+        with pytest.raises(ValueError):
+            hvd.allreduce_async(jnp.ones((8,)), name="dup")
+        h1.wait()
+        # after completion the name is reusable
+        hvd.allreduce(jnp.ones((4,)), name="dup")
+    finally:
+        os.environ.pop("HVD_TPU_CYCLE_TIME", None)
+        hvd.shutdown()
+        hvd.init()
+
+
+def test_native_autotune_knobs_readable():
+    ctrl = hvd.common.basics._require_init().controller
+    assert ctrl.fusion_threshold() > 0
+    assert ctrl.cycle_time_ms() > 0
+    assert ctrl.pending_count() >= 0
+
+
+def test_native_timeline_writes_chrome_trace(tmp_path):
+    """Restart the framework with a timeline file and check the output is
+    loadable chrome-trace JSON with our phases (reference: §5.1 format)."""
+    path = str(tmp_path / "timeline.json")
+    hvd.shutdown()
+    os.environ["HVD_TPU_TIMELINE"] = path
+    try:
+        hvd.init()
+        hvd.allreduce(jnp.ones((64,)), name="traced_tensor")
+        hvd.shutdown()
+    finally:
+        os.environ.pop("HVD_TPU_TIMELINE", None)
+        hvd.init()  # restore for subsequent tests
+    with open(path) as f:
+        events = json.load(f)
+    names = {e.get("name") for e in events}
+    assert "QUEUE" in names and "XLA_COMM" in names
+    tensors = {
+        e.get("args", {}).get("tensor")
+        for e in events if e.get("ph") in ("B", "E")
+    }
+    assert any(t and t.startswith("traced_tensor") for t in tensors)
