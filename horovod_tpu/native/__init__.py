@@ -54,11 +54,12 @@ _build_attempted = False
 
 
 def _maybe_build() -> None:
-    """Lazy build: compile the core on first use when a toolchain exists
-    (reference analog: setup.py's build_ext compiling the CMake tree —
-    §2.5; here a plain Makefile, no third-party deps)."""
+    """Lazy build: run make once per process; make itself decides staleness
+    from source timestamps, so edited sources always rebuild (reference
+    analog: setup.py's build_ext compiling the CMake tree — §2.5; here a
+    plain Makefile, no third-party deps)."""
     global _build_attempted
-    if _build_attempted or os.path.exists(_lib_path()):
+    if _build_attempted:
         return
     _build_attempted = True
     import shutil
@@ -71,7 +72,6 @@ def _maybe_build() -> None:
         subprocess.run(
             ["make"], cwd=src, check=True, capture_output=True, timeout=120
         )
-        get_logger().info("built native core at %s", _lib_path())
     except (subprocess.SubprocessError, OSError) as e:
         get_logger().warning("native core build failed (%s)", e)
 
@@ -83,6 +83,18 @@ def load_controller(topology: Topology, config: Config):
     horovod_init (operations.cc).
     """
     if os.environ.get("HVD_TPU_DISABLE_NATIVE", "0") in ("1", "true"):
+        return PyFallbackController(topology, config)
+    if topology.num_processes > 1 and not os.environ.get(
+        "HVD_TPU_NATIVE_PORT"
+    ):
+        # multi-process world without the launcher's negotiation channel:
+        # per-rank loopback controllers would make fusion timing-dependent
+        # and diverge the ranks' XLA programs — use the deterministic
+        # Python path instead (launch via tpurun to get the native core).
+        get_logger().info(
+            "multi-process world without HVD_TPU_NATIVE_PORT; using the "
+            "python controller (launch with tpurun for the native core)"
+        )
         return PyFallbackController(topology, config)
     _maybe_build()
     path = _lib_path()

@@ -103,9 +103,22 @@ class NativeController:
         # the callback object must outlive the native thread: keep the ref
         self._cb = _EXEC_CB(self._on_exec)
         self._lib.hvdtpu_set_exec_callback(self._cb, None)
+        # multi-process negotiation rides the TCP star the launcher set up
+        # (HVD_TPU_NATIVE_PORT on the coordinator host); absent that,
+        # loopback (reference analog: mpirun-vs-gloo controller selection)
+        import os
+
+        coord_host, coord_port = "", 0
+        native_port = os.environ.get("HVD_TPU_NATIVE_PORT")
+        if topology.num_processes > 1 and native_port:
+            coord = os.environ.get("HVD_TPU_COORDINATOR", "127.0.0.1:0")
+            coord_host = coord.rsplit(":", 1)[0]
+            coord_port = int(native_port)
         rc = self._lib.hvdtpu_init(
-            topology.rank,
-            max(topology.num_processes, 1),
+            topology.process_index,
+            max(topology.num_processes, 1) if coord_port else 1,
+            coord_host.encode(),
+            coord_port,
             ctypes.c_double(config.cycle_time_ms),
             ctypes.c_longlong(config.fusion_threshold_bytes),
             config.cache_capacity,
@@ -125,7 +138,8 @@ class NativeController:
     def _declare(lib) -> None:
         lib.hvdtpu_init.restype = ctypes.c_int
         lib.hvdtpu_init.argtypes = [
-            ctypes.c_int, ctypes.c_int, ctypes.c_double, ctypes.c_longlong,
+            ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_double, ctypes.c_longlong,
             ctypes.c_int, ctypes.c_char_p, ctypes.c_double, ctypes.c_double,
             ctypes.c_int, ctypes.c_char_p,
         ]
@@ -158,6 +172,15 @@ class NativeController:
 
     def shutdown(self) -> None:
         self._lib.hvdtpu_shutdown()
+        # fail anything still registered so concurrent waiters raise
+        # instead of blocking forever
+        with self._entries_lock:
+            leftovers = list(self._entries.values())
+            self._entries.clear()
+        err = HorovodInternalError("framework shut down with collectives "
+                                   "in flight")
+        for e in leftovers:
+            e.future.set_error(err)
 
     # -- stats (reference: horovod_* C getters) -----------------------------
 
@@ -239,6 +262,11 @@ class NativeController:
                 raise ValueError(
                     f"a collective named {name!r} is already pending "
                     "(reference: duplicate-name check in TensorQueue)"
+                )
+            if rc == -3:
+                raise HorovodInternalError(
+                    "background loop has stopped (stall shutdown or peer "
+                    "failure); reinitialize to continue"
                 )
             raise HorovodInternalError("native controller not initialized")
         return fut

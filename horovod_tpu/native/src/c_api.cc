@@ -24,6 +24,7 @@
 #include "stall_inspector.h"
 #include "tensor_queue.h"
 #include "timeline.h"
+#include "tcp_transport.h"
 #include "transport.h"
 
 namespace hvdtpu {
@@ -48,6 +49,9 @@ struct GlobalState {
   std::thread background;
   std::atomic<bool> shutdown{false};
   std::atomic<bool> initialized{false};
+  // set when the background loop exits (stall shutdown / transport death):
+  // the library is dead — reject new work so callers raise instead of hang
+  std::atomic<bool> loop_dead{false};
   std::atomic<int64_t> next_id{1};
   ExecCallback exec_cb = nullptr;
   void* exec_user = nullptr;
@@ -69,7 +73,10 @@ void BackgroundThreadLoop() {
   // the (possibly autotuned) cycle time.
   auto* s = g();
   while (!s->shutdown.load()) {
-    if (!s->controller->RunLoopOnce()) break;
+    if (!s->controller->RunLoopOnce()) {
+      s->loop_dead.store(true);
+      break;
+    }
     auto ms = s->params->cycle_time_ms();
     std::this_thread::sleep_for(
         std::chrono::duration<double, std::milli>(ms));
@@ -91,16 +98,14 @@ using hvdtpu::OpType;
 using hvdtpu::Response;
 using hvdtpu::TensorTableEntry;
 
-int hvdtpu_init(int rank, int size, double cycle_time_ms,
-                long long fusion_threshold, int cache_capacity,
-                const char* timeline_path, double stall_warn_sec,
-                double stall_shutdown_sec, int autotune,
-                const char* autotune_log) {
+int hvdtpu_init(int rank, int size, const char* coord_host, int coord_port,
+                double cycle_time_ms, long long fusion_threshold,
+                int cache_capacity, const char* timeline_path,
+                double stall_warn_sec, double stall_shutdown_sec,
+                int autotune, const char* autotune_log) {
   auto* s = hvdtpu::g();
   std::lock_guard<std::mutex> lk(s->init_mu);
   if (s->initialized.load()) return 0;
-  (void)rank;
-  (void)size;
   s->queue = std::make_unique<hvdtpu::TensorQueue>();
   s->groups = std::make_unique<hvdtpu::GroupTable>();
   s->cache = std::make_unique<hvdtpu::ResponseCache>(
@@ -133,13 +138,24 @@ int hvdtpu_init(int rank, int size, double cycle_time_ms,
                  static_cast<int>(ids.size()),
                  resp.error.empty() ? nullptr : resp.error.c_str());
   };
-  // Single-process loopback transport; the TCP star transport is wired in
-  // by hvdtpu_init_tcp (launcher-driven multi-process worlds).
+  // Transport choice (reference: controller selection in operations.cc):
+  // single process -> loopback; launcher-driven multi-process world ->
+  // TCP star rooted at rank 0 (coord_host:coord_port from tpurun).
+  std::unique_ptr<hvdtpu::Transport> transport;
+  if (size > 1 && coord_host && coord_host[0]) {
+    auto tcp = std::make_unique<hvdtpu::TcpTransport>(coord_host, coord_port,
+                                                      rank, size);
+    if (tcp->failed()) return 1;  // rendezvous failed
+    transport = std::move(tcp);
+  } else {
+    transport = std::make_unique<hvdtpu::LoopbackTransport>();
+  }
   s->controller = std::make_unique<hvdtpu::Controller>(
-      std::make_unique<hvdtpu::LoopbackTransport>(), s->queue.get(),
-      s->groups.get(), s->cache.get(), s->stall.get(), s->timeline.get(),
-      s->params.get(), executor, hvdtpu::DefaultLog);
+      std::move(transport), s->queue.get(), s->groups.get(), s->cache.get(),
+      s->stall.get(), s->timeline.get(), s->params.get(), executor,
+      hvdtpu::DefaultLog);
   s->shutdown.store(false);
+  s->loop_dead.store(false);
   s->background = std::thread(hvdtpu::BackgroundThreadLoop);
   s->initialized.store(true);
   return 0;
@@ -163,6 +179,7 @@ long long hvdtpu_enqueue(long long entry_id, const char* name, int op,
   // returning and the future registration (wait() would hang forever).
   auto* s = hvdtpu::g();
   if (!s->initialized.load()) return -2;
+  if (s->loop_dead.load()) return -3;  // background loop died
   {
     std::lock_guard<std::mutex> lk(s->names_mu);
     if (!s->active_names
@@ -196,16 +213,15 @@ void hvdtpu_shutdown() {
   auto* s = hvdtpu::g();
   std::lock_guard<std::mutex> lk(s->init_mu);
   if (!s->initialized.load()) return;
+  // flip initialized first so concurrent enqueues are rejected before the
+  // loop is joined; components are NOT freed here (a racing enqueue that
+  // slipped past the flag must never touch freed memory) — the next init
+  // replaces them.
+  s->initialized.store(false);
   s->shutdown.store(true);
   if (s->background.joinable()) s->background.join();
   if (s->timeline) s->timeline->Close();
-  s->controller.reset();
-  s->timeline.reset();
-  s->params.reset();
-  s->stall.reset();
-  s->cache.reset();
-  s->groups.reset();
-  s->queue.reset();
+  s->loop_dead.store(false);
   s->exec_cb = nullptr;
   {
     std::lock_guard<std::mutex> nlk(s->names_mu);

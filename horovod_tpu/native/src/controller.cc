@@ -6,6 +6,14 @@
 
 namespace hvdtpu {
 
+namespace {
+// Entries are identified by (name, process_set) everywhere — matching the
+// duplicate check in c_api.cc; name alone would collide across sets.
+std::string Key(const std::string& name, int32_t process_set) {
+  return name + '\x1f' + std::to_string(process_set);
+}
+}  // namespace
+
 bool Controller::RunLoopOnce() {
   // 1. drain newly submitted entries (reference: PopMessagesFromQueue)
   auto newly = queue_->PopAll();
@@ -14,7 +22,7 @@ bool Controller::RunLoopOnce() {
       timeline_->ActivityStart(e.name, "QUEUE");
     stall_->RecordPending(e);
     cache_->Lookup(e);  // warm the signature cache (stats + LRU order)
-    pending_.emplace(e.name, e);
+    pending_.emplace(Key(e.name, e.process_set_id), e);
   }
 
   // 2. report to the coordinator (reference: SendReadyTensors)
@@ -27,10 +35,11 @@ bool Controller::RunLoopOnce() {
       std::vector<TensorTableEntry> reqs;
       if (!wire::DecodeEntryList(gathered[r], &reqs)) continue;
       for (auto& e : reqs) {
-        auto it = coord_table_.find(e.name);
+        auto it = coord_table_.find(Key(e.name, e.process_set_id));
         if (it == coord_table_.end()) {
           it = coord_table_
-                   .emplace(e.name, PendingCoord{e, {}, order_counter_++})
+                   .emplace(Key(e.name, e.process_set_id),
+                            PendingCoord{e, {}, order_counter_++})
                    .first;
         }
         it->second.reported.insert(r);
@@ -41,6 +50,30 @@ bool Controller::RunLoopOnce() {
 
   // 4. broadcast the response list (reference: SendFinalTensors)
   payload = transport_->BcastResponseList(payload);
+  if (transport_->failed()) {
+    // peer died mid-negotiation: fail every pending entry so waiters get
+    // HorovodInternalError — the elastic recovery signal (SURVEY.md §5.3)
+    Response err;
+    err.error = "negotiation transport failed (peer died or disconnected)";
+    std::vector<int64_t> ids;
+    for (auto& [key, e] : pending_) {
+      err.names.push_back(e.name);
+      err.shapes.push_back(e.shape);
+      ids.push_back(e.id);
+      stall_->RecordDone(e.name);
+    }
+    pending_.clear();
+    if (!ids.empty()) {
+      executor_(err, ids);
+      logger_(2, "negotiation transport failed with collectives in flight; "
+                 "background loop stopping");
+    } else {
+      // idle teardown: a peer simply exited first — not an error
+      logger_(1, "peer closed the negotiation channel; "
+                 "background loop stopping");
+    }
+    return false;
+  }
   std::vector<Response> responses;
   wire::DecodeResponseList(payload, &responses);
 
@@ -50,7 +83,7 @@ bool Controller::RunLoopOnce() {
     std::vector<int64_t> local_ids;
     local_ids.reserve(resp.names.size());
     for (size_t i = 0; i < resp.names.size(); ++i) {
-      auto it = pending_.find(resp.names[i]);
+      auto it = pending_.find(Key(resp.names[i], resp.process_set_id));
       if (it == pending_.end()) {
         local_ids.push_back(-1);  // joined rank: zero contribution
       } else {
@@ -80,6 +113,18 @@ bool Controller::RunLoopOnce() {
                    " submitted on this rank but not yet executed "
                    "(waiting on peers?)");
   if (shutdown) {
+    // fail everything in flight so waiters raise instead of hanging
+    Response err;
+    err.error = "stall shutdown threshold exceeded";
+    std::vector<int64_t> ids;
+    for (auto& [key, e] : pending_) {
+      err.names.push_back(e.name);
+      err.shapes.push_back(e.shape);
+      ids.push_back(e.id);
+      stall_->RecordDone(e.name);
+    }
+    pending_.clear();
+    if (!ids.empty()) executor_(err, ids);
     logger_(2, "stall shutdown threshold exceeded; aborting background loop");
     return false;
   }
@@ -158,12 +203,12 @@ std::vector<Response> Controller::BuildResponses() {
       out.push_back(std::move(r));
       bucket_bytes = e.NumBytes();
     }
-    emitted.push_back(e.name);
+    emitted.push_back(Key(e.name, e.process_set_id));
     // a group's members emit atomically in one cycle, so the group id is
     // dead after emission — free it (GroupTable otherwise grows per step)
     if (e.group_id >= 0) groups_->Forget(e.group_id);
   }
-  for (const auto& name : emitted) coord_table_.erase(name);
+  for (const auto& key : emitted) coord_table_.erase(key);
   return out;
 }
 

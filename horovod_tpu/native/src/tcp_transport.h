@@ -1,0 +1,213 @@
+// TCP star transport: cross-process negotiation channel.
+//
+// Reference parity: the Gloo controller's rendezvous + gather/bcast
+// (horovod/common/gloo/gloo_controller.cc, SURVEY.md §2.1): rank 0 is the
+// coordinator; every cycle non-roots send their encoded request lists and
+// receive the fused response list back.  Where the reference rendezvouses
+// through an HTTP KV store hosted by the launcher, this transport dials a
+// socket the tpurun launcher allocated (HVD_TPU_NATIVE_PORT) — same
+// topology, one fewer moving part.  Loopback RTT ~100us against a 1ms
+// cycle keeps negotiation off the critical path.
+//
+// POSIX sockets only; failures poison the transport and surface as
+// HorovodInternalError on the Python side (the elastic recovery signal,
+// SURVEY.md §5.3).
+#pragma once
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "transport.h"
+
+namespace hvdtpu {
+
+class TcpTransport : public Transport {
+ public:
+  // rank 0 binds+listens on port and accepts size-1 peers; others connect
+  // with retry until timeout (rendezvous races with process startup).
+  TcpTransport(const std::string& host, int port, int rank, int size,
+               double timeout_sec = 60.0)
+      : rank_(rank), size_(size) {
+    if (rank == 0) {
+      AcceptPeers(port, timeout_sec);
+    } else {
+      ConnectToRoot(host, port, timeout_sec);
+    }
+  }
+
+  ~TcpTransport() override {
+    for (int fd : peer_fds_)
+      if (fd >= 0) ::close(fd);
+    if (root_fd_ >= 0) ::close(root_fd_);
+    if (listen_fd_ >= 0) ::close(listen_fd_);
+  }
+
+  int rank() const override { return rank_; }
+  int size() const override { return size_; }
+  bool failed() const override { return failed_; }
+
+  std::vector<std::string> GatherRequests(const std::string& mine) override {
+    if (failed_) return {};
+    if (rank_ == 0) {
+      std::vector<std::string> all(size_);
+      all[0] = mine;
+      for (int r = 1; r < size_; ++r)
+        if (!ReadFrame(peer_fds_[r], &all[r])) {
+          failed_ = true;
+          return {};
+        }
+      return all;
+    }
+    if (!WriteFrame(root_fd_, mine)) failed_ = true;
+    return {};
+  }
+
+  std::string BcastResponseList(const std::string& payload) override {
+    if (failed_) return {};
+    if (rank_ == 0) {
+      for (int r = 1; r < size_; ++r)
+        if (!WriteFrame(peer_fds_[r], payload)) {
+          failed_ = true;
+          return {};
+        }
+      return payload;
+    }
+    std::string out;
+    if (!ReadFrame(root_fd_, &out)) {
+      failed_ = true;
+      return {};
+    }
+    return out;
+  }
+
+ private:
+  void AcceptPeers(int port, double timeout_sec) {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = INADDR_ANY;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr),
+               sizeof(addr)) != 0 ||
+        ::listen(listen_fd_, size_) != 0) {
+      failed_ = true;
+      return;
+    }
+    peer_fds_.assign(size_, -1);
+    auto deadline = Clock::now() +
+                    std::chrono::duration_cast<Clock::duration>(
+                        std::chrono::duration<double>(timeout_sec));
+    for (int accepted = 0; accepted < size_ - 1;) {
+      if (Clock::now() > deadline) {
+        failed_ = true;
+        return;
+      }
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) continue;
+      SetNoDelay(fd);
+      int32_t peer_rank = -1;
+      if (!ReadAll(fd, &peer_rank, 4) || peer_rank <= 0 ||
+          peer_rank >= size_) {
+        ::close(fd);
+        continue;
+      }
+      peer_fds_[peer_rank] = fd;
+      ++accepted;
+    }
+  }
+
+  void ConnectToRoot(const std::string& host, int port, double timeout_sec) {
+    auto deadline = Clock::now() +
+                    std::chrono::duration_cast<Clock::duration>(
+                        std::chrono::duration<double>(timeout_sec));
+    while (Clock::now() < deadline) {
+      addrinfo hints{}, *res = nullptr;
+      hints.ai_family = AF_INET;
+      hints.ai_socktype = SOCK_STREAM;
+      if (::getaddrinfo(host.c_str(), std::to_string(port).c_str(), &hints,
+                        &res) != 0 ||
+          res == nullptr) {
+        std::this_thread::sleep_for(std::chrono::milliseconds(100));
+        continue;
+      }
+      int fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+      if (fd >= 0 && ::connect(fd, res->ai_addr, res->ai_addrlen) == 0) {
+        ::freeaddrinfo(res);
+        SetNoDelay(fd);
+        int32_t my_rank = rank_;
+        if (WriteAll(fd, &my_rank, 4)) {
+          root_fd_ = fd;
+          return;
+        }
+        ::close(fd);
+        failed_ = true;
+        return;
+      }
+      if (fd >= 0) ::close(fd);
+      ::freeaddrinfo(res);
+      std::this_thread::sleep_for(std::chrono::milliseconds(100));
+    }
+    failed_ = true;
+  }
+
+  static void SetNoDelay(int fd) {
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  }
+
+  static bool ReadAll(int fd, void* buf, size_t n) {
+    char* p = static_cast<char*>(buf);
+    while (n > 0) {
+      ssize_t got = ::recv(fd, p, n, 0);
+      if (got <= 0) return false;
+      p += got;
+      n -= static_cast<size_t>(got);
+    }
+    return true;
+  }
+
+  static bool WriteAll(int fd, const void* buf, size_t n) {
+    const char* p = static_cast<const char*>(buf);
+    while (n > 0) {
+      ssize_t sent = ::send(fd, p, n, MSG_NOSIGNAL);
+      if (sent <= 0) return false;
+      p += sent;
+      n -= static_cast<size_t>(sent);
+    }
+    return true;
+  }
+
+  static bool ReadFrame(int fd, std::string* out) {
+    uint32_t len = 0;
+    if (!ReadAll(fd, &len, 4) || len > (256u << 20)) return false;
+    out->resize(len);
+    return len == 0 || ReadAll(fd, out->data(), len);
+  }
+
+  static bool WriteFrame(int fd, const std::string& payload) {
+    uint32_t len = static_cast<uint32_t>(payload.size());
+    if (!WriteAll(fd, &len, 4)) return false;
+    return payload.empty() || WriteAll(fd, payload.data(), payload.size());
+  }
+
+  int rank_;
+  int size_;
+  int listen_fd_ = -1;
+  int root_fd_ = -1;
+  std::vector<int> peer_fds_;
+  bool failed_ = false;
+};
+
+}  // namespace hvdtpu

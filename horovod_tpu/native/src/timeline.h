@@ -72,15 +72,39 @@ class Timeline {
     return buf;
   }
 
+  static std::string JsonEscape(const std::string& s) {
+    std::string out;
+    out.reserve(s.size());
+    for (char c : s) {
+      switch (c) {
+        case '"': out += "\\\""; break;
+        case '\\': out += "\\\\"; break;
+        case '\n': out += "\\n"; break;
+        case '\t': out += "\\t"; break;
+        case '\r': out += "\\r"; break;
+        default:
+          if (static_cast<unsigned char>(c) < 0x20) {
+            char buf[8];
+            std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+            out += buf;
+          } else {
+            out += c;
+          }
+      }
+    }
+    return out;
+  }
+
   void Event(const char* ph, const std::string& tensor,
              const std::string& activity) {
     if (!file_) return;
     // tid: stable per-tensor row, like the reference's per-tensor lanes
     auto tid = std::hash<std::string>{}(tensor) % 2147483647;
-    Emit("{\"name\":\"" + activity + "\",\"cat\":\"hvd_tpu\",\"ph\":\"" + ph +
+    Emit("{\"name\":\"" + JsonEscape(activity) +
+         "\",\"cat\":\"hvd_tpu\",\"ph\":\"" + ph +
          "\",\"pid\":" + std::to_string(rank_) + ",\"tid\":" +
          std::to_string(tid) + ",\"ts\":" + NowUs() +
-         ",\"args\":{\"tensor\":\"" + tensor + "\"}}");
+         ",\"args\":{\"tensor\":\"" + JsonEscape(tensor) + "\"}}");
   }
 
   void Emit(std::string record) {

@@ -99,11 +99,11 @@ def _native(tensor=None):
     if ctrl is None or not ctrl.is_native:
         return None
     if tensor is not None:
+        if _contains_tracer(tensor):
+            return None
         from ..native.controller import _DTYPE_TO_ENUM
 
         for l in jax.tree_util.tree_leaves(tensor):
-            if isinstance(l, jax.core.Tracer):
-                return None
             if str(jnp.asarray(l).dtype) not in _DTYPE_TO_ENUM:
                 return None
     return ctrl

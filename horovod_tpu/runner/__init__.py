@@ -1,0 +1,12 @@
+"""Launchers (reference analog: horovod/runner/ — SURVEY.md §2.4).
+
+``tpurun`` replaces ``horovodrun``: it starts one process per host (or N
+local processes for single-host simulation), exports the coordination env
+the same way horovodrun exports HOROVOD_GLOO_RENDEZVOUS_ADDR, and monitors
+children, terminating all on first failure.  The JAX coordination service
+replaces the reference's HTTP rendezvous store; there is no NIC-probing
+driver/task RPC layer because TPU pods have a known, homogeneous network
+(SURVEY.md §5.8).
+"""
+
+from .launch import run, run_commandline  # noqa: F401

@@ -1,0 +1,6 @@
+"""``python -m horovod_tpu.runner`` == tpurun (reference:
+``python -m horovod.runner`` alias for horovodrun)."""
+
+from .launch import main
+
+main()

@@ -1,0 +1,305 @@
+"""tpurun: the launcher CLI.
+
+Reference parity: horovod/runner/launch.py + gloo_run.py (SURVEY.md §2.4,
+§3.3): parse -np/-H/--hostfile/knob flags/--config-file, start one worker
+process per slot with the coordination env exported, monitor, and kill
+everything on first failure.  Differences, by TPU design:
+
+  * rendezvous = the JAX coordination service (workers call
+    ``jax.distributed.initialize`` against HVD_TPU_COORDINATOR), replacing
+    the launcher-hosted HTTP KV store;
+  * no NIC-probing driver/task RPC layer (SURVEY.md §2.4 "driver/task
+    bootstrap") — TPU pod networking is known and homogeneous;
+  * remote hosts are reached with plain ssh like the reference's gloo_run,
+    one process per host (a TPU host drives all its local chips).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .config_parser import config_to_env, load_config_file
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def parse_host_spec(spec: str) -> List[Tuple[str, int]]:
+    """'h1:4,h2:4' -> [(h1, 4), (h2, 4)] (reference: runner/hosts.py)."""
+    hosts = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:
+            name, slots = part.rsplit(":", 1)
+            hosts.append((name, int(slots)))
+        else:
+            hosts.append((part, 1))
+    return hosts
+
+
+def parse_hostfile(path: str) -> List[Tuple[str, int]]:
+    """One 'host slots=N' per line (reference: --hostfile format)."""
+    hosts = []
+    with open(path) as f:
+        for line in f:
+            line = line.split("#")[0].strip()
+            if not line:
+                continue
+            fields = line.split()
+            slots = 1
+            for fld in fields[1:]:
+                if fld.startswith("slots="):
+                    slots = int(fld.split("=", 1)[1])
+            hosts.append((fields[0], slots))
+    return hosts
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpurun",
+        description="Launch a distributed training job "
+                    "(horovodrun-compatible surface, TPU backend).",
+    )
+    p.add_argument("-np", "--num-proc", type=int, default=None,
+                   help="total number of worker processes")
+    p.add_argument("-H", "--hosts", default=None,
+                   help="comma-separated host:slots list")
+    p.add_argument("--hostfile", default=None,
+                   help="file with one 'host slots=N' per line")
+    p.add_argument("--config-file", default=None,
+                   help="YAML file of knob settings (reference format)")
+    p.add_argument("--ssh-port", type=int, default=None)
+    p.add_argument("--output-filename", default=None,
+                   help="redirect each rank's output to <file>.rank")
+    p.add_argument("--verbose", action="store_true")
+    p.add_argument("--check-build", action="store_true",
+                   help="print build capabilities and exit")
+    p.add_argument("--disable-native", action="store_true",
+                   help="force the Python fallback controller")
+    # knob flags (reference: horovodrun's tunable flags; see config_parser)
+    p.add_argument("--fusion-threshold", dest="fusion_threshold", type=int)
+    p.add_argument("--cycle-time-ms", dest="cycle_time_ms", type=float)
+    p.add_argument("--cache-capacity", dest="cache_capacity", type=int)
+    p.add_argument("--timeline-filename", dest="timeline_filename")
+    p.add_argument("--timeline-mark-cycles", dest="timeline_mark_cycles",
+                   action="store_const", const=True)
+    p.add_argument("--no-stall-check", dest="stall_check_disable",
+                   action="store_const", const=True)
+    p.add_argument("--stall-warning-time", dest="stall_warning_time_seconds",
+                   type=float)
+    p.add_argument("--stall-shutdown-time",
+                   dest="stall_shutdown_time_seconds", type=float)
+    p.add_argument("--autotune", dest="autotune", action="store_const",
+                   const=True)
+    p.add_argument("--autotune-log", dest="autotune_log")
+    p.add_argument("--log-level", dest="log_level")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="the training command, e.g. python train.py")
+    return p
+
+
+def check_build() -> str:
+    """Reference: horovodrun --check-build output."""
+    import horovod_tpu
+
+    from ..native import _lib_path, _maybe_build
+
+    _maybe_build()
+    native = os.path.exists(_lib_path())
+    lines = [
+        f"horovod_tpu v{horovod_tpu.__version__}",
+        "",
+        "Available backends:",
+        "    [X] XLA (ICI/DCN collectives)",
+        f"    [{'X' if native else ' '}] native C++ controller core",
+        "",
+        "Available integrations:",
+        "    [X] JAX / optax",
+        "    [X] PyTorch (CPU bridge)" if _torch_available() else
+        "    [ ] PyTorch (CPU bridge)",
+        "    [ ] TensorFlow (not present in this environment)",
+    ]
+    return "\n".join(lines)
+
+
+def _torch_available() -> bool:
+    try:
+        import torch  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def _worker_env(base: Dict[str, str], knob_env: Dict[str, str],
+                coordinator: str, native_port: int, num_proc: int,
+                rank: int, disable_native: bool) -> Dict[str, str]:
+    env = dict(base)
+    env.update(knob_env)
+    env["HVD_TPU_COORDINATOR"] = coordinator
+    # second port for the native controller's TCP negotiation star
+    # (reference analog: the Gloo rendezvous port horovodrun exports)
+    env["HVD_TPU_NATIVE_PORT"] = str(native_port)
+    env["HVD_TPU_NUM_PROCESSES"] = str(num_proc)
+    env["HVD_TPU_PROCESS_ID"] = str(rank)
+    if disable_native:
+        env["HVD_TPU_DISABLE_NATIVE"] = "1"
+    return env
+
+
+def _launch_local(command: List[str], num_proc: int,
+                  knob_env: Dict[str, str], output_filename: Optional[str],
+                  verbose: bool, disable_native: bool) -> int:
+    """Single-host launch: np processes on localhost, lockstep monitored.
+    Reference: gloo_run's local exec path + exit-code monitoring."""
+    coordinator = f"127.0.0.1:{_free_port()}"
+    native_port = _free_port()
+    procs: List[subprocess.Popen] = []
+    outputs = []
+    try:
+        for rank in range(num_proc):
+            env = _worker_env(os.environ.copy(), knob_env, coordinator,
+                              native_port, num_proc, rank, disable_native)
+            stdout = stderr = None
+            if output_filename:
+                f = open(f"{output_filename}.{rank}", "w")
+                outputs.append(f)
+                stdout = stderr = f
+            if verbose:
+                print(f"[tpurun] rank {rank}: {' '.join(command)}",
+                      file=sys.stderr)
+            procs.append(subprocess.Popen(
+                command, env=env, stdout=stdout, stderr=stderr
+            ))
+        # monitor: first nonzero exit kills the job (reference behavior)
+        while True:
+            codes = [p.poll() for p in procs]
+            for rank, code in enumerate(codes):
+                if code is not None and code != 0:
+                    print(f"[tpurun] rank {rank} exited with {code}; "
+                          "terminating remaining workers", file=sys.stderr)
+                    for p in procs:
+                        if p.poll() is None:
+                            p.terminate()
+                    return code
+            if all(c == 0 for c in codes):
+                return 0
+            time.sleep(0.1)
+    except KeyboardInterrupt:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGINT)
+        return 130
+    finally:
+        for f in outputs:
+            f.close()
+
+
+def _launch_ssh(command: List[str], hosts: List[Tuple[str, int]],
+                num_proc: int, knob_env: Dict[str, str],
+                ssh_port: Optional[int], verbose: bool,
+                disable_native: bool) -> int:
+    """Multi-host launch over ssh, one process per host slot (reference:
+    gloo_run.py's ssh exec).  The first host runs rank 0 and hosts the
+    coordination service."""
+    coord_host = hosts[0][0]
+    coordinator = f"{coord_host}:{_free_port()}"
+    native_port = _free_port()
+    procs: List[subprocess.Popen] = []
+    rank = 0
+    for host, slots in hosts:
+        for _ in range(slots):
+            if rank >= num_proc:
+                break
+            env = _worker_env({}, knob_env, coordinator, native_port,
+                              num_proc, rank, disable_native)
+            env_prefix = " ".join(
+                f"{k}={subprocess.list2cmdline([v])}" for k, v in env.items()
+            )
+            remote_cmd = f"cd {os.getcwd()} && {env_prefix} " + \
+                subprocess.list2cmdline(command)
+            ssh_cmd = ["ssh", "-o", "StrictHostKeyChecking=no"]
+            if ssh_port:
+                ssh_cmd += ["-p", str(ssh_port)]
+            ssh_cmd += [host, remote_cmd]
+            if verbose:
+                print(f"[tpurun] rank {rank} on {host}", file=sys.stderr)
+            procs.append(subprocess.Popen(ssh_cmd))
+            rank += 1
+    code = 0
+    for p in procs:
+        rc = p.wait()
+        code = code or rc
+    return code
+
+
+def run_commandline(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.check_build:
+        print(check_build())
+        return 0
+    command = args.command
+    if command and command[0] == "--":
+        command = command[1:]
+    if not command:
+        print("tpurun: no command given (e.g. tpurun -np 4 python train.py)",
+              file=sys.stderr)
+        return 2
+
+    config = load_config_file(args.config_file) if args.config_file else {}
+    knob_env = config_to_env(args, config)
+
+    if args.hostfile:
+        hosts = parse_hostfile(args.hostfile)
+    elif args.hosts:
+        hosts = parse_host_spec(args.hosts)
+    else:
+        hosts = [("localhost", args.num_proc or 1)]
+    total_slots = sum(s for _, s in hosts)
+    num_proc = args.num_proc or total_slots
+    if num_proc > total_slots:
+        print(f"tpurun: requested -np {num_proc} but only {total_slots} "
+              "slots available", file=sys.stderr)
+        return 2
+
+    local_only = all(h in ("localhost", "127.0.0.1", socket.gethostname())
+                     for h, _ in hosts)
+    if local_only:
+        return _launch_local(command, num_proc, knob_env,
+                             args.output_filename, args.verbose,
+                             args.disable_native)
+    return _launch_ssh(command, hosts, num_proc, knob_env, args.ssh_port,
+                       args.verbose, args.disable_native)
+
+
+def run(command: List[str], np: int = 1, **kwargs) -> int:
+    """Programmatic launcher (reference: horovod.run)."""
+    argv = ["-np", str(np)]
+    for k, v in kwargs.items():
+        flag = "--" + k.replace("_", "-")
+        if isinstance(v, bool):
+            if v:
+                argv.append(flag)
+        else:
+            argv += [flag, str(v)]
+    return run_commandline(argv + ["--"] + list(command))
+
+
+def main() -> None:
+    sys.exit(run_commandline())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,103 @@
+"""Per-rank worker script for launcher integration tests.
+
+Reference analog: the body of a test/parallel/test_*.py file — the same
+script runs on every rank under the launcher and asserts collective
+results against locally computed expectations (SURVEY.md §4).  Exercises
+the REAL multi-process path: jax.distributed rendezvous + the eager engine
+over a cross-process device mesh.
+"""
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+
+
+def main():
+    hvd.init()
+    size = hvd.size()
+    rank = hvd.rank()
+    nproc = hvd.cross_size()
+    assert nproc == int(sys.argv[1]), (nproc, sys.argv)
+    assert size >= nproc
+    assert 0 <= rank < size
+
+    # allreduce: average of per-process values
+    out = hvd.allreduce(jnp.asarray([float(hvd.cross_rank())]))
+    expected = np.mean(np.arange(nproc))
+    np.testing.assert_allclose(np.asarray(out), [expected], rtol=1e-6)
+
+    # sum + scaling factors
+    out = hvd.allreduce(jnp.ones((4,)), op=hvd.Sum, prescale_factor=2.0)
+    np.testing.assert_allclose(np.asarray(out), np.full(4, 2.0 * nproc))
+
+    # pytree fusion across a dict
+    tree = {"a": jnp.full((3,), float(hvd.cross_rank())),
+            "b": jnp.ones((2, 2))}
+    out = hvd.allreduce(tree, op=hvd.Sum)
+    np.testing.assert_allclose(
+        np.asarray(out["a"]), np.full(3, float(sum(range(nproc))))
+    )
+
+    # allgather: concat along dim 0 in rank order
+    mine = jnp.full((2, 2), float(hvd.cross_rank()))
+    gathered = hvd.allgather(mine)
+    assert gathered.shape == (2 * nproc, 2)
+    for p in range(nproc):
+        np.testing.assert_allclose(
+            np.asarray(gathered[2 * p:2 * p + 2]), np.full((2, 2), float(p))
+        )
+
+    # broadcast from the last process's lead chip
+    root = size - hvd.local_size()  # lead device rank of last process
+    out = hvd.broadcast(jnp.full((3,), float(hvd.cross_rank())), root)
+    np.testing.assert_allclose(np.asarray(out), np.full(3, float(nproc - 1)))
+
+    # alltoall with even splits
+    send = jnp.arange(nproc * 2, dtype=jnp.float32) + 100 * hvd.cross_rank()
+    received, splits = hvd.alltoall(send)
+    assert received.shape == (nproc * 2,)
+    for p in range(nproc):
+        np.testing.assert_allclose(
+            np.asarray(received[2 * p:2 * p + 2]),
+            100.0 * p + 2 * hvd.cross_rank() + np.arange(2),
+        )
+
+    # reducescatter: my chunk of the sum
+    full = jnp.arange(nproc * 3, dtype=jnp.float32)
+    chunk = hvd.reducescatter(full, op=hvd.Sum)
+    me = hvd.cross_rank()
+    np.testing.assert_allclose(
+        np.asarray(chunk), nproc * np.arange(me * 3, me * 3 + 3)
+    )
+
+    # object plumbing
+    objs = hvd.allgather_object({"rank": hvd.cross_rank()})
+    assert [o["rank"] for o in objs] == list(range(nproc))
+    obj = hvd.broadcast_object({"x": 42} if rank == 0 else None, 0)
+    assert obj == {"x": 42}
+
+    # broadcast_parameters + eager DistributedOptimizer step parity
+    params = {"w": jnp.full((4,), float(hvd.cross_rank()))}
+    params = hvd.broadcast_parameters(params, root_rank=0)
+    np.testing.assert_allclose(np.asarray(params["w"]), np.zeros(4))
+    opt = hvd.DistributedOptimizer(optax.sgd(1.0))
+    state = opt.init(params)
+    grads = {"w": jnp.full((4,), float(hvd.cross_rank()))}
+    updates, _ = opt.update(grads, state, params)
+    new_params = optax.apply_updates(params, updates)
+    np.testing.assert_allclose(
+        np.asarray(new_params["w"]), -np.full(4, np.mean(np.arange(nproc)))
+    )
+
+    hvd.barrier()
+    print(f"WORKER_OK rank={rank} nproc={nproc} native={hvd.native_built()}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,108 @@
+"""Launcher + multi-process integration tests.
+
+Reference analog: test/integration/test_static_run.py (end-to-end
+horovodrun on localhost) and the multi-node-without-a-cluster technique of
+SURVEY.md §4: N real processes on one box, rendezvous over loopback — here
+the JAX coordination service instead of the Gloo HTTP store.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+import horovod_tpu.runner.launch as launch
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "integration", "launcher_worker.py")
+
+
+def _run_tpurun(np_, extra=None, timeout=180):
+    env = os.environ.copy()
+    env["PALLAS_AXON_POOL_IPS"] = ""  # force CPU in children
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)  # one CPU device per process
+    cmd = [
+        sys.executable, "-m", "horovod_tpu.runner",
+        "-np", str(np_), *(extra or []), "--",
+        sys.executable, WORKER, str(np_),
+    ]
+    return subprocess.run(
+        cmd, env=env, capture_output=True, text=True, timeout=timeout,
+        cwd=REPO,
+    )
+
+
+def test_host_parsing():
+    assert launch.parse_host_spec("h1:4,h2:2") == [("h1", 4), ("h2", 2)]
+    assert launch.parse_host_spec("h1") == [("h1", 1)]
+
+
+def test_hostfile_parsing(tmp_path):
+    f = tmp_path / "hosts"
+    f.write_text("# comment\nnode1 slots=8\nnode2 slots=4\n")
+    assert launch.parse_hostfile(str(f)) == [("node1", 8), ("node2", 4)]
+
+
+def test_check_build():
+    out = launch.check_build()
+    assert "XLA" in out and "horovod_tpu" in out
+
+
+def test_config_file_to_env(tmp_path):
+    import yaml
+
+    from horovod_tpu.runner.config_parser import (
+        config_to_env, load_config_file,
+    )
+
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text(yaml.safe_dump({
+        "fusion_threshold": 1234, "autotune": True, "log_level": "debug",
+    }))
+    args = launch.build_parser().parse_args(
+        ["--cycle-time-ms", "2.5", "--", "true"]
+    )
+    env = config_to_env(args, load_config_file(str(cfg)))
+    assert env["HVD_TPU_FUSION_THRESHOLD"] == "1234"
+    assert env["HVD_TPU_AUTOTUNE"] == "1"
+    assert env["HVD_TPU_CYCLE_TIME"] == "2.5"  # CLI wins layering intact
+    assert env["HVD_TPU_LOG_LEVEL"] == "debug"
+
+
+def test_np_exceeding_slots_rejected(capsys):
+    rc = launch.run_commandline(["-np", "4", "-H", "localhost:2", "--",
+                                 "true"])
+    assert rc == 2
+
+
+@pytest.mark.parametrize("np_", [2])
+def test_tpurun_multiprocess_collectives(np_):
+    """The big one: np real processes, jax.distributed rendezvous, every
+    eager collective checked cross-process (python fallback controller)."""
+    res = _run_tpurun(np_, extra=["--disable-native"])
+    assert res.returncode == 0, f"stdout:\n{res.stdout}\nstderr:\n{res.stderr}"
+    assert res.stdout.count("WORKER_OK") == np_
+
+
+def test_tpurun_failure_propagates():
+    env = os.environ.copy()
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    cmd = [sys.executable, "-m", "horovod_tpu.runner", "-np", "2", "--",
+           sys.executable, "-c", "import sys; sys.exit(3)"]
+    res = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                         timeout=120, cwd=REPO)
+    assert res.returncode == 3
+
+
+def test_tpurun_multiprocess_native_controller():
+    """Same per-rank assertions with the C++ controller negotiating over
+    its TCP star (reference analog: the gloo-controller path of
+    test_static_run)."""
+    res = _run_tpurun(2)
+    assert res.returncode == 0, f"stdout:\n{res.stdout}\nstderr:\n{res.stderr}"
+    assert res.stdout.count("WORKER_OK") == 2
+    assert "native=True" in res.stdout
