@@ -1,0 +1,88 @@
+"""Elastic MNIST-style training.
+
+Reference parity: examples/elastic/pytorch/pytorch_mnist_elastic.py — the
+commit/restore/sync elastic loop (SURVEY.md §3.4), JAX flavor.
+
+Run:  tpurun -np 2 --min-np 1 --max-np 4 \
+          --host-discovery-script ./discover.sh \
+          python examples/jax/jax_elastic_mnist.py
+where discover.sh prints the current "host:slots" lines.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.models.simple import MLP
+
+
+def main():
+    hvd.init()
+    rng = np.random.RandomState(0)
+    images = rng.randn(4096, 784).astype(np.float32)
+    labels = rng.randint(0, 10, size=(4096,))
+
+    model = MLP(features=(128, 10))
+    variables = model.init(jax.random.PRNGKey(0), jnp.ones((1, 784)))
+    optimizer = optax.sgd(0.05 * hvd.cross_size(), momentum=0.9)
+
+    sampler = hvd.elastic.ElasticSampler(len(images), shuffle=True)
+    state = hvd.elastic.TpuState(
+        params=variables["params"],
+        opt_state=optimizer.init(variables["params"]),
+        sampler=sampler, epoch=0, batch=0,
+    )
+    # rescale the learning rate when the world resizes (reference idiom)
+    state.register_reset_callbacks([lambda: print(
+        f"[rank {hvd.rank()}] world resized to {hvd.cross_size()}")])
+
+    @jax.jit
+    def train_step(params, opt_state, x, y):
+        def loss_fn(p):
+            logits = model.apply({"params": p}, x)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    batch_size = 32
+
+    @hvd.elastic.run
+    def train(state):
+        while state.epoch < 3:
+            state.sampler.set_epoch(state.epoch)
+            indices = list(state.sampler)
+            while state.batch * batch_size < len(indices):
+                lo = state.batch * batch_size
+                idx = indices[lo:lo + batch_size]
+                if not idx:
+                    break
+                x, y = jnp.asarray(images[idx]), jnp.asarray(labels[idx])
+                params, opt_state, loss = train_step(
+                    state.params, state.opt_state, x, y)
+                # gradients are per-shard; average the step's result via
+                # the eager path (small model; big models: shard_map step)
+                state.params = hvd.allreduce(params)
+                state.opt_state = jax.tree_util.tree_map(
+                    lambda a: hvd.allreduce(a) if hasattr(a, "dtype") and
+                    jnp.issubdtype(a.dtype, jnp.floating) else a, opt_state)
+                state.sampler.record_batch(state.batch, batch_size)
+                state.batch += 1
+                if state.batch % 8 == 0:
+                    state.commit()
+            state.batch = 0
+            state.epoch += 1
+            state.commit()
+            if hvd.rank() == 0:
+                print(f"epoch {state.epoch} done "
+                      f"(world={hvd.cross_size()}, loss={float(loss):.3f})")
+
+    train(state)
+
+
+if __name__ == "__main__":
+    main()

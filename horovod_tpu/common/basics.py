@@ -57,6 +57,13 @@ def _maybe_init_distributed() -> None:
     HVD_TPU_PROCESS_ID (SURVEY.md §3.3's env-plumbing step); on managed TPU
     pods ``jax.distributed.initialize()`` auto-detects and these are unset.
     """
+    if os.environ.get("HVD_TPU_ELASTIC") in ("1", "true"):
+        # elastic workers are spawned with only the driver's address; the
+        # world shape (rank/size/coordinator) always comes from a driver
+        # rendezvous (reference: §3.4 elastic rendezvous hands out ranks)
+        from ..elastic import worker as _elastic_worker
+
+        _elastic_worker.ensure_assignment()
     coord = os.environ.get("HVD_TPU_COORDINATOR")
     if not coord:
         return
@@ -70,9 +77,56 @@ def _maybe_init_distributed() -> None:
     pid = int(os.environ["HVD_TPU_PROCESS_ID"])
     if num <= 1:
         return
+    kwargs = {}
+    if os.environ.get("HVD_TPU_ELASTIC") in ("1", "true"):
+        # elastic mode: fail fast instead of blocking on dead peers — the
+        # shutdown barrier must give up well before the heartbeat watchdog
+        # would kill the surviving process (reference analog: NCCL abort
+        # timeouts in the elastic error path, SURVEY.md §5.3)
+        kwargs["heartbeat_timeout_seconds"] = int(
+            os.environ.get("HVD_TPU_HEARTBEAT_TIMEOUT", "30")
+        )
+        kwargs["shutdown_timeout_seconds"] = int(
+            os.environ.get("HVD_TPU_SHUTDOWN_TIMEOUT", "8")
+        )
     jax.distributed.initialize(
-        coordinator_address=coord, num_processes=num, process_id=pid
+        coordinator_address=coord, num_processes=num, process_id=pid,
+        **kwargs,
     )
+    _register_early_distributed_shutdown()
+
+
+_early_shutdown_registered = False
+
+
+def _register_early_distributed_shutdown() -> None:
+    """Run the coordination-service shutdown barrier at the EARLIEST exit
+    phase (threading._register_atexit fires before regular atexit
+    handlers and before non-daemon thread joins).
+
+    Why: jax's own atexit shutdown can deadlock the whole job when any
+    rank blocks in an earlier-registered finalizer before reaching the
+    barrier — observed whenever an eager collective ever executed on a
+    non-main thread (e.g. the torch adapter's grad hooks running on
+    autograd worker threads).  Running the barrier first, while the
+    process is still fully alive, sidesteps the ordering problem; jax's
+    later atexit then sees a shut-down client and no-ops.
+    """
+    global _early_shutdown_registered
+    if _early_shutdown_registered:
+        return
+    _early_shutdown_registered = True
+
+    def _early_shutdown():
+        try:
+            from jax._src import distributed as _jd
+
+            if getattr(_jd.global_state, "client", None) is not None:
+                jax.distributed.shutdown()
+        except Exception as e:
+            get_logger().info("early distributed shutdown raised (%s)", e)
+
+    threading._register_atexit(_early_shutdown)
 
 
 def init(devices: Optional[Sequence] = None) -> None:

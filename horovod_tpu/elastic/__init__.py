@@ -1,0 +1,83 @@
+"""Elastic training: fault-tolerant, resizable worker sets.
+
+Reference parity: horovod/common/elastic.py + horovod/torch/elastic/* +
+horovod/runner/elastic/* (SURVEY.md §3.4, §5.3).  Usage mirrors the
+reference exactly::
+
+    import horovod_tpu as hvd
+    hvd.init()
+
+    state = hvd.elastic.TpuState(params=params, opt_state=opt_state,
+                                 epoch=0, batch=0)
+
+    @hvd.elastic.run
+    def train(state):
+        for state.epoch in range(state.epoch, num_epochs):
+            ...
+            state.batch = i
+            if i % 10 == 0:
+                state.commit()
+
+    train(state)
+
+Launch with ``tpurun -np 2 --min-np 1 --max-np 4
+--host-discovery-script ./discover.sh python train.py``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+from ..common.exceptions import HorovodInternalError, HostsUpdatedInterrupt
+from .sampler import ElasticSampler
+from .state import ObjectState, State, TpuState
+from .worker import clean_shutdown, elastic_enabled, \
+    maybe_restore_after_restart, notification_manager, reset_world, \
+    restart_after_failure
+
+__all__ = [
+    "State", "ObjectState", "TpuState", "ElasticSampler", "run",
+    "HorovodInternalError", "HostsUpdatedInterrupt",
+]
+
+
+def run(func):
+    """Elastic execution wrapper (reference: common/elastic.py run_fn —
+    the sync/try/catch/reset loop of SURVEY.md §3.4)."""
+
+    @functools.wraps(func)
+    def wrapper(state, *args, **kwargs):
+        notification_manager.init()
+        maybe_restore_after_restart(state)
+        skip_sync = False
+        while True:
+            if not skip_sync:
+                state.sync()
+            try:
+                result = func(state, *args, **kwargs)
+                if elastic_enabled():
+                    # leave the coordination service in lockstep rather
+                    # than from interpreter-exit finalizers (see
+                    # worker.clean_shutdown)
+                    clean_shutdown()
+                return result
+            except HorovodInternalError:
+                # a peer died mid-collective: roll back to the last commit
+                state.restore()
+                if not elastic_enabled():
+                    # no driver to re-rendezvous with: surface the
+                    # original failure with the state restored
+                    raise
+                restart_after_failure(state)  # does not return
+                skip_sync = False
+            except HostsUpdatedInterrupt as e:
+                # membership change: keep current state.  If it was caused
+                # by a peer failure, the coordination service can't be
+                # torn down gracefully — take the restart path with the
+                # live state snapshot instead
+                if getattr(e, "due_to_failure", False) and elastic_enabled():
+                    restart_after_failure(state)  # does not return
+                skip_sync = e.skip_sync
+            reset_world(state)
+
+    return wrapper

@@ -1,0 +1,176 @@
+"""Elastic state: commit / restore / sync.
+
+Reference parity: horovod/common/elastic.py (State, ObjectState) and
+horovod/torch/elastic/state.py (TorchState) — SURVEY.md §5.3.  The contract
+is identical: the user registers everything that must survive a membership
+change in a ``State``; ``commit()`` snapshots it (and polls for membership
+updates); on failure the elastic ``run`` wrapper calls ``restore()`` and
+re-rendezvouses; ``sync()`` broadcasts rank 0's view to everyone after each
+(re)initialization.
+
+TPU-specific twist: a reset tears down and rebuilds the XLA backend (the
+JAX coordination service is re-initialized with the new world — the analog
+of the reference rebuilding its Gloo/NCCL communicators, §3.4), which
+invalidates live ``jax.Array`` objects.  All snapshots are therefore held
+as host (numpy) trees, and live attributes are materialized to host before
+teardown (``_materialize_to_host``).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+
+def _is_jax_array(x) -> bool:
+    import jax
+
+    return isinstance(x, jax.Array)
+
+
+def _to_host(tree: Any) -> Any:
+    """Deep-convert jax arrays inside a pytree-ish value to numpy."""
+    import jax
+
+    def leaf(x):
+        return np.asarray(x) if _is_jax_array(x) else x
+
+    return jax.tree_util.tree_map(leaf, tree)
+
+
+class State:
+    """Abstract elastic state (reference: common/elastic.py State)."""
+
+    def __init__(self):
+        self._reset_callbacks: List[Callable[[], None]] = []
+
+    def register_reset_callbacks(
+        self, callbacks: List[Callable[[], None]]
+    ) -> None:
+        """Callbacks to run after a reset changed the world size
+        (reference: State.register_reset_callbacks — e.g. rescale the
+        learning rate to the new number of workers)."""
+        self._reset_callbacks.extend(callbacks)
+
+    def on_reset(self) -> None:
+        self.reset()
+        for cb in self._reset_callbacks:
+            cb()
+
+    def reset(self) -> None:
+        """Framework hook invoked on world-size change."""
+
+    def commit(self) -> None:
+        """Snapshot + poll for membership updates (reference: State.commit
+        = save() then check_host_updates())."""
+        self.save()
+        self.check_host_updates()
+
+    def check_host_updates(self) -> None:
+        """Raise ``HostsUpdatedInterrupt`` if the driver announced a
+        membership change (reference: State.check_host_updates reading the
+        WorkerNotificationManager queue)."""
+        from .worker import notification_manager
+
+        notification_manager.check_for_updates()
+
+    def save(self) -> None:
+        raise NotImplementedError
+
+    def restore(self) -> None:
+        raise NotImplementedError
+
+    def sync(self) -> None:
+        raise NotImplementedError
+
+    def _materialize_to_host(self) -> None:
+        """Convert live device state to host buffers before backend
+        teardown (TPU-specific; no reference analog needed — NCCL rebuilds
+        did not invalidate framework tensors)."""
+
+
+class ObjectState(State):
+    """State made of arbitrary picklable attributes (reference:
+    common/elastic.py ObjectState).  JAX arrays in attribute values are
+    snapshotted as numpy; objects exposing ``state_dict``/
+    ``load_state_dict`` (e.g. ``ElasticSampler``) are snapshotted through
+    that interface."""
+
+    def __init__(self, **kwargs):
+        super().__init__()
+        self._attrs: Dict[str, Any] = {}
+        for k, v in kwargs.items():
+            self._attrs[k] = v
+        self._saved: Optional[Dict[str, Any]] = None
+        self.save()
+
+    # Attribute routing: user fields live in _attrs so save/restore/sync
+    # can enumerate them.
+    def __getattr__(self, name):
+        attrs = self.__dict__.get("_attrs")
+        if attrs is not None and name in attrs:
+            return attrs[name]
+        raise AttributeError(name)
+
+    def __setattr__(self, name, value):
+        if name.startswith("_") or "_attrs" not in self.__dict__:
+            super().__setattr__(name, value)
+        else:
+            self._attrs[name] = value
+
+    def _snapshot(self) -> Dict[str, Any]:
+        snap = {}
+        for k, v in self._attrs.items():
+            if hasattr(v, "state_dict") and hasattr(v, "load_state_dict"):
+                snap[k] = ("__state_dict__", copy.deepcopy(v.state_dict()))
+            else:
+                snap[k] = ("__value__", copy.deepcopy(_to_host(v)))
+        return snap
+
+    def _apply_snapshot(self, snap: Dict[str, Any]) -> None:
+        for k, (kind, payload) in snap.items():
+            if kind == "__state_dict__" and k in self._attrs:
+                self._attrs[k].load_state_dict(copy.deepcopy(payload))
+            else:
+                self._attrs[k] = copy.deepcopy(payload)
+
+    def save(self) -> None:
+        self._saved = self._snapshot()
+
+    def restore(self) -> None:
+        if self._saved is not None:
+            self._apply_snapshot(self._saved)
+
+    def sync(self) -> None:
+        """Broadcast rank 0's state to all workers (reference:
+        ObjectState.sync via broadcast_object)."""
+        from .. import functions
+
+        snap = functions.broadcast_object(self._snapshot(), root_rank=0)
+        self._apply_snapshot(snap)
+        self.save()
+
+    def _materialize_to_host(self) -> None:
+        for k, v in list(self._attrs.items()):
+            if not (hasattr(v, "state_dict") and
+                    hasattr(v, "load_state_dict")):
+                self._attrs[k] = _to_host(v)
+
+
+class TpuState(ObjectState):
+    """Convenience state for the JAX training loop (reference analog:
+    horovod/torch/elastic/state.py TorchState holding model + optimizer).
+
+    Typical use::
+
+        state = hvd.elastic.TpuState(
+            params=params, opt_state=opt_state, epoch=0, batch=0)
+
+        @hvd.elastic.run
+        def train(state):
+            for state.epoch in range(state.epoch, epochs):
+                ...
+                state.commit()
+    """

@@ -1,0 +1,325 @@
+"""Worker-side elastic plumbing: driver notifications + re-rendezvous.
+
+Reference parity (SURVEY.md §3.4, §2.4): horovod/runner/elastic/worker.py
+(WorkerNotificationService/Manager — the in-worker listener the driver
+pushes ``HostsUpdated`` events to) plus the reset path of
+horovod/common/elastic.py (``_reset``: new rendezvous, rebuilt
+communicators, new rank/size).
+
+Wire protocol (line-delimited JSON over TCP to the driver, replacing the
+reference's pickled-and-HMAC'd socket RPC):
+
+  worker → driver  {"type": "register", "worker_id": k}      (persistent)
+  driver → worker  {"type": "hosts_updated", "epoch": n}     (pushed)
+  worker → driver  {"type": "rendezvous", "worker_id": k}    (fresh conn)
+  driver → worker  {"type": "assignment", "rank": r, "num_processes": n,
+                    "coordinator": "h:p", "native_port": p, "epoch": e}
+               or  {"type": "shutdown"}
+
+The TPU-specific part is ``_reinitialize``: unlike the reference (which
+rebuilds NCCL comms under a live CUDA runtime), changing the world size
+means re-initializing the JAX coordination service and the XLA backend, so
+we tear both down and bring them back up against the new coordinator.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+from typing import Optional
+
+from ..common.exceptions import HorovodInternalError, HostsUpdatedInterrupt
+from ..utils.logging import get_logger
+
+ENV_ELASTIC = "HVD_TPU_ELASTIC"
+ENV_DRIVER = "HVD_TPU_ELASTIC_DRIVER"
+ENV_WORKER_ID = "HVD_TPU_ELASTIC_WORKER_ID"
+ENV_RESTORE = "HVD_TPU_ELASTIC_RESTORE"
+
+_ASSIGNMENT_ENV = (
+    "HVD_TPU_COORDINATOR", "HVD_TPU_NUM_PROCESSES", "HVD_TPU_PROCESS_ID",
+    "HVD_TPU_NATIVE_PORT",
+)
+
+_RENDEZVOUS_TIMEOUT = float(os.environ.get("HVD_TPU_ELASTIC_TIMEOUT", "600"))
+
+
+def elastic_enabled() -> bool:
+    return os.environ.get(ENV_ELASTIC, "0") in ("1", "true")
+
+
+def _driver_addr() -> tuple:
+    host, port = os.environ[ENV_DRIVER].rsplit(":", 1)
+    return host, int(port)
+
+
+def _worker_id() -> int:
+    return int(os.environ[ENV_WORKER_ID])
+
+
+def _free_local_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _send_line(sock: socket.socket, obj: dict) -> None:
+    sock.sendall((json.dumps(obj) + "\n").encode())
+
+
+def _recv_line(f) -> Optional[dict]:
+    line = f.readline()
+    if not line:
+        return None
+    return json.loads(line)
+
+
+class WorkerNotificationManager:
+    """Receives membership-change pushes from the driver (reference:
+    runner/elastic/worker.py WorkerNotificationManager — there a listening
+    service; here an outbound persistent connection, which also gives the
+    driver a liveness channel per worker)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending_epoch: Optional[int] = None
+        self._pending_failure = False
+        self._thread: Optional[threading.Thread] = None
+        self._sock: Optional[socket.socket] = None
+
+    def init(self) -> None:
+        if not elastic_enabled() or self._thread is not None:
+            return
+        sock = socket.create_connection(_driver_addr(), timeout=30)
+        _send_line(sock, {"type": "register", "worker_id": _worker_id()})
+        sock.settimeout(None)
+        self._sock = sock
+        self._thread = threading.Thread(
+            target=self._listen, args=(sock,), daemon=True
+        )
+        self._thread.start()
+
+    def _listen(self, sock: socket.socket) -> None:
+        f = sock.makefile("r")
+        while True:
+            try:
+                msg = _recv_line(f)
+            except OSError:
+                return
+            if msg is None:
+                return
+            if msg.get("type") == "hosts_updated":
+                with self._lock:
+                    self._pending_epoch = msg.get("epoch")
+                    self._pending_failure = bool(msg.get("failure"))
+                get_logger().info(
+                    "elastic: hosts updated (epoch %s, failure=%s)",
+                    msg.get("epoch"), msg.get("failure"),
+                )
+
+    def check_for_updates(self) -> None:
+        """Raise HostsUpdatedInterrupt if an update is pending (reference:
+        State.check_host_updates draining the manager's queue)."""
+        with self._lock:
+            pending = self._pending_epoch
+            failure = self._pending_failure
+        if pending is not None:
+            exc = HostsUpdatedInterrupt()
+            exc.due_to_failure = failure
+            raise exc
+
+    def clear(self) -> None:
+        with self._lock:
+            self._pending_epoch = None
+            self._pending_failure = False
+
+
+notification_manager = WorkerNotificationManager()
+
+
+def rendezvous() -> dict:
+    """Block until the driver hands this worker its assignment for the
+    next epoch (reference: the elastic rendezvous server handing out
+    rank/size on each reset — SURVEY.md §3.4)."""
+    sock = socket.create_connection(
+        _driver_addr(), timeout=_RENDEZVOUS_TIMEOUT
+    )
+    try:
+        _send_line(sock, {"type": "rendezvous", "worker_id": _worker_id()})
+        f = sock.makefile("r")
+        msg = _recv_line(f)
+        if msg is not None and msg.get("type") == "allocate_ports":
+            # we are the rank-0-elect: allocate the epoch's service ports
+            # on THIS host so the binds cannot race a remote probe
+            _send_line(sock, {
+                "type": "ports",
+                "coordinator_port": _free_local_port(),
+                "native_port": _free_local_port(),
+            })
+            msg = _recv_line(f)
+    finally:
+        sock.close()
+    if msg is None:
+        raise HorovodInternalError("elastic driver closed during rendezvous")
+    if msg.get("type") == "shutdown":
+        get_logger().info("elastic: driver requested shutdown")
+        raise SystemExit(0)
+    if msg.get("type") != "assignment":
+        raise HorovodInternalError(f"unexpected rendezvous reply: {msg}")
+    return msg
+
+
+def apply_assignment(msg: dict) -> None:
+    """Export the assignment as the standard launcher env (the same vars
+    tpurun sets — SURVEY.md §3.3 env plumbing) so ``hvd.init()`` picks it
+    up unchanged."""
+    os.environ["HVD_TPU_COORDINATOR"] = msg["coordinator"]
+    os.environ["HVD_TPU_NUM_PROCESSES"] = str(msg["num_processes"])
+    os.environ["HVD_TPU_PROCESS_ID"] = str(msg["rank"])
+    os.environ["HVD_TPU_NATIVE_PORT"] = str(msg["native_port"])
+
+
+def ensure_assignment() -> None:
+    """First-boot hook called from ``hvd.init()``: in elastic mode the
+    spawn env carries only the driver address, so rendezvous for the
+    initial world here (the reference's first Gloo rendezvous in §3.1)."""
+    if not elastic_enabled() or "HVD_TPU_COORDINATOR" in os.environ:
+        return
+    notification_manager.init()
+    apply_assignment(rendezvous())
+
+
+def _teardown_jax() -> None:
+    """Disconnect from the dead/stale coordination service and drop the
+    XLA backend so the next init builds against the new world."""
+    from jax._src import distributed as _dist
+
+    gs = _dist.global_state
+    if gs.preemption_sync_manager is not None:
+        try:
+            gs.preemption_sync_manager.shutdown()
+        except Exception:
+            pass
+        gs.preemption_sync_manager = None
+    if gs.client is not None:
+        try:
+            # bounded by shutdown_timeout_seconds (set short in elastic
+            # init): with a dead peer the shutdown barrier fails fast and
+            # we fall through to a forced disconnect
+            gs.client.shutdown()
+        except Exception as e:
+            get_logger().info(
+                "elastic: client shutdown raised (%s); forcing disconnect",
+                e,
+            )
+        gs.client = None
+    if gs.service is not None:
+        # rank 0 hosted the old coordination service; with dead peers a
+        # graceful service shutdown can block, so just drop it (the next
+        # epoch uses a fresh port)
+        try:
+            gs.service.shutdown()
+        except Exception:
+            pass
+        gs.service = None
+    gs.process_id = 0
+    gs.coordinator_address = None
+    import jax._src.api as _api
+
+    _api.clear_backends()
+
+
+def clean_shutdown() -> None:
+    """Coordinated teardown at the end of an elastic job.
+
+    The JAX coordination service runs a *shutdown barrier* across tasks;
+    leaving it to interpreter-exit atexit ordering is fragile (a task that
+    lingers in other finalizers trips the barrier timeout and the service
+    then kills every task).  The elastic run wrapper calls this as soon as
+    training returns, while all workers are still in controlled code."""
+    import jax
+
+    try:
+        from jax._src import distributed as _dist
+
+        if getattr(_dist.global_state, "client", None) is not None:
+            jax.distributed.shutdown()
+    except Exception as e:
+        get_logger().info("elastic: clean shutdown raised (%s)", e)
+
+
+def reset_world(state) -> None:
+    """Full reset: re-rendezvous, rebuild backend + framework, re-sync
+    (reference: common/elastic.py _reset + §3.4's 'full communicator
+    rebuild' step).  Valid only when all remaining peers are alive (a
+    planned membership change): the coordination-service shutdown barrier
+    then completes.  Peer-death recovery goes through
+    :func:`restart_after_failure` instead."""
+    from ..common import basics
+
+    state._materialize_to_host()
+    notification_manager.clear()
+    # tear down BEFORE the (potentially long) rendezvous wait: the old
+    # client's heartbeat watchdog would otherwise hard-kill this process
+    # while it waits for replacement workers to spawn
+    basics.shutdown()
+    _teardown_jax()
+    msg = rendezvous()
+    apply_assignment(msg)
+    basics.init()
+    state.on_reset()
+    get_logger().info(
+        "elastic: reset complete — epoch=%s rank=%s/%s",
+        msg.get("epoch"), msg.get("rank"), msg.get("num_processes"),
+    )
+
+
+def restart_after_failure(state) -> None:
+    """Peer-death recovery: persist the last committed state and
+    exec-restart this worker in place (same PID — the driver's process
+    table is undisturbed), rejoining via rendezvous on boot.
+
+    Rationale (TPU-specific deviation from the reference, which aborts
+    NCCL comms and keeps the process): a JAX process cannot detach from a
+    coordination service whose peers died — the client's shutdown barrier
+    failure and heartbeat watchdog both hard-terminate the process
+    (jaxlib client.h fatal handler).  Re-execing is the reliable
+    equivalent of torchrun-style worker-group restart, and the state file
+    + post-boot ``state.sync()`` reproduce the reference's
+    restore-then-rebroadcast semantics exactly."""
+    import pickle
+    import sys
+    import tempfile
+
+    snap = state._snapshot() if hasattr(state, "_snapshot") else None
+    if snap is not None:
+        fd, path = tempfile.mkstemp(prefix="hvd_tpu_elastic_state_")
+        with os.fdopen(fd, "wb") as f:
+            pickle.dump(snap, f)
+        os.environ[ENV_RESTORE] = path
+    for k in _ASSIGNMENT_ENV:
+        os.environ.pop(k, None)
+    get_logger().info("elastic: peer failure — exec-restarting this worker")
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os.execv(sys.executable, [sys.executable] + sys.argv)
+
+
+def maybe_restore_after_restart(state) -> None:
+    """On wrapper entry after an exec-restart, reload the persisted
+    snapshot (then the normal ``state.sync()`` re-broadcasts rank 0's
+    authoritative copy)."""
+    import pickle
+
+    path = os.environ.pop(ENV_RESTORE, None)
+    if not path or not os.path.exists(path):
+        return
+    with open(path, "rb") as f:
+        snap = pickle.load(f)
+    os.remove(path)
+    if snap is not None and hasattr(state, "_apply_snapshot"):
+        state._apply_snapshot(snap)
+        state.save()
+        get_logger().info("elastic: state restored after worker restart")

@@ -18,6 +18,7 @@
 #include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <poll.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
@@ -114,6 +115,12 @@ class TcpTransport : public Transport {
         failed_ = true;
         return;
       }
+      // poll before accept so the rendezvous deadline is enforced even
+      // when a peer never connects (a blocking accept would pin rank 0
+      // forever while the other ranks give up in ConnectToRoot)
+      pollfd pfd{listen_fd_, POLLIN, 0};
+      int ready = ::poll(&pfd, 1, /*ms=*/250);
+      if (ready <= 0 || !(pfd.revents & POLLIN)) continue;
       int fd = ::accept(listen_fd_, nullptr, nullptr);
       if (fd < 0) continue;
       SetNoDelay(fd);

@@ -128,6 +128,16 @@ class CollectiveEngine:
         """(size, ...) tiled stack -> (num_processes, ...) unique rows."""
         return a[jnp.asarray(self._lead_slots)]
 
+    def _run(self, compiled, *args):
+        """Execute a compiled collective, translating runtime comm
+        failures (a peer died mid-collective) into HorovodInternalError —
+        the elastic recovery signal (reference: NCCL abort →
+        HorovodInternalError, nccl_operations.cc error path)."""
+        try:
+            return compiled(*args)
+        except jax.errors.JaxRuntimeError as e:
+            raise HorovodInternalError(str(e)) from e
+
     # -- collectives --------------------------------------------------------
 
     def allreduce(
@@ -167,14 +177,12 @@ class CollectiveEngine:
             return _reduce_unique(u, op, n, pre, post)
 
         compiled = self._compile(key, fn)
-        try:
-            g = compiled(
-                self._stacked_global(x),
-                jnp.asarray(prescale_factor, x.dtype),
-                jnp.asarray(postscale_factor, x.dtype),
-            )
-        except jax.errors.JaxRuntimeError as e:  # comm failure => elastic
-            raise HorovodInternalError(str(e)) from e
+        g = self._run(
+            compiled,
+            self._stacked_global(x),
+            jnp.asarray(prescale_factor, x.dtype),
+            jnp.asarray(postscale_factor, x.dtype),
+        )
         return self._local_view(g)
 
     def allgather(
@@ -195,7 +203,7 @@ class CollectiveEngine:
             return u.reshape((-1,) + u.shape[2:])
 
         compiled = self._compile(key, fn)
-        return self._local_view(compiled(self._stacked_global(x)))
+        return self._local_view(self._run(compiled, self._stacked_global(x)))
 
     def broadcast(
         self,
@@ -216,7 +224,7 @@ class CollectiveEngine:
             return a[root_slot]
 
         compiled = self._compile(key, fn)
-        return self._local_view(compiled(self._stacked_global(x)))
+        return self._local_view(self._run(compiled, self._stacked_global(x)))
 
     def alltoall(
         self,
@@ -263,7 +271,7 @@ class CollectiveEngine:
             return c[:, me].reshape((-1,) + u.shape[2:])
 
         compiled = self._compile(key, fn)
-        out = self._local_view(compiled(self._stacked_global(x)))
+        out = self._local_view(self._run(compiled, self._stacked_global(x)))
         return out, jnp.full((n,), chunk, dtype=jnp.int32)
 
     def reducescatter(
@@ -294,7 +302,7 @@ class CollectiveEngine:
             return jax.lax.dynamic_slice_in_dim(r, me * chunk, chunk, axis=0)
 
         compiled = self._compile(key, fn)
-        return self._local_view(compiled(self._stacked_global(x)))
+        return self._local_view(self._run(compiled, self._stacked_global(x)))
 
     def barrier(self, process_set: Optional[ProcessSet] = None) -> None:
         """Reference: BarrierOp (collective_operations.cc)."""

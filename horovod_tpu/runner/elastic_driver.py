@@ -1,0 +1,470 @@
+"""Elastic driver: discovery polling, worker lifecycle, rendezvous.
+
+Reference parity: horovod/runner/elastic/driver.py (ElasticDriver),
+discovery.py (HostDiscoveryScript), registration.py / rendezvous.py
+(SURVEY.md §2.4, §3.4).  Responsibilities are the same set:
+
+  * poll ``--host-discovery-script`` (~1 s) for the current ``host:slots``
+    set;
+  * spawn one worker process per slot (localhost exec or ssh), each told
+    only the driver's address + a stable worker id — world shape always
+    arrives via rendezvous;
+  * detect failures (process exit, notification-socket drop), blacklist
+    the failed slot, and drive a reset epoch: push ``hosts_updated`` to
+    survivors, collect rendezvous requests from the expected member set,
+    hand out rank/size/coordinator assignments;
+  * enforce ``--min-np`` (wait for capacity, bounded by
+    HVD_TPU_ELASTIC_TIMEOUT) and ``--max-np`` (cap spawned slots);
+  * declare success when every live worker exits 0.
+
+The assignment makes the lowest worker id rank 0, whose host then serves
+the JAX coordination service for the epoch — the analog of the reference
+restarting its rendezvous server on reset.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..elastic.worker import ENV_DRIVER, ENV_ELASTIC, ENV_WORKER_ID
+from ..utils.logging import get_logger
+
+_LOCAL_HOSTS = ("localhost", "127.0.0.1")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+class HostDiscovery:
+    """Wraps the user's discovery script (reference:
+    runner/elastic/discovery.py HostDiscoveryScript): executable printing
+    one ``host`` or ``host:slots`` per line."""
+
+    def __init__(self, script: str, default_slots: int = 1):
+        self.script = script
+        self.default_slots = default_slots
+
+    def find_available_hosts(self) -> List[Tuple[str, int]]:
+        out = subprocess.run(
+            [self.script], capture_output=True, text=True, timeout=30
+        )
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"host discovery script failed ({out.returncode}): "
+                f"{out.stderr.strip()}"
+            )
+        hosts = []
+        for line in out.stdout.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            if ":" in line:
+                h, s = line.rsplit(":", 1)
+                hosts.append((h, int(s)))
+            else:
+                hosts.append((line, self.default_slots))
+        return hosts
+
+
+class _Worker:
+    def __init__(self, worker_id: int, host: str, slot: int,
+                 proc: subprocess.Popen):
+        self.worker_id = worker_id
+        self.host = host
+        self.slot = slot
+        self.proc = proc
+        self.exit_code: Optional[int] = None
+        # slot removed by discovery: the worker stays alive through the
+        # next rendezvous (so the old world's teardown barrier completes)
+        # and is then told to shut down
+        self.leaving = False
+
+    @property
+    def alive(self) -> bool:
+        return self.exit_code is None and self.proc.poll() is None
+
+
+class ElasticDriver:
+    """See module docstring.  One instance per ``tpurun`` elastic job."""
+
+    def __init__(
+        self,
+        command: List[str],
+        discovery: HostDiscovery,
+        min_np: int,
+        max_np: Optional[int] = None,
+        knob_env: Optional[Dict[str, str]] = None,
+        poll_interval: float = 1.0,
+        timeout: Optional[float] = None,
+        verbose: bool = False,
+    ):
+        self.command = command
+        self.discovery = discovery
+        self.min_np = min_np
+        self.max_np = max_np
+        self.knob_env = knob_env or {}
+        self.poll_interval = poll_interval
+        self.timeout = timeout or float(
+            os.environ.get("HVD_TPU_ELASTIC_TIMEOUT", "600")
+        )
+        self.verbose = verbose
+
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._workers: Dict[int, _Worker] = {}
+        self._blacklist: set = set()  # (host, slot) pairs
+        self._next_worker_id = 0
+        self._epoch = 0
+        # rendezvous state: worker_id -> socket awaiting an assignment
+        self._pending_rendezvous: Dict[int, socket.socket] = {}
+        self._notify_socks: Dict[int, socket.socket] = {}
+        self._server: Optional[socket.socket] = None
+        self._shutdown = False
+
+    # -- server ------------------------------------------------------------
+
+    def _start_server(self) -> Tuple[str, int]:
+        srv = socket.socket()
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("", 0))
+        srv.listen(128)
+        self._server = srv
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+        return socket.gethostname(), srv.getsockname()[1]
+
+    def _accept_loop(self) -> None:
+        while not self._shutdown:
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._handle_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _handle_conn(self, conn: socket.socket) -> None:
+        try:
+            f = conn.makefile("r")
+            line = f.readline()
+            if not line:
+                conn.close()
+                return
+            msg = json.loads(line)
+        except (OSError, ValueError):
+            conn.close()
+            return
+        kind = msg.get("type")
+        wid = msg.get("worker_id")
+        if kind == "register":
+            with self._cv:
+                self._notify_socks[wid] = conn
+            # keep the socket open; its EOF doubles as a liveness signal
+        elif kind == "rendezvous":
+            with self._cv:
+                self._pending_rendezvous[wid] = conn
+                self._cv.notify_all()
+        else:
+            conn.close()
+
+    # -- worker lifecycle --------------------------------------------------
+
+    def _spawn(self, host: str, slot: int, driver_addr: str) -> _Worker:
+        wid = self._next_worker_id
+        self._next_worker_id += 1
+        env = dict(os.environ)
+        env.update(self.knob_env)
+        env[ENV_ELASTIC] = "1"
+        env[ENV_DRIVER] = driver_addr
+        env[ENV_WORKER_ID] = str(wid)
+        if host in _LOCAL_HOSTS:
+            proc = subprocess.Popen(self.command, env=env)
+        else:
+            env_prefix = " ".join(
+                f"{k}={subprocess.list2cmdline([v])}"
+                for k, v in env.items() if k.startswith("HVD_TPU_")
+            )
+            remote = (f"cd {os.getcwd()} && {env_prefix} "
+                      + subprocess.list2cmdline(self.command))
+            proc = subprocess.Popen(
+                ["ssh", "-o", "StrictHostKeyChecking=no", host, remote]
+            )
+        w = _Worker(wid, host, slot, proc)
+        self._workers[wid] = w
+        if self.verbose:
+            print(f"[tpurun elastic] spawned worker {wid} on {host}:{slot}",
+                  file=sys.stderr)
+        return w
+
+    def _alive_workers(self) -> List[_Worker]:
+        return [w for w in self._workers.values() if w.alive]
+
+    def _occupied_slots(self) -> set:
+        return {(w.host, w.slot) for w in self._workers.values() if w.alive}
+
+    def _desired_slots(self, hosts: List[Tuple[str, int]]) -> List[Tuple[str, int]]:
+        slots = []
+        for h, n in hosts:
+            for s in range(n):
+                if (h, s) not in self._blacklist:
+                    slots.append((h, s))
+        if self.max_np is not None:
+            slots = slots[: self.max_np]
+        return slots
+
+    # -- rendezvous epoch --------------------------------------------------
+
+    def _query_ports(self, sock: socket.socket):
+        """Ask the rank-0-elect worker to allocate the epoch's
+        coordinator + native ports on its host."""
+        try:
+            sock.sendall(
+                (json.dumps({"type": "allocate_ports"}) + "\n").encode()
+            )
+            sock.settimeout(30)
+            reply = json.loads(sock.makefile("r").readline())
+            sock.settimeout(None)
+            if reply.get("type") != "ports":
+                return None
+            return reply
+        except (OSError, ValueError):
+            return None
+
+    def _notify_hosts_updated(self, failure: bool = False) -> None:
+        """Push the membership change; ``failure=True`` tells survivors a
+        peer died, so they must take the restart recovery path (a graceful
+        in-process teardown would trip on the dead peer's barrier)."""
+        dead = []
+        for wid, sock in self._notify_socks.items():
+            try:
+                sock.sendall((json.dumps(
+                    {"type": "hosts_updated", "epoch": self._epoch,
+                     "failure": failure}
+                ) + "\n").encode())
+            except OSError:
+                dead.append(wid)
+        for wid in dead:
+            self._notify_socks.pop(wid, None)
+
+    def _complete_rendezvous(self, driver_host: str) -> bool:
+        """Wait until every live worker has requested rendezvous, then
+        hand out assignments.  Returns False on timeout/below-min-np."""
+        deadline = time.time() + self.timeout
+        with self._cv:
+            while True:
+                for w in list(self._workers.values()):
+                    w.exit_code = w.proc.poll() if w.exit_code is None \
+                        else w.exit_code
+                expected = {w.worker_id for w in self._alive_workers()}
+                have = set(self._pending_rendezvous)
+                if not expected:
+                    return False
+                if expected <= have:
+                    break
+                if time.time() > deadline:
+                    return False
+                self._cv.wait(timeout=0.2)
+
+            members = sorted(
+                wid for wid in expected if not self._workers[wid].leaving
+            )
+            if not members:
+                return False
+            self._members = list(members)
+            rank0 = self._workers[members[0]]
+            coord_host = ("127.0.0.1" if rank0.host in _LOCAL_HOSTS
+                          else rank0.host)
+            # two-phase: the rank-0-elect allocates the ports ON ITS OWN
+            # HOST (probing them here would race/miss on a remote machine
+            # — reference analog: the rendezvous server owning its port)
+            ports = self._query_ports(self._pending_rendezvous[members[0]])
+            if ports is None:
+                return False
+            coordinator = f"{coord_host}:{ports['coordinator_port']}"
+            native_port = ports["native_port"]
+            for rank, wid in enumerate(members):
+                sock = self._pending_rendezvous.pop(wid)
+                reply = {
+                    "type": "assignment",
+                    "rank": rank,
+                    "num_processes": len(members),
+                    "coordinator": coordinator,
+                    "native_port": native_port,
+                    "epoch": self._epoch,
+                }
+                try:
+                    sock.sendall((json.dumps(reply) + "\n").encode())
+                except OSError:
+                    pass
+                sock.close()
+            # leaving workers (removed slots) and latecomers from dead
+            # epochs are told to shut down; they exit 0 after having
+            # participated in the old world's teardown
+            for wid, sock in list(self._pending_rendezvous.items()):
+                if wid not in members:
+                    try:
+                        sock.sendall(
+                            (json.dumps({"type": "shutdown"}) + "\n").encode()
+                        )
+                    except OSError:
+                        pass
+                    sock.close()
+                    self._pending_rendezvous.pop(wid, None)
+            if self.verbose:
+                print(f"[tpurun elastic] epoch {self._epoch}: world="
+                      f"{len(members)} coordinator={coordinator}",
+                      file=sys.stderr)
+        return True
+
+    # -- main loop ---------------------------------------------------------
+
+    def run(self) -> int:
+        host, port = self._start_server()
+        # workers resolve the driver by this address; local workers can
+        # always use loopback
+        driver_addr = f"{host}:{port}"
+        try:
+            return self._run(driver_addr, host)
+        finally:
+            self._shutdown = True
+            try:
+                self._server.close()
+            except OSError:
+                pass
+            for w in self._workers.values():
+                if w.alive:
+                    w.proc.terminate()
+
+    def _run(self, driver_addr: str, driver_host: str) -> int:
+        log = get_logger()
+        # wait for the initial host set to satisfy min_np
+        deadline = time.time() + self.timeout
+        while True:
+            hosts = self.discovery.find_available_hosts()
+            slots = self._desired_slots(hosts)
+            if len(slots) >= self.min_np:
+                break
+            if time.time() > deadline:
+                print(f"[tpurun elastic] timed out waiting for >= "
+                      f"{self.min_np} slots", file=sys.stderr)
+                return 1
+            time.sleep(self.poll_interval)
+
+        local_addr = driver_addr
+        if all(h in _LOCAL_HOSTS for h, _ in slots):
+            local_addr = f"127.0.0.1:{driver_addr.rsplit(':', 1)[1]}"
+        with self._cv:
+            for h, s in slots:
+                self._spawn(h, s, local_addr)
+        if not self._complete_rendezvous(driver_host):
+            return 1
+
+        last_poll = time.time()
+        while True:
+            time.sleep(0.1)
+            membership_changed = False
+            had_failure = False
+            with self._cv:
+                for w in list(self._workers.values()):
+                    if w.exit_code is None:
+                        code = w.proc.poll()
+                        if code is not None:
+                            w.exit_code = code
+                            self._notify_socks.pop(w.worker_id, None)
+                            if code == 0 and not w.leaving:
+                                # a clean exit of an active member means
+                                # training completed: the job is winding
+                                # down — stop spawning into freed slots.
+                                # (A 'leaving' worker exiting 0 is just a
+                                # scale-down; elasticity must survive it.)
+                                self._completing = True
+                            if code != 0:
+                                log.warning(
+                                    "elastic: worker %d (%s:%d) failed "
+                                    "with exit code %d", w.worker_id,
+                                    w.host, w.slot, code)
+                                self._blacklist.add((w.host, w.slot))
+                                membership_changed = True
+                                had_failure = True
+                alive = self._alive_workers()
+            if not alive and not membership_changed:
+                # job over: success iff every member of the final epoch
+                # exited cleanly (recovered-from failures of earlier
+                # epochs don't count against the job — reference behavior)
+                members = getattr(self, "_members", [])
+                ok = members and all(
+                    self._workers[wid].exit_code == 0 for wid in members
+                )
+                return 0 if ok else 1
+
+            # discovery poll (suspended once the job is completing)
+            if not getattr(self, "_completing", False) and \
+                    time.time() - last_poll >= self.poll_interval:
+                last_poll = time.time()
+                try:
+                    hosts = self.discovery.find_available_hosts()
+                except RuntimeError as e:
+                    log.warning("elastic: discovery failed: %s", e)
+                    hosts = None
+                if hosts is not None:
+                    desired = set(self._desired_slots(hosts))
+                    occupied = self._occupied_slots()
+                    added = desired - occupied
+                    removed = occupied - desired
+                    if added or removed:
+                        membership_changed = True
+                        with self._cv:
+                            for w in self._alive_workers():
+                                if (w.host, w.slot) in removed:
+                                    # keep it alive through the next
+                                    # rendezvous; it exits after the
+                                    # "shutdown" reply
+                                    w.leaving = True
+                            for h, s in sorted(added):
+                                self._spawn(h, s, local_addr)
+
+            # a worker that exec-restarted itself (failure recovery) shows
+            # up as an out-of-band rendezvous request: serve it with a new
+            # epoch even if no process exit was observed
+            with self._cv:
+                if self._pending_rendezvous and not membership_changed:
+                    membership_changed = True
+
+            if membership_changed:
+                with self._cv:
+                    alive = self._alive_workers()
+                if len(alive) < self.min_np:
+                    # wait (bounded) for discovery to refill capacity
+                    refill_deadline = time.time() + self.timeout
+                    while len(alive) < self.min_np:
+                        if time.time() > refill_deadline:
+                            print("[tpurun elastic] world below --min-np "
+                                  "and no new hosts; aborting",
+                                  file=sys.stderr)
+                            return 1
+                        time.sleep(self.poll_interval)
+                        try:
+                            hosts = self.discovery.find_available_hosts()
+                        except RuntimeError:
+                            continue
+                        with self._cv:
+                            desired = set(self._desired_slots(hosts))
+                            for h, s in sorted(desired -
+                                               self._occupied_slots()):
+                                self._spawn(h, s, local_addr)
+                            alive = self._alive_workers()
+                self._epoch += 1
+                self._notify_hosts_updated(failure=had_failure)
+                if not self._complete_rendezvous(driver_host):
+                    print("[tpurun elastic] rendezvous failed; aborting",
+                          file=sys.stderr)
+                    return 1

@@ -105,6 +105,17 @@ def build_parser() -> argparse.ArgumentParser:
                    const=True)
     p.add_argument("--autotune-log", dest="autotune_log")
     p.add_argument("--log-level", dest="log_level")
+    # elastic flags (reference: horovodrun --min-np/--max-np/
+    # --host-discovery-script — runner/elastic/settings.py)
+    p.add_argument("--min-np", type=int, default=None,
+                   help="minimum workers to keep running (elastic mode)")
+    p.add_argument("--max-np", type=int, default=None,
+                   help="maximum workers (elastic mode)")
+    p.add_argument("--host-discovery-script", default=None,
+                   help="executable printing current 'host:slots' lines; "
+                        "enables elastic mode")
+    p.add_argument("--slots", type=int, default=1,
+                   help="default slots per discovered host (elastic)")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="the training command, e.g. python train.py")
     return p
@@ -260,6 +271,28 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
 
     config = load_config_file(args.config_file) if args.config_file else {}
     knob_env = config_to_env(args, config)
+
+    if args.host_discovery_script:
+        # elastic mode (reference: horovodrun --host-discovery-script
+        # switching launch.py into the ElasticDriver path)
+        from .elastic_driver import ElasticDriver, HostDiscovery
+
+        if args.disable_native:
+            knob_env["HVD_TPU_DISABLE_NATIVE"] = "1"
+        driver = ElasticDriver(
+            command=command,
+            discovery=HostDiscovery(args.host_discovery_script,
+                                    default_slots=args.slots),
+            min_np=args.min_np or args.num_proc or 1,
+            max_np=args.max_np,
+            knob_env=knob_env,
+            verbose=args.verbose,
+        )
+        return driver.run()
+    if args.min_np or args.max_np:
+        print("tpurun: --min-np/--max-np require --host-discovery-script",
+              file=sys.stderr)
+        return 2
 
     if args.hostfile:
         hosts = parse_hostfile(args.hostfile)

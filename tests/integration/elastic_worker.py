@@ -1,0 +1,70 @@
+"""Elastic integration worker.
+
+Reference analog: the training scripts run by
+test/integration/test_elastic_torch.py via elastic_common.py (SURVEY.md §4)
+— a small training loop under ``hvd.elastic.run`` that commits every batch
+and logs progress so the test can assert recovery/rescale bookkeeping.
+
+Usage: elastic_worker.py <logdir> <num_epochs> <batches_per_epoch>
+Each batch "trains" by allreducing a per-worker gradient of 1.0 (average),
+so after any membership dance the final weight must equal the number of
+completed batches exactly — lost/duplicated batches would show up as a
+wrong weight.
+"""
+
+import json
+import os
+import sys
+import time
+
+import jax.numpy as jnp
+import numpy as np
+
+import horovod_tpu as hvd
+
+
+def log(logdir, **kv):
+    wid = os.environ.get("HVD_TPU_ELASTIC_WORKER_ID", "na")
+    with open(os.path.join(logdir, f"worker_{wid}.log"), "a") as f:
+        f.write(json.dumps(kv) + "\n")
+
+
+def main():
+    logdir, num_epochs, batches = sys.argv[1], int(sys.argv[2]), int(
+        sys.argv[3])
+    hvd.init()
+    log(logdir, event="init", rank=hvd.cross_rank(), world=hvd.cross_size(),
+        pid=os.getpid())
+
+    state = hvd.elastic.TpuState(
+        weight=np.zeros(()), epoch=0, batch=0, resets=0)
+    state.register_reset_callbacks([
+        lambda: log(logdir, event="reset", world=hvd.cross_size())
+    ])
+
+    @hvd.elastic.run
+    def train(state):
+        while state.epoch < num_epochs:
+            while state.batch < batches:
+                grad = hvd.allreduce(jnp.ones(()), op=hvd.Average)
+                state.weight = np.asarray(state.weight + np.asarray(grad))
+                state.batch += 1
+                state.commit()
+                log(logdir, event="batch", epoch=state.epoch,
+                    batch=state.batch, world=hvd.cross_size(),
+                    rank=hvd.cross_rank(), weight=float(state.weight))
+                time.sleep(0.15)
+            state.batch = 0
+            state.epoch += 1
+            state.commit()
+        return float(state.weight)
+
+    final = train(state)
+    expected = float(num_epochs * batches)
+    assert abs(final - expected) < 1e-6, (final, expected)
+    log(logdir, event="done", weight=final, world=hvd.cross_size(),
+        rank=hvd.cross_rank())
+
+
+if __name__ == "__main__":
+    main()
