@@ -1,0 +1,107 @@
+"""MNIST with the torch adapter.
+
+Reference parity: examples/pytorch/pytorch_mnist.py — the canonical
+reference training script, unchanged in structure: hvd.init, data sharded
+by rank, DistributedOptimizer with grad hooks, parameter broadcast from
+rank 0, metric allreduce.  Only the import line differs.
+
+Run: tpurun -np 2 python examples/pytorch/pytorch_mnist.py --epochs 1
+(uses synthetic MNIST-shaped data when no dataset is available — this
+image has no torchvision download access).
+"""
+
+import argparse
+
+import numpy as np
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+import torch.utils.data
+
+import horovod_tpu.torch as hvd
+
+
+class Net(nn.Module):
+    """The reference's LeNet-style MNIST model."""
+
+    def __init__(self):
+        super().__init__()
+        self.conv1 = nn.Conv2d(1, 10, kernel_size=5)
+        self.conv2 = nn.Conv2d(10, 20, kernel_size=5)
+        self.conv2_drop = nn.Dropout2d()
+        self.fc1 = nn.Linear(320, 50)
+        self.fc2 = nn.Linear(50, 10)
+
+    def forward(self, x):
+        x = F.relu(F.max_pool2d(self.conv1(x), 2))
+        x = F.relu(F.max_pool2d(self.conv2_drop(self.conv2(x)), 2))
+        x = x.view(-1, 320)
+        x = F.relu(self.fc1(x))
+        x = F.dropout(x, training=self.training)
+        return F.log_softmax(self.fc2(x), dim=1)
+
+
+def synthetic_mnist(n=2048, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 1, 28, 28).astype(np.float32)
+    y = rng.randint(0, 10, size=(n,))
+    return torch.utils.data.TensorDataset(
+        torch.from_numpy(x), torch.from_numpy(y)
+    )
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--lr", type=float, default=0.01)
+    p.add_argument("--use-adasum", action="store_true")
+    p.add_argument("--fp16-allreduce", action="store_true")
+    args = p.parse_args()
+
+    hvd.init()
+    torch.manual_seed(42)
+
+    dataset = synthetic_mnist()
+    # shard the dataset by rank (reference: DistributedSampler)
+    sampler = torch.utils.data.distributed.DistributedSampler(
+        dataset, num_replicas=hvd.cross_size(), rank=hvd.cross_rank()
+    )
+    loader = torch.utils.data.DataLoader(
+        dataset, batch_size=args.batch_size, sampler=sampler
+    )
+
+    model = Net()
+    # scale lr by world size (reference idiom)
+    optimizer = torch.optim.SGD(
+        model.parameters(), lr=args.lr * hvd.cross_size(), momentum=0.5
+    )
+    compression = (hvd.Compression.fp16 if args.fp16_allreduce
+                   else hvd.Compression.none)
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters(),
+        compression=compression,
+        op=hvd.Adasum if args.use_adasum else hvd.Average,
+    )
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+
+    model.train()
+    for epoch in range(args.epochs):
+        sampler.set_epoch(epoch)
+        for batch_idx, (data, target) in enumerate(loader):
+            optimizer.zero_grad()
+            loss = F.nll_loss(model(data), target)
+            loss.backward()
+            optimizer.step()
+            if batch_idx % 10 == 0 and hvd.rank() == 0:
+                print(f"epoch {epoch} batch {batch_idx} "
+                      f"loss {loss.item():.4f}")
+        # averaged epoch metric (reference: metric_average helper)
+        avg = hvd.allreduce(torch.tensor(loss.item()), name="avg_loss")
+        if hvd.rank() == 0:
+            print(f"epoch {epoch} avg loss {float(avg):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,49 @@
+"""horovod_tpu.torch: the PyTorch framework adapter.
+
+Reference parity: the ``horovod.torch`` surface (horovod/torch/__init__.py,
+mpi_ops.py, optimizer.py, functions.py, sync_batch_norm.py,
+compression.py, elastic/ — SURVEY.md §2.3).  A reference training script
+needs only its import changed::
+
+    import horovod_tpu.torch as hvd
+    hvd.init()
+    optimizer = hvd.DistributedOptimizer(optimizer,
+                                         named_parameters=model.named_parameters())
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+
+Design: torch stays the model/autograd frontend; collectives execute as
+compiled XLA programs through the shared eager engine (CPU tensors bridge
+zero-copy via numpy).  The TPU compute path for new code is the JAX API;
+this adapter exists for reference-script parity and CPU-hosted torch
+training.
+"""
+
+from __future__ import annotations
+
+# lifecycle + topology (shared with the JAX surface)
+from ..common.basics import (  # noqa: F401
+    init, shutdown, is_initialized, rank, local_rank, size, local_size,
+    cross_rank, cross_size, is_homogeneous, xla_built, nccl_built,
+    mpi_enabled, gloo_built, ccl_built, native_built,
+)
+from ..common.exceptions import (  # noqa: F401
+    HorovodInternalError, HostsUpdatedInterrupt,
+)
+from ..common.process_sets import ProcessSet, global_process_set  # noqa: F401
+from ..ops.reduce_ops import (  # noqa: F401
+    Adasum, Average, Max, Min, Product, ReduceOp, Sum,
+)
+from .compression import Compression  # noqa: F401
+from .functions import (  # noqa: F401
+    broadcast_object, broadcast_optimizer_state, broadcast_parameters,
+)
+from .mpi_ops import (  # noqa: F401
+    allgather, allgather_async, allreduce, allreduce_, allreduce_async,
+    allreduce_async_, alltoall, alltoall_async, barrier, broadcast,
+    broadcast_, broadcast_async, broadcast_async_, grouped_allreduce,
+    grouped_allreduce_, grouped_allreduce_async, grouped_allreduce_async_,
+    join, poll, reducescatter, reducescatter_async, synchronize,
+)
+from .optimizer import DistributedOptimizer  # noqa: F401
+from .sync_batch_norm import SyncBatchNorm  # noqa: F401
+from . import elastic  # noqa: F401

@@ -1,0 +1,55 @@
+"""Gradient compression for the torch adapter.
+
+Reference parity: horovod/torch/compression.py — ``Compression.none`` and
+``Compression.fp16``, applied to gradients before the wire and undone
+after.  On TPU the same fp16-on-the-wire trick matters for DCN-bound
+multislice traffic; the JAX-side equivalent lives in
+``horovod_tpu.compression``.
+"""
+
+from __future__ import annotations
+
+import torch
+
+
+class Compressor:
+    @staticmethod
+    def compress(tensor: torch.Tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor: torch.Tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    """Identity (reference: NoneCompressor)."""
+
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class FP16Compressor(Compressor):
+    """Cast fp32/fp64 to fp16 on the wire (reference: FP16Compressor)."""
+
+    @staticmethod
+    def compress(tensor):
+        if tensor.dtype.is_floating_point:
+            return tensor.to(torch.float16), tensor.dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor.to(ctx) if ctx is not None else tensor
+
+
+class Compression:
+    """Namespace matching the reference's ``hvd.Compression`` surface."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor

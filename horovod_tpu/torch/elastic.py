@@ -1,0 +1,20 @@
+"""Elastic state for torch models.
+
+Reference parity: horovod/torch/elastic/state.py (TorchState) +
+torch/elastic/sampler.py (ElasticSampler — the shared implementation in
+``horovod_tpu.elastic.sampler`` already satisfies torch's Sampler
+protocol: ``__iter__`` over indices + ``__len__``).
+"""
+
+from __future__ import annotations
+
+from ..elastic import ObjectState, run  # noqa: F401 (re-export)
+from ..elastic.sampler import ElasticSampler  # noqa: F401 (re-export)
+
+
+class TorchState(ObjectState):
+    """Elastic state holding torch modules/optimizers (reference:
+    TorchState(model=..., optimizer=..., epoch=0, batch=0)).  Modules and
+    optimizers expose ``state_dict``/``load_state_dict``, which the base
+    ObjectState snapshots and syncs through — matching the reference's
+    capture→broadcast design."""

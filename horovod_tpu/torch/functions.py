@@ -1,0 +1,113 @@
+"""State broadcast helpers for torch models.
+
+Reference parity: horovod/torch/functions.py — broadcast_parameters,
+broadcast_optimizer_state, broadcast_object (SURVEY.md §2.3), used at
+train start so all workers leave rank 0's initialization identically.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any
+
+import torch
+
+from .. import functions as _jax_functions
+from . import mpi_ops
+
+
+def broadcast_parameters(params, root_rank: int = 0,
+                         process_set=None) -> None:
+    """Broadcast a ``model.state_dict()`` or ``named_parameters``
+    (reference: horovod/torch/functions.py broadcast_parameters)."""
+    if isinstance(params, dict):
+        items = sorted(params.items())
+    else:
+        items = list(params)
+    handles = []
+    for name, p in items:
+        if isinstance(p, torch.Tensor):
+            handles.append(
+                mpi_ops.broadcast_async_(p.data if hasattr(p, "data") else p,
+                                         root_rank, name=name,
+                                         process_set=process_set)
+            )
+    for h in handles:
+        mpi_ops.synchronize(h)
+
+
+def broadcast_object(obj: Any, root_rank: int = 0, name: str = None,
+                     process_set=None) -> Any:
+    """Reference: horovod/torch/mpi_ops.py broadcast_object (pickle +
+    size/payload broadcast); delegates to the shared implementation."""
+    return _jax_functions.broadcast_object(obj, root_rank=root_rank,
+                                           process_set=process_set)
+
+
+def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
+                              root_rank: int = 0, process_set=None) -> None:
+    """Broadcast optimizer state dict from root (reference:
+    horovod/torch/functions.py broadcast_optimizer_state — which walks the
+    state dict broadcasting tensors and pickling scalars; the same split
+    here: tensors via broadcast_, the structure via broadcast_object)."""
+    if isinstance(optimizer, torch.optim.LBFGS):
+        raise ValueError(
+            "cannot broadcast torch.optim.LBFGS state (reference limitation)"
+        )
+    state_dict = optimizer.state_dict()
+
+    # split tensors out of the state dict so they ride the tensor path
+    tensors = {}
+
+    def strip(prefix, value):
+        if isinstance(value, torch.Tensor):
+            tensors[prefix] = value
+            return ("__tensor__", prefix, value.dtype, tuple(value.shape))
+        if isinstance(value, dict):
+            return {k: strip(f"{prefix}.{k}", v) for k, v in value.items()}
+        if isinstance(value, (list, tuple)):
+            out = [strip(f"{prefix}.{i}", v) for i, v in enumerate(value)]
+            return type(value)(out) if isinstance(value, tuple) else out
+        return value
+
+    skeleton = strip("state", state_dict)
+    skeleton = broadcast_object(skeleton, root_rank=root_rank,
+                                process_set=process_set)
+
+    # workers whose optimizer hasn't stepped yet have no state tensors:
+    # materialize zeros from the broadcast metadata so the tensor broadcast
+    # has a landing buffer (reference handles this by pre-initializing the
+    # optimizer state before broadcasting)
+    def collect_markers(value):
+        if isinstance(value, tuple) and len(value) == 4 and \
+                value[0] == "__tensor__":
+            if value[1] not in tensors:
+                tensors[value[1]] = torch.zeros(value[3], dtype=value[2])
+        elif isinstance(value, dict):
+            for v in value.values():
+                collect_markers(v)
+        elif isinstance(value, list):
+            for v in value:
+                collect_markers(v)
+
+    collect_markers(skeleton)
+
+    handles = [
+        mpi_ops.broadcast_async_(t, root_rank, name=f"opt.{k}",
+                                 process_set=process_set)
+        for k, t in sorted(tensors.items())
+    ]
+    for h in handles:
+        mpi_ops.synchronize(h)
+
+    def rebuild(value):
+        if isinstance(value, tuple) and len(value) == 4 and \
+                value[0] == "__tensor__":
+            return tensors[value[1]]
+        if isinstance(value, dict):
+            return {k: rebuild(v) for k, v in value.items()}
+        if isinstance(value, list):
+            return [rebuild(v) for v in value]
+        return value
+
+    optimizer.load_state_dict(rebuild(skeleton))

@@ -1,0 +1,237 @@
+"""Torch tensor collectives over the XLA engine.
+
+Reference parity: horovod/torch/mpi_ops.py + the C++ binding it fronts
+(torch/mpi_ops_v2.cc, adapter_v2.cc, handle_manager.cc — SURVEY.md §2.3).
+The reference wraps ``at::Tensor`` into ``common::Tensor`` and enqueues to
+the background thread; here a CPU torch tensor is viewed as numpy
+(zero-copy), routed through the same eager engine the JAX API uses, and
+the result copied back.  Handles mirror the reference's int-keyed
+HandleManager: ``*_async`` returns a handle consumed by ``synchronize`` /
+``poll``.
+
+In-place variants (``allreduce_`` etc.) write the result back into the
+input tensor, matching reference semantics.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import torch
+
+from ..common.process_sets import ProcessSet
+from ..ops import collective_ops as _ops
+from ..ops.reduce_ops import ReduceOp
+
+
+def _to_np(t: torch.Tensor) -> np.ndarray:
+    if t.device.type != "cpu":
+        raise ValueError(
+            "horovod_tpu.torch bridges CPU tensors; move the tensor to CPU "
+            "first (the TPU compute path is the JAX API)"
+        )
+    return t.detach().contiguous().numpy()
+
+
+def _from_np(a, like: torch.Tensor) -> torch.Tensor:
+    # copy: the source is an immutable XLA buffer view; handing torch a
+    # writable alias of it would be undefined behavior
+    return torch.from_numpy(np.array(a, copy=True)).to(like.dtype)
+
+
+class _HandleManager:
+    """Int-keyed handle table (reference: torch/handle_manager.cc)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._next = 0
+        self._entries: Dict[int, Tuple[_ops.Handle, callable]] = {}
+
+    def allocate(self, inner: _ops.Handle, finalize) -> int:
+        with self._lock:
+            h = self._next
+            self._next += 1
+            self._entries[h] = (inner, finalize)
+            return h
+
+    def pop(self, handle: int):
+        with self._lock:
+            return self._entries.pop(handle)
+
+    def peek(self, handle: int):
+        with self._lock:
+            return self._entries.get(handle)
+
+
+_handles = _HandleManager()
+
+
+def synchronize(handle: int) -> torch.Tensor:
+    """Wait for an async op and return its output (reference:
+    horovod/torch/mpi_ops.py synchronize)."""
+    inner, finalize = _handles.pop(handle)
+    return finalize(inner.wait())
+
+
+def poll(handle: int) -> bool:
+    """Reference: horovod/torch/mpi_ops.py poll."""
+    entry = _handles.peek(handle)
+    return entry is None or entry[0].done()
+
+
+# -- allreduce ---------------------------------------------------------------
+
+
+def allreduce_async(tensor: torch.Tensor, average: Optional[bool] = None,
+                    name: Optional[str] = None, op: Optional[ReduceOp] = None,
+                    prescale_factor: float = 1.0,
+                    postscale_factor: float = 1.0,
+                    process_set: Optional[ProcessSet] = None) -> int:
+    inner = _ops.allreduce_async(
+        _to_np(tensor), average=average, name=name, op=op,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        process_set=process_set,
+    )
+    return _handles.allocate(inner, lambda out: _from_np(out, tensor))
+
+
+def allreduce(tensor: torch.Tensor, **kwargs) -> torch.Tensor:
+    return synchronize(allreduce_async(tensor, **kwargs))
+
+
+def allreduce_async_(tensor: torch.Tensor, **kwargs) -> int:
+    """In-place async allreduce (reference: allreduce_async_)."""
+    inner = _ops.allreduce_async(_to_np(tensor), **kwargs)
+
+    def finalize(out):
+        tensor.copy_(_from_np(out, tensor))
+        return tensor
+
+    return _handles.allocate(inner, finalize)
+
+
+def allreduce_(tensor: torch.Tensor, **kwargs) -> torch.Tensor:
+    return synchronize(allreduce_async_(tensor, **kwargs))
+
+
+def grouped_allreduce_async(tensors: Sequence[torch.Tensor],
+                            **kwargs) -> int:
+    inner = _ops.grouped_allreduce_async(
+        [_to_np(t) for t in tensors], **kwargs
+    )
+
+    def finalize(outs):
+        return [_from_np(o, t) for o, t in zip(outs, tensors)]
+
+    return _handles.allocate(inner, finalize)
+
+
+def grouped_allreduce(tensors: Sequence[torch.Tensor], **kwargs) -> list:
+    return synchronize(grouped_allreduce_async(tensors, **kwargs))
+
+
+def grouped_allreduce_async_(tensors: Sequence[torch.Tensor],
+                             **kwargs) -> int:
+    inner = _ops.grouped_allreduce_async(
+        [_to_np(t) for t in tensors], **kwargs
+    )
+
+    def finalize(outs):
+        for o, t in zip(outs, tensors):
+            t.copy_(_from_np(o, t))
+        return list(tensors)
+
+    return _handles.allocate(inner, finalize)
+
+
+def grouped_allreduce_(tensors: Sequence[torch.Tensor], **kwargs) -> list:
+    return synchronize(grouped_allreduce_async_(tensors, **kwargs))
+
+
+# -- allgather ---------------------------------------------------------------
+
+
+def allgather_async(tensor: torch.Tensor, name: Optional[str] = None,
+                    process_set: Optional[ProcessSet] = None) -> int:
+    inner = _ops.allgather_async(_to_np(tensor), name=name,
+                                 process_set=process_set)
+    return _handles.allocate(inner, lambda out: _from_np(out, tensor))
+
+
+def allgather(tensor: torch.Tensor, **kwargs) -> torch.Tensor:
+    return synchronize(allgather_async(tensor, **kwargs))
+
+
+# -- broadcast ---------------------------------------------------------------
+
+
+def broadcast_async(tensor: torch.Tensor, root_rank: int,
+                    name: Optional[str] = None,
+                    process_set: Optional[ProcessSet] = None) -> int:
+    inner = _ops.broadcast_async(_to_np(tensor), root_rank, name=name,
+                                 process_set=process_set)
+    return _handles.allocate(inner, lambda out: _from_np(out, tensor))
+
+
+def broadcast(tensor: torch.Tensor, root_rank: int, **kwargs) -> torch.Tensor:
+    return synchronize(broadcast_async(tensor, root_rank, **kwargs))
+
+
+def broadcast_async_(tensor: torch.Tensor, root_rank: int,
+                     **kwargs) -> int:
+    inner = _ops.broadcast_async(_to_np(tensor), root_rank, **kwargs)
+
+    def finalize(out):
+        tensor.copy_(_from_np(out, tensor))
+        return tensor
+
+    return _handles.allocate(inner, finalize)
+
+
+def broadcast_(tensor: torch.Tensor, root_rank: int, **kwargs) -> torch.Tensor:
+    return synchronize(broadcast_async_(tensor, root_rank, **kwargs))
+
+
+# -- alltoall / reducescatter ------------------------------------------------
+
+
+def alltoall_async(tensor: torch.Tensor,
+                   splits: Optional[torch.Tensor] = None,
+                   name: Optional[str] = None,
+                   process_set: Optional[ProcessSet] = None) -> int:
+    np_splits = None if splits is None else _to_np(splits)
+    inner = _ops.alltoall_async(_to_np(tensor), splits=np_splits, name=name,
+                                process_set=process_set)
+
+    def finalize(out):
+        received, recv_splits = out
+        return (_from_np(received, tensor),
+                torch.from_numpy(np.asarray(recv_splits)).to(torch.int32))
+
+    return _handles.allocate(inner, finalize)
+
+
+def alltoall(tensor: torch.Tensor, **kwargs):
+    return synchronize(alltoall_async(tensor, **kwargs))
+
+
+def reducescatter_async(tensor: torch.Tensor, op: Optional[ReduceOp] = None,
+                        name: Optional[str] = None,
+                        process_set: Optional[ProcessSet] = None) -> int:
+    inner = _ops.reducescatter_async(_to_np(tensor), op=op, name=name,
+                                     process_set=process_set)
+    return _handles.allocate(inner, lambda out: _from_np(out, tensor))
+
+
+def reducescatter(tensor: torch.Tensor, **kwargs) -> torch.Tensor:
+    return synchronize(reducescatter_async(tensor, **kwargs))
+
+
+def barrier(process_set: Optional[ProcessSet] = None) -> None:
+    _ops.barrier(process_set=process_set)
+
+
+def join() -> int:
+    return _ops.join()

@@ -1,0 +1,151 @@
+"""DistributedOptimizer for torch models.
+
+Reference parity: horovod/torch/optimizer.py (_DistributedOptimizer) —
+SURVEY.md §3.2's hot path: a hook fires as each parameter's gradient is
+accumulated, submits an async (compressed) allreduce, and ``step()``
+synchronizes all handles before applying the update.  Local gradient
+aggregation over ``backward_passes_per_step`` is preserved.
+
+The reference registers hooks on the autograd graph's grad accumulator
+nodes; modern torch exposes the same moment directly via
+``register_post_accumulate_grad_hook``, which we use.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterable, Optional, Tuple
+
+import torch
+
+from ..ops.reduce_ops import Average, ReduceOp
+from . import mpi_ops
+from .compression import Compression
+
+
+class _DistributedOptimizer(torch.optim.Optimizer):
+    def __init__(self, params, named_parameters, compression,
+                 backward_passes_per_step, op, gradient_predivide_factor,
+                 process_set):
+        super(self.__class__, self).__init__(params)
+        self._compression = compression
+        self._op = op
+        self._process_set = process_set
+        self.backward_passes_per_step = backward_passes_per_step
+        self._gradient_predivide_factor = gradient_predivide_factor
+
+        if named_parameters is not None:
+            named = list(named_parameters)
+        else:
+            named = []
+            for i, group in enumerate(self.param_groups):
+                for j, p in enumerate(group["params"]):
+                    named.append((f"allreduce.noname.{i}.{j}", p))
+        self._param_names = {p: name for name, p in named}
+
+        self._handles = {}  # param -> (handle, ctx)
+        self._passes = {}  # param -> local accumulation count
+        self._synchronized = False
+        self._should_synchronize = True
+        self._hook_handles = []
+        self._register_hooks()
+
+    # -- hooks --------------------------------------------------------------
+
+    def _register_hooks(self):
+        for group in self.param_groups:
+            for p in group["params"]:
+                if p.requires_grad:
+                    self._passes[p] = 0
+                    self._hook_handles.append(
+                        p.register_post_accumulate_grad_hook(
+                            self._make_hook()
+                        )
+                    )
+
+    def _make_hook(self):
+        def hook(p):
+            self._passes[p] += 1
+            if self._passes[p] == self.backward_passes_per_step:
+                self._passes[p] = 0
+                self._allreduce_grad_async(p)
+        return hook
+
+    def _allreduce_grad_async(self, p):
+        name = self._param_names.get(p, "allreduce.noname")
+        grad = p.grad
+        if self.backward_passes_per_step > 1:
+            grad = grad / self.backward_passes_per_step
+        if self._gradient_predivide_factor != 1.0:
+            grad = grad / self._gradient_predivide_factor
+        compressed, ctx = self._compression.compress(grad)
+        handle = mpi_ops.allreduce_async(
+            compressed, name=name, op=self._op,
+            process_set=self._process_set,
+        )
+        self._handles[p] = (handle, ctx)
+
+    # -- synchronization ----------------------------------------------------
+
+    def synchronize(self):
+        """Wait for all outstanding allreduces and install averaged grads
+        (reference: _DistributedOptimizer.synchronize)."""
+        for p, (handle, ctx) in list(self._handles.items()):
+            output = mpi_ops.synchronize(handle)
+            grad = self._compression.decompress(output, ctx)
+            if self._gradient_predivide_factor != 1.0:
+                grad = grad * self._gradient_predivide_factor
+            p.grad = grad.to(p.grad.dtype)
+        self._handles.clear()
+        self._synchronized = True
+
+    @contextlib.contextmanager
+    def skip_synchronize(self):
+        """Reference: optimizer.skip_synchronize() for manual
+        ``optimizer.synchronize()`` + gradient clipping patterns."""
+        self._should_synchronize = False
+        try:
+            yield
+        finally:
+            self._should_synchronize = True
+
+    def step(self, closure=None):
+        if self._should_synchronize:
+            if self._synchronized:
+                import warnings
+
+                warnings.warn(
+                    "optimizer.step() called after optimizer.synchronize(); "
+                    "use optimizer.skip_synchronize() to avoid reducing "
+                    "gradients twice (reference warning text)"
+                )
+            self.synchronize()
+        self._synchronized = False
+        return super(self.__class__, self).step(closure)
+
+    def zero_grad(self, *args, **kwargs):
+        if self._handles:
+            raise AssertionError(
+                "optimizer.zero_grad() was called after loss.backward() but "
+                "before optimizer.step() or optimizer.synchronize()"
+            )
+        return super(self.__class__, self).zero_grad(*args, **kwargs)
+
+
+def DistributedOptimizer(
+    optimizer: torch.optim.Optimizer,
+    named_parameters: Optional[Iterable[Tuple[str, torch.nn.Parameter]]] = None,
+    compression=Compression.none,
+    backward_passes_per_step: int = 1,
+    op: ReduceOp = Average,
+    gradient_predivide_factor: float = 1.0,
+    process_set=None,
+):
+    """Wrap a torch optimizer with distributed gradient averaging
+    (reference: horovod/torch/optimizer.py DistributedOptimizer — same
+    dynamic-subclass trick so isinstance checks keep working)."""
+    cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
+               dict(_DistributedOptimizer.__dict__))
+    return cls(optimizer.param_groups, named_parameters, compression,
+               backward_passes_per_step, op, gradient_predivide_factor,
+               process_set)

@@ -1,0 +1,81 @@
+"""SyncBatchNorm for torch models.
+
+Reference parity: horovod/torch/sync_batch_norm.py — batch-norm whose
+statistics are reduced across all workers each forward pass, with the
+matching allreduce in backward.  Differentiable collectives are expressed
+as ``torch.autograd.Function``s over the adapter's allreduce (the
+reference calls its C++ ops the same way).
+"""
+
+from __future__ import annotations
+
+import torch
+from torch.nn.modules.batchnorm import _BatchNorm
+
+from ..ops.reduce_ops import Sum
+from . import mpi_ops
+from ..common import basics
+
+
+class _SyncSum(torch.autograd.Function):
+    """Differentiable cross-worker sum: backward of a sum-allreduce is a
+    sum-allreduce of the gradient."""
+
+    @staticmethod
+    def forward(ctx, x):
+        return mpi_ops.allreduce(x, op=Sum)
+
+    @staticmethod
+    def backward(ctx, grad):
+        return mpi_ops.allreduce(grad.contiguous(), op=Sum)
+
+
+class SyncBatchNorm(_BatchNorm):
+    """Drop-in replacement for ``nn.BatchNorm*d`` with cross-worker stats
+    (reference: hvd.SyncBatchNorm).  Statistics are computed from global
+    sum / sum-of-squares / count, exactly the reference's formulation."""
+
+    def _check_input_dim(self, input):
+        if input.dim() < 2:
+            raise ValueError(
+                f"expected at least 2D input (got {input.dim()}D)"
+            )
+
+    def forward(self, input):
+        if not (self.training and basics.is_initialized()
+                and basics.cross_size() > 1):
+            return super().forward(input)
+
+        self._check_input_dim(input)
+        dims = [0] + list(range(2, input.dim()))
+        count = torch.tensor(
+            [float(input.numel() // input.size(1))], dtype=input.dtype
+        )
+        local_sum = input.sum(dims)
+        local_sq = (input * input).sum(dims)
+
+        packed = torch.cat([count, local_sum, local_sq])
+        packed = _SyncSum.apply(packed)
+        global_count = packed[0]
+        mean = packed[1:1 + input.size(1)] / global_count
+        sq = packed[1 + input.size(1):] / global_count
+        var = sq - mean * mean
+
+        if self.track_running_stats and self.running_mean is not None:
+            with torch.no_grad():
+                m = self.momentum if self.momentum is not None else 0.1
+                n = global_count
+                unbiased = var * (n / (n - 1)) if n > 1 else var
+                self.running_mean.mul_(1 - m).add_(mean.detach() * m)
+                self.running_var.mul_(1 - m).add_(unbiased.detach() * m)
+                if self.num_batches_tracked is not None:
+                    self.num_batches_tracked.add_(1)
+
+        shape = [1, -1] + [1] * (input.dim() - 2)
+        out = (input - mean.reshape(shape)) / torch.sqrt(
+            var.reshape(shape) + self.eps
+        )
+        if self.affine:
+            out = out * self.weight.reshape(shape) + \
+                self.bias.reshape(shape)
+        return out

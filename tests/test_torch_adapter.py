@@ -1,0 +1,214 @@
+"""Torch adapter tests.
+
+Reference analog: test/parallel/test_torch.py (SURVEY.md §4) — collectives
+on torch tensors, DistributedOptimizer gradient averaging, parameter /
+optimizer-state broadcast, compression, SyncBatchNorm.  Single-process
+world here (the per-rank semantics are covered by the launcher integration
+tests); these verify the adapter's bridging, hooks and state machinery.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import horovod_tpu.torch as hvd  # noqa: E402
+
+
+def test_allreduce_roundtrip():
+    t = torch.arange(6, dtype=torch.float32).reshape(2, 3)
+    out = hvd.allreduce(t)
+    assert isinstance(out, torch.Tensor)
+    assert out.dtype == t.dtype
+    torch.testing.assert_close(out, t)  # world of one process: identity
+
+
+def test_allreduce_inplace_and_handles():
+    t = torch.ones(4)
+    h = hvd.allreduce_async_(t, op=hvd.Sum)
+    assert hvd.poll(h) in (True, False)
+    out = hvd.synchronize(h)
+    assert out is t
+    torch.testing.assert_close(t, torch.ones(4))
+
+
+def test_allreduce_prescale():
+    t = torch.ones(3)
+    out = hvd.allreduce(t, op=hvd.Sum, prescale_factor=2.0)
+    torch.testing.assert_close(out, torch.full((3,), 2.0))
+
+
+def test_grouped_allreduce():
+    ts = [torch.ones(2), torch.full((3,), 2.0)]
+    outs = hvd.grouped_allreduce(ts)
+    assert len(outs) == 2
+    torch.testing.assert_close(outs[1], torch.full((3,), 2.0))
+
+
+def test_allgather_broadcast_alltoall():
+    t = torch.arange(4, dtype=torch.float32)
+    torch.testing.assert_close(hvd.allgather(t), t)
+    torch.testing.assert_close(hvd.broadcast(t, root_rank=0), t)
+    received, splits = hvd.alltoall(t)
+    torch.testing.assert_close(received, t)
+    assert splits.sum().item() == 4
+
+
+def test_int_dtypes_preserved():
+    t = torch.arange(5, dtype=torch.int64)
+    out = hvd.allreduce(t, op=hvd.Sum)
+    assert out.dtype == torch.int64
+    torch.testing.assert_close(out, t)
+
+
+def test_compression_fp16_roundtrip():
+    t = torch.randn(8)
+    c, ctx = hvd.Compression.fp16.compress(t)
+    assert c.dtype == torch.float16
+    d = hvd.Compression.fp16.decompress(c, ctx)
+    assert d.dtype == torch.float32
+    torch.testing.assert_close(d, t, rtol=1e-3, atol=1e-3)
+
+
+def _train_step(model, opt, x, y):
+    opt.zero_grad()
+    loss = torch.nn.functional.mse_loss(model(x), y)
+    loss.backward()
+    opt.step()
+    return float(loss)
+
+
+def test_distributed_optimizer_trains():
+    torch.manual_seed(0)
+    model = torch.nn.Sequential(
+        torch.nn.Linear(4, 8), torch.nn.ReLU(), torch.nn.Linear(8, 1)
+    )
+    opt = torch.optim.SGD(model.parameters(), lr=0.1)
+    opt = hvd.DistributedOptimizer(
+        opt, named_parameters=model.named_parameters()
+    )
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+
+    x = torch.randn(16, 4)
+    y = x.sum(dim=1, keepdim=True)
+    losses = [_train_step(model, opt, x, y) for _ in range(20)]
+    assert losses[-1] < losses[0] * 0.5  # actually learning
+
+
+def test_distributed_optimizer_matches_local_sgd():
+    """With one worker the wrapped optimizer must match plain SGD exactly
+    (the reference's correctness invariant for np=1)."""
+    def build():
+        torch.manual_seed(7)
+        m = torch.nn.Linear(3, 1)
+        return m
+
+    m1, m2 = build(), build()
+    o1 = torch.optim.SGD(m1.parameters(), lr=0.05)
+    o2 = hvd.DistributedOptimizer(
+        torch.optim.SGD(m2.parameters(), lr=0.05),
+        named_parameters=m2.named_parameters(),
+    )
+    x = torch.randn(8, 3)
+    y = torch.randn(8, 1)
+    for _ in range(5):
+        _train_step(m1, o1, x, y)
+        _train_step(m2, o2, x, y)
+    for p1, p2 in zip(m1.parameters(), m2.parameters()):
+        torch.testing.assert_close(p1, p2)
+
+
+def test_backward_passes_per_step_accumulates():
+    model = torch.nn.Linear(2, 1, bias=False)
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=1.0),
+        named_parameters=model.named_parameters(),
+        backward_passes_per_step=2,
+    )
+    x = torch.ones(1, 2)
+    opt.zero_grad()
+    (model(x).sum()).backward()
+    assert not opt._handles  # first pass: only locally accumulated
+    (model(x).sum()).backward()
+    assert opt._handles  # second pass submitted the allreduce
+    opt.step()
+
+
+def test_skip_synchronize_pattern():
+    model = torch.nn.Linear(2, 1)
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1),
+        named_parameters=model.named_parameters(),
+    )
+    opt.zero_grad()
+    model(torch.ones(1, 2)).sum().backward()
+    opt.synchronize()
+    torch.nn.utils.clip_grad_norm_(model.parameters(), 1.0)
+    with opt.skip_synchronize():
+        opt.step()
+
+
+def test_broadcast_optimizer_state_roundtrip():
+    model = torch.nn.Linear(3, 2)
+    opt = torch.optim.Adam(model.parameters(), lr=1e-3)
+    model(torch.randn(4, 3)).sum().backward()
+    opt.step()
+    hvd.broadcast_optimizer_state(opt, root_rank=0)
+    sd = opt.state_dict()
+    assert sd["state"]  # momentum buffers survived the roundtrip
+    for st in sd["state"].values():
+        assert isinstance(st["exp_avg"], torch.Tensor)
+
+
+def test_sync_batch_norm_single_worker_matches_bn():
+    torch.manual_seed(0)
+    x = torch.randn(8, 4, 5, 5)
+    bn = torch.nn.BatchNorm2d(4)
+    sbn = hvd.SyncBatchNorm(4)
+    sbn.load_state_dict(bn.state_dict())
+    bn.train(), sbn.train()
+    # single process: SyncBatchNorm takes the plain-BN fast path
+    torch.testing.assert_close(bn(x), sbn(x))
+
+
+def test_sync_batch_norm_stats_math():
+    """Force the collective path and check it equals plain BN in a world
+    of one (the reduction is then an identity)."""
+    import horovod_tpu.torch.sync_batch_norm as sbn_mod
+
+    torch.manual_seed(1)
+    x = torch.randn(6, 3, 4, requires_grad=True)
+    x2 = x.detach().clone().requires_grad_(True)
+    bn = torch.nn.BatchNorm1d(3)
+    sbn = hvd.SyncBatchNorm(3)
+    sbn.load_state_dict(bn.state_dict())
+    bn.train(), sbn.train()
+
+    orig = sbn_mod.basics.cross_size
+    sbn_mod.basics.cross_size = lambda: 2  # pretend multi-worker
+    try:
+        out = sbn(x)
+    finally:
+        sbn_mod.basics.cross_size = orig
+    ref = bn(x2)
+    torch.testing.assert_close(out, ref, rtol=1e-4, atol=1e-4)
+    out.sum().backward()
+    ref.sum().backward()
+    torch.testing.assert_close(x.grad, x2.grad, rtol=1e-4, atol=1e-4)
+    torch.testing.assert_close(sbn.running_mean, bn.running_mean,
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_torch_elastic_state_roundtrip():
+    model = torch.nn.Linear(2, 2)
+    opt = torch.optim.SGD(model.parameters(), lr=0.1)
+    state = hvd.elastic.TorchState(model=model, optimizer=opt, epoch=0)
+    w0 = model.weight.detach().clone()
+    state.commit()
+    with torch.no_grad():
+        model.weight.add_(1.0)
+    state.epoch = 5
+    state.restore()
+    torch.testing.assert_close(model.weight.detach(), w0)
+    assert state.epoch == 0
+    assert state.model is model  # restored in place via load_state_dict
