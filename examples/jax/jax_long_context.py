@@ -1,0 +1,61 @@
+"""Long-context attention: ring vs Ulysses sequence parallelism.
+
+No reference analog (the reference is data-parallel only, SURVEY.md §5.7);
+this demonstrates the framework's first-class long-context pillar: a
+sequence too large for one chip's memory, sharded over the mesh, with
+exact causal attention computed by either strategy.
+
+Run (8 virtual chips):
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python examples/jax/jax_long_context.py
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.parallel import ring_attention, ulysses_attention
+
+
+def main():
+    hvd.init()
+    n = hvd.size()
+    mesh = hvd.world_mesh()
+    axis = hvd.WORLD_AXIS
+
+    b, s_global, heads, dh = 1, 8192, 8, 64
+    print(f"sequence {s_global} over {n} chips "
+          f"({s_global // n} per chip)")
+    rng = np.random.RandomState(0)
+    shape = (b, s_global, heads, dh)
+    q = jnp.asarray(rng.randn(*shape).astype(np.float32) * 0.1)
+    k = jnp.asarray(rng.randn(*shape).astype(np.float32) * 0.1)
+    v = jnp.asarray(rng.randn(*shape).astype(np.float32) * 0.1)
+
+    specs = dict(
+        in_specs=(P(None, axis), P(None, axis), P(None, axis)),
+        out_specs=P(None, axis), check_vma=False,
+    )
+    ring = jax.jit(jax.shard_map(
+        lambda a, b_, c: ring_attention(a, b_, c, axis_name=axis),
+        mesh=mesh, **specs))
+    ulysses = jax.jit(jax.shard_map(
+        lambda a, b_, c: ulysses_attention(a, b_, c, axis_name=axis),
+        mesh=mesh, **specs))
+
+    for name, fn in [("ring", ring), ("ulysses", ulysses)]:
+        out = jax.block_until_ready(fn(q, k, v))  # compile + run
+        t0 = time.perf_counter()
+        for _ in range(3):
+            out = jax.block_until_ready(fn(q, k, v))
+        dt = (time.perf_counter() - t0) / 3
+        print(f"{name:8s}: {dt * 1e3:8.1f} ms/step  "
+              f"out[0,0,0,:3]={np.asarray(out)[0, 0, 0, :3]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -76,6 +76,9 @@ from .functions import (
     broadcast_optimizer_state,
     broadcast_parameters,
 )
+from . import callbacks, checkpoint, elastic
+from .compression import Compression
+from .sync_batch_norm import SyncBatchNorm
 from .optim import (
     DistributedOptimizer,
     allreduce_gradients,

@@ -1,0 +1,312 @@
+"""Training-loop callbacks.
+
+Reference parity: horovod/keras/callbacks.py + horovod/_keras/callbacks.py
+(SURVEY.md §2.3): BroadcastGlobalVariablesCallback, MetricAverageCallback,
+LearningRateWarmupCallback, LearningRateScheduleCallback — re-expressed
+for the optax/flax training loop.
+
+The learning-rate callbacks need a mutable LR.  The optax-idiomatic
+equivalent is ``optax.inject_hyperparams``, which turns the learning rate
+into a leaf of ``opt_state`` that can be rewritten between steps without
+recompiling::
+
+    optimizer = optax.inject_hyperparams(optax.sgd)(learning_rate=0.1)
+    loop = hvd.callbacks.TrainLoop(state, callbacks=[
+        hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+        hvd.callbacks.LearningRateWarmupCallback(target_lr=0.1 * hvd.size(),
+                                                 warmup_epochs=5,
+                                                 steps_per_epoch=100),
+        hvd.callbacks.MetricAverageCallback(),
+    ])
+    for epoch in range(epochs):
+        loop.on_epoch_begin(epoch)
+        for batch, (x, y) in enumerate(loader):
+            loop.on_batch_begin(batch)
+            loop.state, loss = step(loop.state, x, y)
+            loop.on_batch_end(batch, {"loss": float(loss)})
+        logs = loop.on_epoch_end(epoch, {"loss": epoch_loss})
+
+For fully-static schedules, prefer :func:`warmup_schedule` (a plain optax
+schedule baked into the compiled step) — the TPU-native spelling.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from .common import basics
+from .ops import collective_ops
+from .ops.reduce_ops import Average
+
+
+# -- LR plumbing -------------------------------------------------------------
+
+
+def _find_hyperparams(opt_state):
+    """Locate InjectStatefulHyperparamsState dicts inside an opt_state tree."""
+    found = []
+
+    def visit(node):
+        hp = getattr(node, "hyperparams", None)
+        if isinstance(hp, dict) and "learning_rate" in hp:
+            found.append(node)
+        if isinstance(node, tuple):
+            for child in node:
+                visit(child)
+
+    visit(opt_state)
+    return found
+
+
+def get_lr(opt_state) -> float:
+    nodes = _find_hyperparams(opt_state)
+    if not nodes:
+        raise ValueError(
+            "no injected learning_rate found; build the optimizer with "
+            "optax.inject_hyperparams (see horovod_tpu.callbacks docstring)"
+        )
+    return float(np.asarray(nodes[0].hyperparams["learning_rate"]))
+
+
+def set_lr(opt_state, lr: float):
+    """Rewrite the injected learning-rate leaf (no recompilation)."""
+    nodes = _find_hyperparams(opt_state)
+    if not nodes:
+        raise ValueError(
+            "no injected learning_rate found; build the optimizer with "
+            "optax.inject_hyperparams (see horovod_tpu.callbacks docstring)"
+        )
+    for node in nodes:
+        node.hyperparams["learning_rate"] = jnp.asarray(
+            lr, node.hyperparams["learning_rate"].dtype
+        )
+    return opt_state
+
+
+# -- loop + callback protocol ------------------------------------------------
+
+
+class Callback:
+    loop: "TrainLoop"
+
+    def set_loop(self, loop: "TrainLoop") -> None:
+        self.loop = loop
+
+    def on_train_begin(self) -> None: ...
+
+    def on_epoch_begin(self, epoch: int) -> None: ...
+
+    def on_batch_begin(self, batch: int) -> None: ...
+
+    def on_batch_end(self, batch: int, logs: Optional[dict] = None) -> None:
+        ...
+
+    def on_epoch_end(self, epoch: int,
+                     logs: Optional[dict] = None) -> Optional[dict]: ...
+
+
+class TrainLoop:
+    """Thin callback host around a TrainState (stands in for the Keras
+    ``model`` object the reference callbacks mutate)."""
+
+    def __init__(self, state, callbacks: List[Callback]):
+        self.state = state
+        self.callbacks = callbacks
+        self.epoch = 0
+        self.batch = 0
+        for cb in callbacks:
+            cb.set_loop(self)
+        self._began = False
+
+    # lr accessors proxy into the live opt_state
+    @property
+    def lr(self) -> float:
+        return get_lr(self.state.opt_state)
+
+    @lr.setter
+    def lr(self, value: float) -> None:
+        self.state = self.state.replace(
+            opt_state=set_lr(self.state.opt_state, value)
+        )
+
+    def on_epoch_begin(self, epoch: int) -> None:
+        if not self._began:
+            self._began = True
+            for cb in self.callbacks:
+                cb.on_train_begin()
+        self.epoch = epoch
+        for cb in self.callbacks:
+            cb.on_epoch_begin(epoch)
+
+    def on_batch_begin(self, batch: int) -> None:
+        self.batch = batch
+        for cb in self.callbacks:
+            cb.on_batch_begin(batch)
+
+    def on_batch_end(self, batch: int, logs: Optional[dict] = None) -> None:
+        for cb in self.callbacks:
+            cb.on_batch_end(batch, logs)
+
+    def on_epoch_end(self, epoch: int,
+                     logs: Optional[dict] = None) -> Optional[dict]:
+        for cb in self.callbacks:
+            out = cb.on_epoch_end(epoch, logs)
+            if out is not None:
+                logs = out
+        return logs
+
+
+# -- the reference callbacks -------------------------------------------------
+
+
+class BroadcastGlobalVariablesCallback(Callback):
+    """Broadcast initial state from root so all workers start identical
+    (reference: keras/callbacks.py BroadcastGlobalVariablesCallback)."""
+
+    def __init__(self, root_rank: int = 0):
+        self.root_rank = root_rank
+
+    def on_train_begin(self) -> None:
+        from . import functions
+
+        st = self.loop.state
+        params = functions.broadcast_parameters(
+            st.params, root_rank=self.root_rank
+        )
+        opt_state = functions.broadcast_optimizer_state(
+            st.opt_state, root_rank=self.root_rank
+        )
+        new = st.replace(params=params, opt_state=opt_state)
+        if getattr(st, "batch_stats", None) is not None:
+            new = new.replace(batch_stats=functions.broadcast_parameters(
+                st.batch_stats, root_rank=self.root_rank
+            ))
+        self.loop.state = new
+
+
+class MetricAverageCallback(Callback):
+    """Average epoch metrics over workers before reporting (reference:
+    keras/callbacks.py MetricAverageCallback)."""
+
+    def on_epoch_end(self, epoch: int,
+                     logs: Optional[dict] = None) -> Optional[dict]:
+        if not logs:
+            return logs
+        out = dict(logs)
+        for k, v in logs.items():
+            if isinstance(v, (int, float, np.floating, np.integer)) or (
+                hasattr(v, "shape") and getattr(v, "shape", None) == ()
+            ):
+                reduced = collective_ops.allreduce(
+                    jnp.asarray(float(v)), op=Average, name=f"metric.{k}"
+                )
+                out[k] = float(np.asarray(reduced))
+        return out
+
+
+class LearningRateWarmupCallback(Callback):
+    """Linear LR warmup over the first epochs (reference:
+    keras/callbacks.py LearningRateWarmupCallback, after Goyal et al. —
+    ramp from ``target_lr / size`` to ``target_lr``, adjusted every batch
+    at epoch + batch/steps_per_epoch granularity)."""
+
+    def __init__(self, target_lr: float, warmup_epochs: float = 5,
+                 steps_per_epoch: Optional[int] = None,
+                 initial_lr: Optional[float] = None, verbose: bool = False):
+        self.target_lr = target_lr
+        self.warmup_epochs = warmup_epochs
+        self.steps_per_epoch = steps_per_epoch
+        self.initial_lr = initial_lr
+        self.verbose = verbose
+        self._current_epoch = 0
+
+    def _initial(self) -> float:
+        if self.initial_lr is not None:
+            return self.initial_lr
+        size = basics.size() if basics.is_initialized() else 1
+        return self.target_lr / size
+
+    def on_epoch_begin(self, epoch: int) -> None:
+        self._current_epoch = epoch
+
+    def on_batch_begin(self, batch: int) -> None:
+        if self._current_epoch >= self.warmup_epochs:
+            return
+        if self.steps_per_epoch:
+            progress = (self._current_epoch +
+                        batch / self.steps_per_epoch) / self.warmup_epochs
+        else:
+            progress = self._current_epoch / self.warmup_epochs
+        progress = min(max(progress, 0.0), 1.0)
+        init = self._initial()
+        self.loop.lr = init + (self.target_lr - init) * progress
+
+    def on_epoch_end(self, epoch: int,
+                     logs: Optional[dict] = None) -> Optional[dict]:
+        if epoch + 1 == self.warmup_epochs:
+            self.loop.lr = self.target_lr
+            if self.verbose:
+                print(f"Epoch {epoch + 1}: finished gradual learning rate "
+                      f"warmup to {self.target_lr}.")
+        return logs
+
+
+class LearningRateScheduleCallback(Callback):
+    """Piecewise LR schedule (reference: keras/callbacks.py
+    LearningRateScheduleCallback): within [start_epoch, end_epoch) the LR
+    is ``initial_lr * multiplier(epoch)`` (or a constant multiplier)."""
+
+    def __init__(self, initial_lr: float,
+                 multiplier: Union[float, Callable[[int], float]],
+                 start_epoch: int = 0, end_epoch: Optional[int] = None,
+                 staircase: bool = True,
+                 steps_per_epoch: Optional[int] = None):
+        self.initial_lr = initial_lr
+        self.multiplier = multiplier
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.steps_per_epoch = steps_per_epoch
+        self._current_epoch = 0
+
+    def _mult(self, epoch: float) -> float:
+        if callable(self.multiplier):
+            return self.multiplier(epoch)
+        return self.multiplier
+
+    def _in_range(self, epoch: float) -> bool:
+        if epoch < self.start_epoch:
+            return False
+        return self.end_epoch is None or epoch < self.end_epoch
+
+    def on_epoch_begin(self, epoch: int) -> None:
+        self._current_epoch = epoch
+        if self.staircase and self._in_range(epoch):
+            self.loop.lr = self.initial_lr * self._mult(epoch)
+
+    def on_batch_begin(self, batch: int) -> None:
+        if self.staircase or not self.steps_per_epoch:
+            return
+        epoch = self._current_epoch + batch / self.steps_per_epoch
+        if self._in_range(epoch):
+            self.loop.lr = self.initial_lr * self._mult(epoch)
+
+
+# -- TPU-native static schedules --------------------------------------------
+
+
+def warmup_schedule(target_lr: float, warmup_steps: int,
+                    initial_lr: Optional[float] = None) -> optax.Schedule:
+    """Optax schedule form of LearningRateWarmupCallback — bake the warmup
+    into the compiled step (no host round-trip per batch)."""
+    if initial_lr is None:
+        initial_lr = target_lr / (
+            basics.size() if basics.is_initialized() else 1
+        )
+    return optax.linear_schedule(initial_lr, target_lr, warmup_steps)

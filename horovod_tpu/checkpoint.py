@@ -1,0 +1,112 @@
+"""Checkpoint save/resume helpers.
+
+Reference parity (SURVEY.md §5.4): the reference has no bespoke format —
+rank 0 writes a framework checkpoint, resume re-broadcasts from root
+(examples/ pattern: ``torch.save`` + ``broadcast_parameters`` +
+``broadcast_optimizer_state``).  This module packages exactly that
+pattern for the JAX loop:
+
+  * :func:`save_checkpoint` — rank 0 serializes the state pytree
+    (flax msgpack; any pytree of arrays works) to ``<dir>/ckpt-<step>``;
+  * :func:`restore_checkpoint` — every worker reads the latest checkpoint
+    if present (shared filesystem), or rank 0 reads and the state is
+    broadcast (``broadcast=True``) — the §5.4(b) resume flow.
+
+Orbax remains the right tool for sharded multi-host checkpoints of very
+large models; these helpers cover the reference's replicated-weights
+contract without extra dependencies.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Optional
+
+import flax.serialization
+import jax
+import numpy as np
+
+from . import functions
+from .common import basics
+
+_CKPT_RE = re.compile(r"^ckpt-(\d+)$")
+
+
+def _is_root() -> bool:
+    return not basics.is_initialized() or basics.rank() == 0
+
+
+def save_checkpoint(directory: str, state: Any, step: int,
+                    keep: int = 3) -> Optional[str]:
+    """Rank-0 checkpoint write (reference: the ``if hvd.rank() == 0:
+    torch.save(...)`` idiom).  Returns the path written (root only)."""
+    if not _is_root():
+        return None
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, f"ckpt-{int(step)}")
+    payload = flax.serialization.to_bytes(
+        jax.tree_util.tree_map(np.asarray, state)
+    )
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(payload)
+    os.replace(tmp, path)  # atomic publish
+    _prune(directory, keep)
+    return path
+
+
+def _prune(directory: str, keep: int) -> None:
+    ckpts = sorted(
+        (int(m.group(1)), name)
+        for name in os.listdir(directory)
+        if (m := _CKPT_RE.match(name))
+    )
+    for _, name in ckpts[:-keep] if keep else []:
+        os.remove(os.path.join(directory, name))
+
+
+def latest_checkpoint(directory: str) -> Optional[str]:
+    if not os.path.isdir(directory):
+        return None
+    ckpts = sorted(
+        (int(m.group(1)), name)
+        for name in os.listdir(directory)
+        if (m := _CKPT_RE.match(name))
+    )
+    return os.path.join(directory, ckpts[-1][1]) if ckpts else None
+
+
+def restore_checkpoint(directory: str, state: Any,
+                       broadcast: bool = True) -> Any:
+    """Restore the latest checkpoint into ``state``'s structure.
+
+    With ``broadcast=True`` only rank 0 needs to see the file; the loaded
+    state is broadcast to all workers (reference resume flow:
+    load-on-root + broadcast_parameters/broadcast_optimizer_state).
+    Returns ``state`` unchanged when no checkpoint exists.
+    """
+    path = latest_checkpoint(directory)
+    multi = basics.is_initialized() and basics.cross_size() > 1
+    if not multi:
+        if path is None:
+            return state
+        with open(path, "rb") as f:
+            return flax.serialization.from_bytes(state, f.read())
+
+    if broadcast:
+        found = functions.broadcast_object(path is not None, root_rank=0)
+        if not found:
+            return state
+        if basics.rank() == 0:
+            with open(path, "rb") as f:
+                loaded = flax.serialization.from_bytes(state, f.read())
+        else:
+            loaded = state
+        host = jax.tree_util.tree_map(np.asarray, loaded)
+        return functions.broadcast_object(host, root_rank=0)
+
+    if path is None:
+        return state
+    with open(path, "rb") as f:
+        return flax.serialization.from_bytes(state, f.read())

@@ -82,22 +82,30 @@ def DistributedOptimizer(
     postscale_factor: float = 1.0,
     process_set: Optional[ProcessSet] = None,
     backward_passes_per_step: int = 1,
+    compression=None,
 ) -> optax.GradientTransformation:
     """Wrap an optax optimizer so updates see globally reduced gradients.
 
     Reference: horovod/torch/optimizer.py DistributedOptimizer — same
     contract (wraps an existing optimizer, averages grads across workers,
-    supports op=Sum/Average/Adasum, pre/postscale, process sets and local
-    aggregation), expressed as an optax gradient transformation.
+    supports op=Sum/Average/Adasum, pre/postscale, process sets, fp16/bf16
+    ``compression`` on the wire, and local aggregation), expressed as an
+    optax gradient transformation.
     """
-    grad_reduce = optax.stateless(
-        lambda updates, params=None: allreduce_gradients(
+    def _reduce(updates, params=None):
+        if compression is not None:
+            updates, ctx = compression.compress(updates)
+        updates = allreduce_gradients(
             updates, op=op, axis=axis,
             prescale_factor=prescale_factor,
             postscale_factor=postscale_factor,
             process_set=process_set,
         )
-    )
+        if compression is not None:
+            updates = compression.decompress(updates, ctx)
+        return updates
+
+    grad_reduce = optax.stateless(_reduce)
     chained = optax.chain(grad_reduce, optimizer)
     if backward_passes_per_step > 1:
         chained = optax.MultiSteps(

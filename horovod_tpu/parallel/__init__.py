@@ -1,5 +1,14 @@
 """Parallelism strategies beyond data parallel: hierarchical ICI/DCN
-reduction, ring attention, Ulysses sequence parallelism (SURVEY.md §2.6).
-The reference is data-parallel only; these modules exist because on TPU the
-same mesh machinery makes them cheap and they are first-class in this
-framework's scope."""
+reduction, ring attention, Ulysses sequence parallelism, Megatron-style
+tensor parallelism, expert-parallel MoE and pipeline parallelism
+(SURVEY.md §2.6).  The reference is data-parallel only; these modules
+exist because on TPU the same mesh machinery makes them cheap and they
+are first-class in this framework's scope."""
+
+from .ring_attention import ring_attention  # noqa: F401
+from .ulysses import heads_to_seq, seq_to_heads, ulysses_attention  # noqa: F401
+from .tensor_parallel import (  # noqa: F401
+    ColumnParallelDense, RowParallelDense, TensorParallelAttention,
+    TensorParallelMlp,
+)
+from .moe import ExpertParallelMoe  # noqa: F401

@@ -1,0 +1,139 @@
+"""Mixture-of-Experts with expert parallelism over an ``ep`` mesh axis.
+
+No reference analog — the reference ships ``alltoall`` largely *for* MoE
+users (SURVEY.md §5.7) but no MoE layer; here the layer itself is
+first-class.  (Lepikhin et al., "GShard", 2020 — PAPERS.md.)
+
+Design (top-1 switch routing, Fedus et al. 2021, capacity-factor
+dropping):
+
+  * each chip holds ``num_experts / ep`` experts' weights;
+  * tokens are routed by a learned gate; a chip packs its tokens into
+    per-expert capacity buffers (static shapes — XLA-friendly: dropped
+    tokens pass through the residual);
+  * ONE ``all_to_all`` sends buffers to the experts' owners, the expert
+    MLPs run as a batched einsum over the local experts (MXU-dense), and
+    a second ``all_to_all`` returns outputs.
+
+Everything is static-shaped: scatter/gather by one-hot matmuls, the
+standard TPU MoE formulation.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def _axis_size(axis: Optional[str]) -> int:
+    if axis is None:
+        return 1
+    try:
+        return jax.lax.axis_size(axis)
+    except (NameError, Exception):
+        return 1
+
+
+class ExpertParallelMoe(nn.Module):
+    """Switch-style top-1 MoE layer, experts sharded over ``axis``.
+
+    Input/output: (B, S, d_model) — the local batch/sequence shard.
+    Returns (output, aux_loss); add ``aux_loss`` (load-balancing, Fedus et
+    al. eq. 4) to the training loss.
+    """
+
+    num_experts: int  # GLOBAL expert count
+    d_model: int
+    d_ff: int
+    axis: Optional[str] = "ep"
+    capacity_factor: float = 1.25
+    activation: Callable = nn.gelu
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        ep = _axis_size(self.axis)
+        if self.num_experts % ep:
+            raise ValueError(
+                f"experts {self.num_experts} not divisible by ep={ep}"
+            )
+        local_e = self.num_experts // ep
+        b, s, d = x.shape
+        tokens = x.reshape(b * s, d)
+        n_tok = b * s
+        capacity = max(
+            1, int(self.capacity_factor * n_tok / self.num_experts)
+        )
+
+        # -- gate (computed in f32 for routing stability) ------------------
+        gate_w = self.param("gate", nn.initializers.lecun_normal(),
+                            (d, self.num_experts), jnp.float32)
+        logits = jnp.dot(tokens.astype(jnp.float32), gate_w)
+        probs = jax.nn.softmax(logits, axis=-1)  # (T, E)
+        expert_idx = jnp.argmax(probs, axis=-1)  # (T,)
+        gate_val = jnp.max(probs, axis=-1)  # (T,)
+
+        # load-balancing aux loss: E * sum_e fraction_tokens_e * mean_prob_e
+        one_hot = jax.nn.one_hot(expert_idx, self.num_experts)  # (T, E)
+        frac = one_hot.mean(axis=0)
+        mean_prob = probs.mean(axis=0)
+        aux_loss = self.num_experts * jnp.sum(frac * mean_prob)
+
+        # -- capacity assignment: position of each token within its expert
+        pos_in_expert = (jnp.cumsum(one_hot, axis=0) - 1.0) * one_hot
+        pos = jnp.sum(pos_in_expert, axis=-1)  # (T,)
+        keep = pos < capacity
+        one_hot = one_hot * keep[:, None]
+        gate_val = gate_val * keep
+
+        # dispatch tensor: (T, E, C) one-hot of (expert, slot)
+        slot_oh = jax.nn.one_hot(pos.astype(jnp.int32), capacity) \
+            * keep[:, None]
+        dispatch = one_hot[:, :, None] * slot_oh[:, None, :]
+        # (E, C, d): per-expert capacity buffers
+        buffers = jnp.einsum("tec,td->ecd", dispatch, tokens.astype(
+            jnp.float32)).astype(self.dtype)
+
+        # -- all_to_all to expert owners -----------------------------------
+        if ep > 1:
+            # (E, C, d): dim0 chunk o (this chip's tokens for owner o's
+            # experts) goes to chip o; received buffers concatenate along
+            # the capacity dim -> (local_E, ep*C, d), columns ordered by
+            # source chip
+            buffers = jax.lax.all_to_all(
+                buffers, self.axis, split_axis=0, concat_axis=1, tiled=True
+            )
+        else:
+            buffers = buffers.reshape(local_e, capacity, d)
+
+        # -- local experts: batched einsum over local_E (MXU) --------------
+        wi = self.param("wi", nn.initializers.lecun_normal(),
+                        (local_e, d, self.d_ff), jnp.float32)
+        wo = self.param("wo", nn.initializers.lecun_normal(),
+                        (local_e, self.d_ff, d), jnp.float32)
+        h = jnp.einsum("ecd,edf->ecf", buffers, wi.astype(self.dtype))
+        h = self.activation(h)
+        out = jnp.einsum("ecf,efd->ecd", h, wo.astype(self.dtype))
+
+        # -- return trip ----------------------------------------------------
+        if ep > 1:
+            # (local_E, ep, C, d): dim1 chunk c (outputs for chip c's
+            # tokens) returns to chip c; received chunks stack along dim0
+            # in owner order == global expert order -> (E, 1, C, d)
+            out = out.reshape(local_e, ep, capacity, d)
+            out = jax.lax.all_to_all(
+                out, self.axis, split_axis=1, concat_axis=0, tiled=True
+            )
+            out = out.reshape(self.num_experts, capacity, d)
+        else:
+            out = out.reshape(self.num_experts, capacity, d)
+
+        # gather back to token order, weighted by the gate value
+        combined = jnp.einsum(
+            "tec,ecd->td", dispatch.astype(self.dtype), out
+        )
+        combined = combined * gate_val[:, None].astype(self.dtype)
+        return combined.reshape(b, s, d), aux_loss

@@ -1,0 +1,91 @@
+"""Pipeline parallelism: GPipe-style microbatched stage execution.
+
+No reference analog (SURVEY.md §2.6 marks PP absent upstream); provided
+as part of this framework's first-class multi-axis story.  (Huang et al.,
+"GPipe", 2019 — PAPERS.md.)
+
+Design — the SPMD circular-pipeline formulation that fits shard_map:
+
+  * the ``pp`` mesh axis holds one *stage* per rank (stage params live
+    only on their rank: ``in_specs=P('pp')`` over a leading stage dim);
+  * the batch is split into M microbatches; each ``lax.fori_loop``
+    iteration every rank runs its stage on the microbatch it currently
+    holds, then passes activations to the next rank with ONE
+    ``ppermute`` (ICI neighbor hop);
+  * after ``M + n - 1`` ticks all microbatches have exited the last
+    stage; outputs are collected on their home microbatch slots.
+
+This is the inference/forward scheduling core; for training, wrap the
+whole pipelined forward in ``jax.grad`` — XLA derives the reverse
+schedule (backward ppermutes) automatically, which is the compiler-native
+replacement for hand-written 1F1B schedules.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    x: jax.Array,
+    num_microbatches: int,
+    axis: str = "pp",
+) -> jax.Array:
+    """Run a pipelined stack of stages over the ``axis`` mesh axis.
+
+    Args:
+      stage_fn: ``(params_for_this_stage, activations) -> activations``;
+        applied by every rank to whatever microbatch it holds.  Must be
+        shape-preserving (classic transformer-block pipelining).
+      stage_params: this rank's stage parameters (shard the stage dim over
+        ``axis`` in the enclosing shard_map).
+      x: (M, mb, ...) — the microbatched local input, identical shape on
+        every rank; only rank 0's values are consumed.
+      num_microbatches: M (static).
+      axis: pipeline mesh axis name (bound inside shard_map).
+
+    Returns:
+      (M, mb, ...) outputs of the final stage, valid on every rank
+      (broadcast back via the closing ppermute ring).
+    """
+    n = jax.lax.axis_size(axis)
+    idx = jax.lax.axis_index(axis)
+    m = num_microbatches
+    if x.shape[0] != m:
+        raise ValueError(f"x dim0 ({x.shape[0]}) must equal M ({m})")
+    if n == 1:
+        return jax.vmap(lambda mb: stage_fn(stage_params, mb))(x)
+
+    fwd = [(i, (i + 1) % n) for i in range(n)]
+    total = m + n - 1
+
+    def tick(t, carry):
+        held, out = carry
+        # feed: rank 0 picks up microbatch t (or zeros once drained)
+        mb_idx = jnp.minimum(t, m - 1)
+        feed = jnp.where(t < m, x[mb_idx], jnp.zeros_like(x[0]))
+        held = jnp.where(idx == 0, feed, held)
+        held = stage_fn(stage_params, held)
+        # collect: last stage finished microbatch (t - (n-1))
+        done_idx = jnp.clip(t - (n - 1), 0, m - 1)
+        is_done = jnp.logical_and(idx == n - 1, t >= n - 1)
+        out = jnp.where(
+            is_done,
+            out.at[done_idx].set(held),
+            out,
+        )
+        held = jax.lax.ppermute(held, axis, fwd)
+        return held, out
+
+    held0 = jnp.zeros_like(x[0])
+    out0 = jnp.zeros_like(x)
+    _, out = jax.lax.fori_loop(0, total, tick, (held0, out0))
+    # outputs live on the last rank; one collective broadcast brings them
+    # home to every rank (psum with a mask keeps it a single allreduce)
+    mask = (idx == n - 1).astype(out.dtype)
+    return jax.lax.psum(out * mask, axis)

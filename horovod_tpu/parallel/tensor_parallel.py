@@ -1,0 +1,157 @@
+"""Tensor (model) parallelism: Megatron-style sharded layers.
+
+No reference analog (SURVEY.md §2.6 marks TP absent upstream); provided
+because the same mesh machinery makes it first-class here.  (Shoeybi et
+al., "Megatron-LM", 2019 — PAPERS.md.)
+
+The classic pairing inside a shard_map'ped step over a ``tp`` mesh axis:
+
+  * :class:`ColumnParallelDense` — weight sharded on the *output* dim;
+    no communication on the forward (each chip computes its slice of the
+    activations).
+  * :class:`RowParallelDense` — weight sharded on the *input* dim; a
+    single ``psum`` over the tp axis reassembles the output.
+
+An attention block becomes: QKV projections column-parallel (heads split
+across tp), local attention on H/n heads, output projection row-parallel
+(one psum).  The MLP becomes column→gelu→row (one psum).  XLA lowers the
+psums onto ICI and fuses them with the surrounding matmuls' epilogues.
+
+Gradients: under SPMD autodiff the transpose of psum/identity is
+identity/psum, so backward communication is derived automatically — no
+hand-written backward collectives (the compiler does what Megatron's
+``f``/``g`` autograd functions hand-code).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def _axis_size(axis: Optional[str]) -> int:
+    if axis is None:
+        return 1
+    try:
+        return jax.lax.axis_size(axis)
+    except (NameError, Exception):
+        return 1
+
+
+class ColumnParallelDense(nn.Module):
+    """Dense with output features sharded over ``axis``: this chip holds
+    ``features // tp`` columns.  Forward needs no communication."""
+
+    features: int  # GLOBAL output features
+    axis: Optional[str] = "tp"
+    use_bias: bool = True
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        tp = _axis_size(self.axis)
+        if self.features % tp:
+            raise ValueError(
+                f"features {self.features} not divisible by tp={tp}"
+            )
+        local = self.features // tp
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (x.shape[-1], local), jnp.float32,
+        )
+        y = jnp.dot(x.astype(self.dtype), kernel.astype(self.dtype))
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros, (local,),
+                              jnp.float32)
+            y = y + bias.astype(self.dtype)
+        return y
+
+
+class RowParallelDense(nn.Module):
+    """Dense with input features sharded over ``axis``: the partial
+    products are summed with ONE psum over the tp axis."""
+
+    features: int
+    axis: Optional[str] = "tp"
+    use_bias: bool = True
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        tp = _axis_size(self.axis)
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (x.shape[-1], self.features), jnp.float32,
+        )
+        y = jnp.dot(x.astype(self.dtype), kernel.astype(self.dtype))
+        if tp > 1:
+            y = jax.lax.psum(y, self.axis)
+        if self.use_bias:
+            # bias applied once, after the reduction
+            bias = self.param("bias", nn.initializers.zeros,
+                              (self.features,), jnp.float32)
+            y = y + bias.astype(self.dtype)
+        return y
+
+
+class TensorParallelMlp(nn.Module):
+    """Column → activation → Row: the Megatron MLP with one forward psum."""
+
+    d_model: int
+    d_ff: int
+    axis: Optional[str] = "tp"
+    activation: Callable = nn.gelu
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        h = ColumnParallelDense(self.d_ff, axis=self.axis,
+                                dtype=self.dtype, name="wi")(x)
+        h = self.activation(h)
+        return RowParallelDense(self.d_model, axis=self.axis,
+                                dtype=self.dtype, name="wo")(h)
+
+
+class TensorParallelAttention(nn.Module):
+    """Multi-head attention with heads sharded over the tp axis.
+
+    QKV column-parallel (this chip computes H/tp heads), attention local,
+    output projection row-parallel (one psum).  ``attn_fn`` defaults to
+    exact causal attention and may be swapped for ring/ulysses attention
+    to compose TP × SP.
+    """
+
+    num_heads: int  # GLOBAL head count
+    head_dim: int
+    axis: Optional[str] = "tp"
+    attn_fn: Optional[Callable] = None
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        tp = _axis_size(self.axis)
+        if self.num_heads % tp:
+            raise ValueError(
+                f"heads {self.num_heads} not divisible by tp={tp}"
+            )
+        local_heads = self.num_heads // tp
+        d_model = x.shape[-1]
+        qkv_features = self.num_heads * self.head_dim
+        qkv = ColumnParallelDense(3 * qkv_features, axis=self.axis,
+                                  use_bias=False, dtype=self.dtype,
+                                  name="qkv")(x)
+        b, s = qkv.shape[0], qkv.shape[1]
+        qkv = qkv.reshape(b, s, 3, local_heads, self.head_dim)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        attn = self.attn_fn
+        if attn is None:
+            from ..models.transformer import causal_dot_attention
+
+            attn = causal_dot_attention
+        out = attn(q, k, v)  # (B, S, H/tp, D)
+        out = out.reshape(b, s, local_heads * self.head_dim)
+        return RowParallelDense(d_model, axis=self.axis, use_bias=False,
+                                dtype=self.dtype, name="proj")(out)

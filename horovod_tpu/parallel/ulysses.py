@@ -1,0 +1,81 @@
+"""Ulysses (DeepSpeed-style) sequence parallelism via all-to-all.
+
+No reference analog — the reference is data-parallel only (SURVEY.md §5.7)
+but ships ``alltoall`` precisely because schemes like this are built from
+it; here the scheme itself is first-class.  (Jacobs et al., "DeepSpeed
+Ulysses", 2023 — PAPERS.md.)
+
+Idea: activations are sequence-sharded (each chip holds S/n of the
+sequence).  Attention needs full-sequence context per head, so before
+attention an all-to-all re-shards from sequence-split to *head*-split
+(each chip now holds H/n heads over the FULL sequence), runs ordinary
+attention locally, and a second all-to-all restores sequence sharding.
+Two ``lax.all_to_all`` hops per layer over ICI versus ring attention's n
+``ppermute`` hops — cheaper for moderate sequence lengths; ring wins when
+the sequence no longer fits even head-sharded.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..common.topology import WORLD_AXIS
+
+
+def seq_to_heads(x: jax.Array, axis: str) -> jax.Array:
+    """(B, S/n, H, D) sequence-sharded -> (B, S, H/n, D) head-sharded.
+
+    ``lax.all_to_all`` with tiled=True: splits the head dim across the
+    axis and concatenates the gathered sequence chunks.
+    """
+    return jax.lax.all_to_all(
+        x, axis, split_axis=2, concat_axis=1, tiled=True
+    )
+
+
+def heads_to_seq(x: jax.Array, axis: str) -> jax.Array:
+    """(B, S, H/n, D) head-sharded -> (B, S/n, H, D) sequence-sharded."""
+    return jax.lax.all_to_all(
+        x, axis, split_axis=1, concat_axis=2, tiled=True
+    )
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: Optional[str] = None,
+    attn_fn: Optional[Callable] = None,
+) -> jax.Array:
+    """Exact attention over a sequence-sharded axis via two all-to-alls.
+
+    Args:
+      q, k, v: (B, S_local, H, D) — the local sequence shard.  H must be
+        divisible by the axis size.
+      axis_name: mesh axis the sequence is sharded over (bound inside
+        shard_map); defaults to the world axis.
+      attn_fn: local attention callable ``(q, k, v) -> out`` on
+        full-sequence, head-sharded tensors; defaults to exact causal
+        attention.
+    Returns:
+      (B, S_local, H, D) output, sequence-sharded like the input.
+    """
+    axis = axis_name or WORLD_AXIS
+    n = jax.lax.axis_size(axis)
+    if attn_fn is None:
+        from ..models.transformer import causal_dot_attention
+
+        attn_fn = causal_dot_attention
+    if n == 1:
+        return attn_fn(q, k, v)
+    h = q.shape[2]
+    if h % n:
+        raise ValueError(
+            f"ulysses needs heads ({h}) divisible by axis size ({n})"
+        )
+    q, k, v = (seq_to_heads(t, axis) for t in (q, k, v))
+    out = attn_fn(q, k, v)  # (B, S, H/n, D), full sequence locally
+    return heads_to_seq(out, axis)

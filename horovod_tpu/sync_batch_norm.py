@@ -1,0 +1,38 @@
+"""SyncBatchNorm for the JAX/flax path.
+
+Reference parity: horovod/torch/sync_batch_norm.py (SURVEY.md §2.3) —
+batch statistics reduced across all workers each training step.  On TPU
+the idiomatic form is flax's ``BatchNorm(axis_name=...)`` inside a
+``shard_map``/``pjit`` program: the mean/variance ``pmean`` lowers to an
+ICI allreduce fused into the step.  This module packages that as a
+drop-in module plus a converter mirroring
+``torch.nn.SyncBatchNorm.convert_sync_batchnorm``.
+
+(The torch adapter's eager-autograd version lives in
+``horovod_tpu.torch.sync_batch_norm``.)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+
+from .common.topology import WORLD_AXIS
+
+
+class SyncBatchNorm(nn.BatchNorm):
+    """``nn.BatchNorm`` whose statistics sync over the world axis by
+    default (reference: hvd.SyncBatchNorm).  Use inside a shard_map'ped
+    training step where ``axis_name`` is bound."""
+
+    axis_name: Optional[str] = WORLD_AXIS
+
+
+def cross_replica(bn_cls=nn.BatchNorm, axis: str = WORLD_AXIS):
+    """Partial-application helper: ``cross_replica()`` is BatchNorm with
+    the world axis bound — handy for model definitions that take a norm
+    constructor."""
+    import functools
+
+    return functools.partial(bn_cls, axis_name=axis)

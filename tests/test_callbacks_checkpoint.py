@@ -1,0 +1,180 @@
+"""Callbacks, compression and checkpoint tests.
+
+Reference analog (SURVEY.md §4): the keras callback coverage of
+test/parallel/test_tensorflow2_keras.py (warmup/schedule/metric-average
+callbacks), compression coverage inside test_torch.py, and the
+checkpoint-resume idiom of §5.4.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import callbacks as cb
+from horovod_tpu import checkpoint as ckpt
+
+
+def _make_state(lr=0.1):
+    import flax.struct
+
+    class S(flax.struct.PyTreeNode):
+        step: jax.Array
+        params: dict
+        opt_state: object
+        batch_stats: object = None
+
+    opt = optax.inject_hyperparams(optax.sgd)(learning_rate=lr)
+    params = {"w": jnp.ones((3,))}
+    return S(step=jnp.zeros((), jnp.int32), params=params,
+             opt_state=opt.init(params)), opt
+
+
+# -- lr plumbing -------------------------------------------------------------
+
+def test_get_set_lr_roundtrip():
+    state, opt = _make_state(0.25)
+    assert cb.get_lr(state.opt_state) == pytest.approx(0.25)
+    new_opt_state = cb.set_lr(state.opt_state, 0.5)
+    assert cb.get_lr(new_opt_state) == pytest.approx(0.5)
+    # the rewritten lr actually drives the update
+    g = {"w": jnp.ones((3,))}
+    updates, _ = opt.update(g, new_opt_state, state.params)
+    np.testing.assert_allclose(np.asarray(updates["w"]),
+                               np.full(3, -0.5), rtol=1e-6)
+
+
+def test_set_lr_requires_injected_hyperparams():
+    opt = optax.sgd(0.1)
+    opt_state = opt.init({"w": jnp.ones(2)})
+    with pytest.raises(ValueError):
+        cb.set_lr(opt_state, 0.5)
+
+
+# -- warmup callback ---------------------------------------------------------
+
+def test_warmup_callback_ramps_linearly():
+    state, _ = _make_state(lr=0.0)
+    warmup = cb.LearningRateWarmupCallback(
+        target_lr=0.8, warmup_epochs=4, steps_per_epoch=10, initial_lr=0.0
+    )
+    loop = cb.TrainLoop(state, [warmup])
+    lrs = []
+    for epoch in range(5):
+        loop.on_epoch_begin(epoch)
+        for batch in range(10):
+            loop.on_batch_begin(batch)
+            lrs.append(loop.lr)
+        loop.on_epoch_end(epoch)
+    # linear: first batch ~0, midpoint ~0.4, after warmup pinned at target
+    assert lrs[0] == pytest.approx(0.0)
+    assert lrs[20] == pytest.approx(0.4, abs=0.02)
+    assert lrs[-1] == pytest.approx(0.8)
+
+
+def test_schedule_callback_staircase():
+    state, _ = _make_state(lr=1.0)
+    sched = cb.LearningRateScheduleCallback(
+        initial_lr=1.0, multiplier=lambda e: 0.1 ** (e // 2),
+        start_epoch=0,
+    )
+    loop = cb.TrainLoop(state, [sched])
+    seen = {}
+    for epoch in range(4):
+        loop.on_epoch_begin(epoch)
+        seen[epoch] = loop.lr
+        loop.on_epoch_end(epoch)
+    assert seen[0] == pytest.approx(1.0)
+    assert seen[1] == pytest.approx(1.0)
+    assert seen[2] == pytest.approx(0.1)
+    assert seen[3] == pytest.approx(0.1)
+
+
+def test_metric_average_callback_single_process_identity():
+    state, _ = _make_state()
+    loop = cb.TrainLoop(state, [cb.MetricAverageCallback()])
+    loop.on_epoch_begin(0)
+    logs = loop.on_epoch_end(0, {"loss": 2.5, "acc": 0.75, "name": "x"})
+    assert logs["loss"] == pytest.approx(2.5)
+    assert logs["acc"] == pytest.approx(0.75)
+    assert logs["name"] == "x"  # non-numeric passes through
+
+
+def test_broadcast_callback_runs():
+    state, _ = _make_state()
+    loop = cb.TrainLoop(state, [cb.BroadcastGlobalVariablesCallback(0)])
+    loop.on_epoch_begin(0)  # triggers on_train_begin
+    np.testing.assert_allclose(np.asarray(loop.state.params["w"]),
+                               np.ones(3))
+
+
+def test_warmup_schedule_optax():
+    sched = cb.warmup_schedule(0.8, warmup_steps=8, initial_lr=0.0)
+    assert float(sched(0)) == pytest.approx(0.0)
+    assert float(sched(4)) == pytest.approx(0.4)
+    assert float(sched(8)) == pytest.approx(0.8)
+    assert float(sched(100)) == pytest.approx(0.8)
+
+
+# -- compression -------------------------------------------------------------
+
+def test_compression_fp16_pytree_roundtrip():
+    tree = {"a": jnp.arange(4, dtype=jnp.float32),
+            "b": jnp.ones((2,), jnp.int32),
+            "c": jnp.ones((3,), jnp.bfloat16)}
+    comp, ctx = hvd.Compression.fp16.compress(tree)
+    assert comp["a"].dtype == jnp.float16
+    assert comp["b"].dtype == jnp.int32  # non-float untouched
+    assert comp["c"].dtype == jnp.bfloat16  # already 16-bit: untouched
+    out = hvd.Compression.fp16.decompress(comp, ctx)
+    assert out["a"].dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(out["a"]), np.arange(4))
+
+
+def test_distributed_optimizer_with_compression():
+    opt = hvd.DistributedOptimizer(optax.sgd(0.1),
+                                   compression=hvd.Compression.bf16)
+    params = {"w": jnp.ones((4,))}
+    opt_state = opt.init(params)
+    g = {"w": jnp.full((4,), 0.5)}
+    updates, _ = opt.update(g, opt_state, params)
+    assert updates["w"].dtype == jnp.float32  # decompressed back
+    np.testing.assert_allclose(np.asarray(updates["w"]),
+                               np.full(4, -0.05), rtol=1e-2)
+
+
+# -- checkpoint --------------------------------------------------------------
+
+def test_checkpoint_save_restore_roundtrip(tmp_path):
+    state, opt = _make_state(0.3)
+    state = state.replace(params={"w": jnp.asarray([1.0, 2.0, 3.0])},
+                          step=jnp.asarray(17, jnp.int32))
+    path = ckpt.save_checkpoint(str(tmp_path), state, step=17)
+    assert path and os.path.exists(path)
+
+    fresh, _ = _make_state(0.3)
+    restored = ckpt.restore_checkpoint(str(tmp_path), fresh)
+    np.testing.assert_allclose(np.asarray(restored.params["w"]),
+                               [1.0, 2.0, 3.0])
+    assert int(restored.step) == 17
+    # injected lr survives as part of opt_state
+    assert cb.get_lr(restored.opt_state) == pytest.approx(0.3)
+
+
+def test_checkpoint_pruning_and_latest(tmp_path):
+    state, _ = _make_state()
+    for step in [1, 2, 3, 4, 5]:
+        ckpt.save_checkpoint(str(tmp_path), state, step=step, keep=2)
+    names = sorted(os.listdir(tmp_path))
+    assert names == ["ckpt-4", "ckpt-5"]
+    assert ckpt.latest_checkpoint(str(tmp_path)).endswith("ckpt-5")
+
+
+def test_restore_without_checkpoint_is_identity(tmp_path):
+    state, _ = _make_state()
+    restored = ckpt.restore_checkpoint(str(tmp_path / "nope"), state)
+    assert restored is state
