@@ -49,7 +49,7 @@ from .ops.reduce_ops import Average
 
 
 def _find_hyperparams(opt_state):
-    """Locate InjectStatefulHyperparamsState dicts inside an opt_state tree."""
+    """Locate InjectHyperparams states inside an opt_state tree."""
     found = []
 
     def visit(node):
@@ -75,18 +75,34 @@ def get_lr(opt_state) -> float:
 
 
 def set_lr(opt_state, lr: float):
-    """Rewrite the injected learning-rate leaf (no recompilation)."""
-    nodes = _find_hyperparams(opt_state)
-    if not nodes:
+    """Return a copy of the opt_state with the injected learning-rate
+    leaf replaced (functional — the input state is left untouched, so
+    checkpoint snapshots and rollback copies stay valid)."""
+
+    def rebuild(node):
+        hp = getattr(node, "hyperparams", None)
+        if isinstance(hp, dict) and "learning_rate" in hp and \
+                hasattr(node, "_replace"):
+            new_hp = dict(hp)
+            new_hp["learning_rate"] = jnp.asarray(
+                lr, jnp.asarray(hp["learning_rate"]).dtype
+            )
+            node = node._replace(hyperparams=new_hp)
+        if isinstance(node, tuple):
+            if hasattr(node, "_replace"):  # namedtuple: rebuild fields
+                return node._replace(**{
+                    f: rebuild(getattr(node, f)) for f in node._fields
+                    if isinstance(getattr(node, f), tuple)
+                })
+            return type(node)(rebuild(c) for c in node)
+        return node
+
+    if not _find_hyperparams(opt_state):
         raise ValueError(
             "no injected learning_rate found; build the optimizer with "
             "optax.inject_hyperparams (see horovod_tpu.callbacks docstring)"
         )
-    for node in nodes:
-        node.hyperparams["learning_rate"] = jnp.asarray(
-            lr, node.hyperparams["learning_rate"].dtype
-        )
-    return opt_state
+    return rebuild(opt_state)
 
 
 # -- loop + callback protocol ------------------------------------------------
@@ -249,7 +265,9 @@ class LearningRateWarmupCallback(Callback):
 
     def on_epoch_end(self, epoch: int,
                      logs: Optional[dict] = None) -> Optional[dict]:
-        if epoch + 1 == self.warmup_epochs:
+        # fires exactly on the epoch that crosses warmup_epochs — also for
+        # fractional warmup_epochs (e.g. 2.5 pins the target at epoch 2)
+        if epoch < self.warmup_epochs <= epoch + 1:
             self.loop.lr = self.target_lr
             if self.verbose:
                 print(f"Epoch {epoch + 1}: finished gradual learning rate "
@@ -287,7 +305,11 @@ class LearningRateScheduleCallback(Callback):
 
     def on_epoch_begin(self, epoch: int) -> None:
         self._current_epoch = epoch
-        if self.staircase and self._in_range(epoch):
+        # staircase, or smooth mode without per-batch granularity
+        # available: adjust at epoch boundaries (reference behavior —
+        # never silently skip the schedule)
+        if (self.staircase or not self.steps_per_epoch) and \
+                self._in_range(epoch):
             self.loop.lr = self.initial_lr * self._mult(epoch)
 
     def on_batch_begin(self, batch: int) -> None:

@@ -1,0 +1,20 @@
+"""Shared mesh-axis helpers for the parallelism modules."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+
+def axis_size_or_1(axis: Optional[str]) -> int:
+    """Size of a bound mesh axis, or 1 when ``axis`` is None (layer used
+    unsharded).  An axis *name* that is simply unbound in this trace also
+    degrades to 1 — that is the supported single-chip/test usage — but
+    only the unbound-axis NameError is swallowed; real errors surface."""
+    if axis is None:
+        return 1
+    try:
+        return jax.lax.axis_size(axis)
+    except NameError:
+        return 1

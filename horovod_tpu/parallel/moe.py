@@ -28,13 +28,7 @@ import jax
 import jax.numpy as jnp
 
 
-def _axis_size(axis: Optional[str]) -> int:
-    if axis is None:
-        return 1
-    try:
-        return jax.lax.axis_size(axis)
-    except (NameError, Exception):
-        return 1
+from ._mesh_utils import axis_size_or_1 as _axis_size
 
 
 class ExpertParallelMoe(nn.Module):

@@ -23,6 +23,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..common import basics
+from ._mesh_utils import axis_size_or_1 as _axis_size_or_1
 from .tensor_parallel import TensorParallelAttention, TensorParallelMlp
 from .ulysses import ulysses_attention
 
@@ -98,11 +99,6 @@ class MultiAxisTransformer(nn.Module):
         return jnp.dot(x, emb.T.astype(self.dtype))  # tied head
 
 
-def _axis_size_or_1(axis: str) -> int:
-    try:
-        return jax.lax.axis_size(axis)
-    except (NameError, Exception):
-        return 1
 
 
 def param_specs(params: Any) -> Any:
@@ -127,26 +123,42 @@ def param_specs(params: Any) -> Any:
 
 def init_sharded(model: MultiAxisTransformer, mesh: Mesh, rng,
                  local_batch: int = 1) -> Any:
-    """Initialize params already laid out on the mesh: init one shard's
-    worth per chip by running init inside shard_map (each tp rank draws
-    the same RNG, so replicated leaves match; sharded leaves differ per
-    rank, which is exactly the Megatron init)."""
+    """Initialize params already laid out on the mesh.
+
+    Replicated leaves must be identical on every chip (they draw from the
+    shared base rng), while tp-sharded leaves are DISTINCT shards of a
+    conceptually larger matrix — they draw from an rng folded with this
+    chip's tp index, the Megatron per-partition init.  (A single shared
+    rng would make all tp shards bit-identical, and gradient symmetry
+    would keep them identical forever — silently wasting 1/tp of model
+    capacity.)"""
     sp = mesh.shape[SP_AXIS]
     s_local = model.seq_len // sp
     tokens = jnp.zeros((local_batch, s_local), jnp.int32)
 
-    def init_fn(rng, tokens):
+    def plain_init(rng, tokens):
         return model.init(rng, tokens)
-
-    specs = None  # discovered after a dry init below
 
     abstract = jax.eval_shape(
         lambda r, t: jax.shard_map(
-            init_fn, mesh=mesh, in_specs=(P(), P()),
+            plain_init, mesh=mesh, in_specs=(P(), P()),
             out_specs=P(), check_vma=False,
         )(r, t), rng, tokens,
     )
     specs = {"params": param_specs(abstract["params"])}
+
+    def init_fn(rng, tokens):
+        base = model.init(rng, tokens)
+        tp_rng = jax.random.fold_in(rng, jax.lax.axis_index(TP_AXIS))
+        folded = model.init(tp_rng, tokens)
+
+        picked = jax.tree_util.tree_map(
+            lambda spec, b, f: f if TP_AXIS in spec else b,
+            specs["params"], base["params"], folded["params"],
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        return {"params": picked}
+
     out = jax.jit(jax.shard_map(
         init_fn, mesh=mesh, in_specs=(P(), P()), out_specs=specs,
         check_vma=False,

@@ -1,0 +1,163 @@
+"""RayExecutor-style programmatic job execution.
+
+Reference parity: horovod/ray/runner.py (``RayExecutor``) — an executor
+object that starts a fleet of workers, runs a user function on every
+worker with the framework initialized, and collects per-rank results
+(SURVEY.md §2.4).
+
+Backends:
+  * **ray** (when importable): one Ray actor per worker, placement-group
+    scheduling — the reference's deployment model.
+  * **local** (always available, used in this image — ray is not
+    installed): one subprocess per worker wired into the same
+    coordination env ``tpurun`` uses.  This keeps the API contract fully
+    testable and doubles as a programmatic `horovod.run()` analog.
+
+Functions must be picklable (module-level); closures need cloudpickle,
+which this environment does not ship.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import subprocess
+import sys
+import tempfile
+from typing import Any, Callable, List, Optional
+
+__all__ = ["RayExecutor"]
+
+
+def _ray_available() -> bool:
+    try:
+        import ray  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class RayExecutor:
+    """Reference: horovod/ray/runner.py RayExecutor.
+
+    Usage::
+
+        executor = RayExecutor(num_workers=4)
+        executor.start()
+        results = executor.run(train_fn, args=[config])  # len == 4
+        executor.shutdown()
+    """
+
+    def __init__(self, settings: Optional[dict] = None,
+                 num_workers: int = 1, use_current_process: bool = False,
+                 env_vars: Optional[dict] = None):
+        self.num_workers = num_workers
+        self.settings = settings or {}
+        self.env_vars = dict(env_vars or {})
+        self._started = False
+        self._backend = "ray" if _ray_available() else "local"
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        """Allocate workers (reference: RayExecutor.start creating the
+        actor fleet).  The local backend allocates lazily at run()."""
+        if self._backend == "ray":
+            import ray
+
+            if not ray.is_initialized():
+                ray.init(ignore_reinit_error=True)
+        self._started = True
+
+    def shutdown(self) -> None:
+        self._started = False
+
+    # -- execution ----------------------------------------------------------
+
+    def run(self, fn: Callable, args: Optional[List[Any]] = None,
+            kwargs: Optional[dict] = None) -> List[Any]:
+        """Run ``fn(*args, **kwargs)`` on every worker with the framework
+        initialized; returns the per-rank results in rank order
+        (reference: RayExecutor.run → run_remote + get)."""
+        if not self._started:
+            raise RuntimeError("call start() before run()")
+        args, kwargs = list(args or []), dict(kwargs or {})
+        if self._backend == "ray":
+            return self._run_ray(fn, args, kwargs)
+        return self._run_local(fn, args, kwargs)
+
+    def execute(self, fn: Callable) -> List[Any]:
+        """Reference: RayExecutor.execute — fn receives no arguments."""
+        return self.run(fn)
+
+    # -- backends -----------------------------------------------------------
+
+    def _run_ray(self, fn, args, kwargs):
+        import ray
+
+        coordinator = f"{socket.gethostname()}:{_free_port()}"
+        native_port = _free_port()
+
+        @ray.remote
+        def worker(rank):
+            for k, v in self._worker_env(coordinator, native_port,
+                                         rank).items():
+                os.environ[k] = v
+            import horovod_tpu as hvd
+
+            hvd.init()
+            return fn(*args, **kwargs)
+
+        return ray.get([worker.remote(r) for r in range(self.num_workers)])
+
+    def _worker_env(self, coordinator, native_port, rank):
+        env = dict(self.env_vars)
+        env.update({
+            "HVD_TPU_COORDINATOR": coordinator,
+            "HVD_TPU_NATIVE_PORT": str(native_port),
+            "HVD_TPU_NUM_PROCESSES": str(self.num_workers),
+            "HVD_TPU_PROCESS_ID": str(rank),
+        })
+        return env
+
+    def _run_local(self, fn, args, kwargs):
+        coordinator = f"127.0.0.1:{_free_port()}"
+        native_port = _free_port()
+        with tempfile.TemporaryDirectory(prefix="hvd_tpu_ray_") as tmp:
+            payload = os.path.join(tmp, "payload.pkl")
+            with open(payload, "wb") as f:
+                pickle.dump((fn, args, kwargs), f)
+            procs = []
+            for rank in range(self.num_workers):
+                env = dict(os.environ)
+                env.update(self._worker_env(coordinator, native_port,
+                                            rank))
+                repo_root = os.path.dirname(os.path.dirname(
+                    os.path.dirname(os.path.abspath(__file__))))
+                env["PYTHONPATH"] = (
+                    repo_root + os.pathsep + env.get("PYTHONPATH", "")
+                )
+                procs.append(subprocess.Popen(
+                    [sys.executable, "-m", "horovod_tpu.ray._worker",
+                     payload, os.path.join(tmp, f"result_{rank}.pkl")],
+                    env=env,
+                ))
+            codes = [p.wait() for p in procs]
+            if any(codes):
+                raise RuntimeError(
+                    f"RayExecutor(local) worker failure, exit codes {codes}"
+                )
+            results = []
+            for rank in range(self.num_workers):
+                with open(os.path.join(tmp, f"result_{rank}.pkl"),
+                          "rb") as f:
+                    results.append(pickle.load(f))
+            return results

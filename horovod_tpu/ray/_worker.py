@@ -1,0 +1,27 @@
+"""Local-backend worker entrypoint for RayExecutor (reference analog:
+the remote function body Ray actors execute in horovod/ray/runner.py)."""
+
+import pickle
+import sys
+
+
+def main():
+    payload_path, result_path = sys.argv[1], sys.argv[2]
+    with open(payload_path, "rb") as f:
+        fn, args, kwargs = pickle.load(f)
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    result = fn(*args, **kwargs)
+    with open(result_path, "wb") as f:
+        pickle.dump(result, f)
+    # coordinated teardown before interpreter exit (see
+    # basics._register_early_distributed_shutdown): harmless if single
+    from horovod_tpu.elastic.worker import clean_shutdown
+
+    clean_shutdown()
+
+
+if __name__ == "__main__":
+    main()

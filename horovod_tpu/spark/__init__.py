@@ -1,0 +1,67 @@
+"""Spark integration (reference: horovod/spark — SURVEY.md §2.4).
+
+The reference runs workers inside Spark tasks and ships DataFrame-backed
+Estimators (Keras/Torch) over a Petastorm store.  This environment has
+no pyspark, so the integration is scoped to:
+
+  * :func:`run` — the ``horovod.spark.run(fn, args, num_proc)`` contract.
+    With pyspark present it executes ``fn`` inside ``num_proc`` barrier
+    Spark tasks, each joined into the framework's world; without pyspark
+    it raises ImportError with guidance (use ``horovod_tpu.ray
+    .RayExecutor`` or ``tpurun`` for the same contract locally).
+  * Estimators (KerasEstimator/TorchEstimator analogs) are out of scope
+    until a pyspark environment exists; documented in README's coverage
+    table.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Any, Callable, List, Optional
+
+
+def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
+        num_proc: int = 1, **_ignored) -> List[Any]:
+    """Reference: horovod.spark.run — execute ``fn`` on ``num_proc``
+    Spark executors with the framework initialized, returning per-rank
+    results."""
+    try:
+        import pyspark  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "horovod_tpu.spark.run requires pyspark, which is not "
+            "installed in this environment. For the same programmatic "
+            "contract use horovod_tpu.ray.RayExecutor (local backend) or "
+            "the tpurun launcher."
+        ) from e
+
+    from pyspark.sql import SparkSession
+    from pyspark import BarrierTaskContext
+
+    kwargs = dict(kwargs or {})
+    spark = SparkSession.builder.getOrCreate()
+    sc = spark.sparkContext
+    coordinator_host = socket.gethostname()
+    with socket.socket() as s:
+        s.bind(("", 0))
+        coordinator = f"{coordinator_host}:{s.getsockname()[1]}"
+
+    def task(_):
+        import os
+
+        ctx = BarrierTaskContext.get()
+        rank = ctx.partitionId()
+        os.environ.update({
+            "HVD_TPU_COORDINATOR": coordinator,
+            "HVD_TPU_NUM_PROCESSES": str(num_proc),
+            "HVD_TPU_PROCESS_ID": str(rank),
+        })
+        import horovod_tpu as hvd
+
+        hvd.init()
+        out = fn(*args, **kwargs)
+        ctx.barrier()
+        return [out]
+
+    rdd = sc.parallelize(range(num_proc), num_proc).barrier()
+    return rdd.mapPartitions(task).collect()

@@ -1,0 +1,16 @@
+"""Module-level functions for executor tests (must be plain-picklable —
+the reference's Ray tests use module-level train fns the same way)."""
+
+
+def rank_report(arg):
+    import jax.numpy as jnp
+
+    import horovod_tpu as hvd
+
+    out = hvd.allreduce(jnp.ones(()), op=hvd.Sum)
+    return {
+        "rank": hvd.cross_rank(),
+        "world": hvd.cross_size(),
+        "allreduce_sum": float(out),
+        "arg": arg,
+    }

@@ -178,3 +178,36 @@ def test_restore_without_checkpoint_is_identity(tmp_path):
     state, _ = _make_state()
     restored = ckpt.restore_checkpoint(str(tmp_path / "nope"), state)
     assert restored is state
+
+
+def test_set_lr_is_functional():
+    state, _ = _make_state(0.25)
+    old = state.opt_state
+    new = cb.set_lr(old, 0.5)
+    assert cb.get_lr(old) == pytest.approx(0.25)  # input untouched
+    assert cb.get_lr(new) == pytest.approx(0.5)
+
+
+def test_schedule_callback_smooth_without_steps_per_epoch():
+    state, _ = _make_state(lr=1.0)
+    sched = cb.LearningRateScheduleCallback(
+        initial_lr=1.0, multiplier=lambda e: 0.5 ** e, staircase=False,
+    )
+    loop = cb.TrainLoop(state, [sched])
+    loop.on_epoch_begin(0)
+    assert loop.lr == pytest.approx(1.0)
+    loop.on_epoch_begin(2)
+    assert loop.lr == pytest.approx(0.25)  # epoch-granularity fallback
+
+
+def test_warmup_callback_fractional_epochs_pins_target():
+    state, _ = _make_state(lr=0.0)
+    warmup = cb.LearningRateWarmupCallback(
+        target_lr=0.8, warmup_epochs=2.5, initial_lr=0.0
+    )
+    loop = cb.TrainLoop(state, [warmup])
+    for epoch in range(4):
+        loop.on_epoch_begin(epoch)
+        loop.on_batch_begin(0)
+        loop.on_epoch_end(epoch)
+    assert loop.lr == pytest.approx(0.8)

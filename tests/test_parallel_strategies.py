@@ -302,3 +302,21 @@ def test_param_specs_layout():
     assert p["mlp_0"]["wi"]["kernel"] == P(None, "tp")
     assert p["mlp_0"]["wo"]["kernel"] == P("tp", None)
     assert p["embed"] == P()
+
+
+def test_init_sharded_tp_shards_differ():
+    """tp shards must be DISTINCT random draws (Megatron per-partition
+    init) while replicated leaves are identical across all ranks."""
+    from horovod_tpu.parallel import sharded as sh
+
+    mesh = sh.multi_axis_mesh(dp=2, sp=2, tp=2)
+    model = sh.MultiAxisTransformer(vocab=32, d_model=16, num_heads=4,
+                                    num_layers=1, seq_len=8)
+    variables, specs = sh.init_sharded(model, mesh, jax.random.PRNGKey(0))
+    wi = variables["params"]["mlp_0"]["wi"]["kernel"]
+    shards = [np.asarray(s.data) for s in wi.addressable_shards]
+    tp_shards = shards[:2]  # same (dp, sp), tp=0 vs tp=1
+    assert not np.array_equal(tp_shards[0], tp_shards[1])
+    emb = variables["params"]["embed"]
+    eshards = [np.asarray(s.data) for s in emb.addressable_shards]
+    assert all(np.array_equal(eshards[0], e) for e in eshards[1:])
