@@ -28,6 +28,7 @@
 #include <thread>
 #include <vector>
 
+#include "thread_pool.h"
 #include "transport.h"
 
 namespace hvdtpu {
@@ -60,13 +61,23 @@ class TcpTransport : public Transport {
   std::vector<std::string> GatherRequests(const std::string& mine) override {
     if (failed_) return {};
     if (rank_ == 0) {
+      // per-peer reads run on the pool so the cycle latency is the
+      // slowest peer, not the sum of all peers (reference analog:
+      // ThreadPool use in horovod/common — SURVEY.md §2.1)
       std::vector<std::string> all(size_);
       all[0] = mine;
-      for (int r = 1; r < size_; ++r)
-        if (!ReadFrame(peer_fds_[r], &all[r])) {
-          failed_ = true;
-          return {};
-        }
+      std::vector<std::future<bool>> done;
+      for (int r = 1; r < size_; ++r) {
+        done.push_back(pool_.Submit([this, r, &all] {
+          return ReadFrame(peer_fds_[r], &all[r]);
+        }));
+      }
+      bool ok = true;
+      for (auto& f : done) ok = f.get() && ok;
+      if (!ok) {
+        failed_ = true;
+        return {};
+      }
       return all;
     }
     if (!WriteFrame(root_fd_, mine)) failed_ = true;
@@ -76,11 +87,18 @@ class TcpTransport : public Transport {
   std::string BcastResponseList(const std::string& payload) override {
     if (failed_) return {};
     if (rank_ == 0) {
-      for (int r = 1; r < size_; ++r)
-        if (!WriteFrame(peer_fds_[r], payload)) {
-          failed_ = true;
-          return {};
-        }
+      std::vector<std::future<bool>> done;
+      for (int r = 1; r < size_; ++r) {
+        done.push_back(pool_.Submit([this, r, &payload] {
+          return WriteFrame(peer_fds_[r], payload);
+        }));
+      }
+      bool ok = true;
+      for (auto& f : done) ok = f.get() && ok;
+      if (!ok) {
+        failed_ = true;
+        return {};
+      }
       return payload;
     }
     std::string out;
@@ -215,6 +233,8 @@ class TcpTransport : public Transport {
   int root_fd_ = -1;
   std::vector<int> peer_fds_;
   bool failed_ = false;
+  // IO pool sized for a per-host controller star (reference default: 4)
+  ThreadPool pool_{4};
 };
 
 }  // namespace hvdtpu

@@ -1,0 +1,74 @@
+// Fixed-size worker pool with a task queue.
+//
+// Reference parity: horovod/common/thread_pool.h/.cc (SURVEY.md §2.1) —
+// the reference uses its pool for CPU adasum and async copies; here it
+// parallelizes the controller transport's per-peer socket IO (the root's
+// request gather and response fan-out are otherwise serialized on the
+// slowest peer).
+#pragma once
+
+#include <condition_variable>
+#include <functional>
+#include <future>
+#include <mutex>
+#include <queue>
+#include <thread>
+#include <vector>
+
+namespace hvdtpu {
+
+class ThreadPool {
+ public:
+  explicit ThreadPool(int num_threads) {
+    for (int i = 0; i < num_threads; ++i) {
+      workers_.emplace_back([this] { Loop(); });
+    }
+  }
+
+  ~ThreadPool() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      shutdown_ = true;
+    }
+    cv_.notify_all();
+    for (auto& w : workers_) w.join();
+  }
+
+  // Submit a task; returns a future for completion/result.
+  template <typename F>
+  auto Submit(F&& f) -> std::future<decltype(f())> {
+    using R = decltype(f());
+    auto task = std::make_shared<std::packaged_task<R()>>(std::forward<F>(f));
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      queue_.push([task] { (*task)(); });
+    }
+    cv_.notify_one();
+    return task->get_future();
+  }
+
+  int size() const { return static_cast<int>(workers_.size()); }
+
+ private:
+  void Loop() {
+    for (;;) {
+      std::function<void()> task;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait(lk, [this] { return shutdown_ || !queue_.empty(); });
+        if (shutdown_ && queue_.empty()) return;
+        task = std::move(queue_.front());
+        queue_.pop();
+      }
+      task();
+    }
+  }
+
+  std::vector<std::thread> workers_;
+  std::queue<std::function<void()>> queue_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  bool shutdown_ = false;
+};
+
+}  // namespace hvdtpu
