@@ -12,31 +12,122 @@ Baseline constant: the reference repo publishes no absolute number
 pytorch_synthetic_benchmark on the reference-era flagship (V100, fp32,
 batch 32) is ~330 img/sec, which we use as vs_baseline's denominator.
 
-Output: ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+Robustness contract (VERDICT round 1 item 1b): every backend touch happens
+in a SUBPROCESS under a hard deadline (a bare in-process ``jax.devices()``
+can hang for minutes when the axon tunnel is down — the round-1 failure
+mode).  Orchestration: bounded-retry TPU probe → timed TPU attempt →
+virtual-CPU fallback, so the run always emits its one JSON line.
+
+Output: ONE JSON line {"metric", "value", "unit", "vs_baseline", ...}.
 """
 
 import json
+import os
+import subprocess
 import sys
 import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-import optax
-
-import horovod_tpu as hvd
-from horovod_tpu.models.resnet import ResNet50
-from horovod_tpu import training
-
 BASELINE_IMG_PER_SEC = 330.0  # reference pytorch_synthetic_benchmark, 1x V100 fp32
 
+# Dense peak bf16 FLOP/s per chip by generation, for the MFU estimate.
+PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+}
+# ResNet-50 fwd ~4.1 GFLOP/img @224; training step ~= 3x forward.
+RESNET50_TRAIN_FLOPS_PER_IMG = 3 * 4.1e9
 
-def main():
+PROBE_TIMEOUT_S = 60
+PROBE_RETRIES = 2
+TPU_RUN_TIMEOUT_S = 330
+CPU_RUN_TIMEOUT_S = 150
+
+
+def tpu_available() -> bool:
+    """Probe the TPU backend in a subprocess with a hard timeout.
+
+    A clean cpu-only answer is deterministic (no retry); only
+    failures/hangs are retried, boundedly.
+    """
+    probe = "import jax; d = jax.devices(); assert d; print(d[0].platform)"
+    for attempt in range(1, PROBE_RETRIES + 1):
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", probe],
+                capture_output=True,
+                text=True,
+                timeout=PROBE_TIMEOUT_S,
+            )
+            if out.returncode == 0:
+                return "cpu" not in out.stdout
+            reason = (out.stderr.strip().splitlines() or ["rc=%d" % out.returncode])[-1]
+        except subprocess.TimeoutExpired:
+            reason = f"probe hung >{PROBE_TIMEOUT_S}s"
+        print(
+            f"[bench] TPU probe attempt {attempt}/{PROBE_RETRIES} failed: {reason}",
+            file=sys.stderr,
+        )
+        if attempt < PROBE_RETRIES:
+            time.sleep(2 * attempt)
+    return False
+
+
+def run_worker(mode: str, timeout_s: int) -> bool:
+    """Run ``bench.py --worker <mode>`` under a deadline; forward its JSON
+    line to stdout.  Returns True iff a result line was produced."""
+    env = dict(os.environ)
+    if mode == "cpu":
+        # prevent axon registration entirely so nothing can hang
+        env["PALLAS_AXON_POOL_IPS"] = ""
+        env["JAX_PLATFORMS"] = "cpu"
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--worker", mode],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            env=env,
+        )
+    except subprocess.TimeoutExpired as e:
+        if e.stderr:
+            err = e.stderr if isinstance(e.stderr, str) else e.stderr.decode()
+            sys.stderr.write(err[-3000:])
+        print(f"[bench] {mode} run hung >{timeout_s}s", file=sys.stderr)
+        return False
+    sys.stderr.write(out.stderr)
+    for line in out.stdout.splitlines():
+        if line.startswith("{"):
+            print(line)
+            return True
+    print(f"[bench] {mode} run rc={out.returncode}, no result line", file=sys.stderr)
+    return False
+
+
+def worker(mode: str) -> int:
+    """The measured run itself.  mode: 'tpu' (default backend) or 'cpu'."""
+    import jax
+
+    if mode == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models.resnet import ResNet50
+    from horovod_tpu import training
+
     hvd.init()
-    on_tpu = jax.default_backend() not in ("cpu",)
+    on_tpu = jax.default_backend() != "cpu"
+    if mode == "tpu" and not on_tpu:
+        print("[bench] worker asked for tpu but got cpu backend", file=sys.stderr)
+        return 1
     batch = 128 if on_tpu else 16
     image_size = 224 if on_tpu else 64
-    warmup, iters = (3, 20) if on_tpu else (1, 2)
+    warmup, iters = (5, 30) if on_tpu else (1, 2)
 
     model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
     rng = jax.random.PRNGKey(0)
@@ -50,9 +141,7 @@ def main():
     )
 
     optimizer = optax.sgd(0.1, momentum=0.9)
-    state = training.create_train_state(
-        model, optimizer, rng, images[:2]
-    )
+    state = training.create_train_state(model, optimizer, rng, images[:2])
     state = training.replicate_state(state)
     step = training.data_parallel_train_step(model, optimizer)
 
@@ -70,16 +159,46 @@ def main():
     assert np.isfinite(final_loss), f"non-finite loss {final_loss}"
 
     img_per_sec = batch * iters / dt
-    print(
-        json.dumps(
-            {
-                "metric": "resnet50_synthetic_train_throughput",
-                "value": round(img_per_sec, 2),
-                "unit": "images/sec",
-                "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 3),
-            }
+    result = {
+        "metric": "resnet50_synthetic_train_throughput",
+        "value": round(img_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 3),
+        "backend": jax.default_backend(),
+        "batch": batch,
+        "image_size": image_size,
+        "step_time_ms": round(dt / iters * 1e3, 2),
+        "n_devices": jax.device_count(),
+    }
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN")
+    if on_tpu and image_size == 224 and gen in PEAK_FLOPS:
+        # MFU only when the generation is explicitly known — a guessed
+        # peak-FLOPs denominator would mis-state MFU by up to ~4.7x.
+        # img_per_sec is aggregate across the data-parallel world, so
+        # normalize to per-chip before dividing by per-chip peak.
+        result["mfu"] = round(
+            img_per_sec / jax.device_count()
+            * RESNET50_TRAIN_FLOPS_PER_IMG / PEAK_FLOPS[gen], 4
         )
-    )
+        result["tpu_gen"] = gen
+    print(json.dumps(result))
+    return 0
+
+
+def main() -> int:
+    if len(sys.argv) >= 3 and sys.argv[1] == "--worker":
+        return worker(sys.argv[2])
+    if tpu_available():
+        if run_worker("tpu", TPU_RUN_TIMEOUT_S):
+            return 0
+        print("[bench] TPU attempt failed; falling back to CPU", file=sys.stderr)
+    else:
+        print(
+            "[bench] TPU backend unavailable after bounded retries; "
+            "falling back to CPU so a result line is still emitted",
+            file=sys.stderr,
+        )
+    return 0 if run_worker("cpu", CPU_RUN_TIMEOUT_S) else 1
 
 
 if __name__ == "__main__":
