@@ -45,7 +45,9 @@ _ENUM_TO_DTYPE = {val: name for name, val in _DTYPES}
 _EXEC_CB = ctypes.CFUNCTYPE(
     None, ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
     ctypes.c_int, ctypes.c_double, ctypes.c_double,
-    ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_char_p,
+    ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+    ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int),
+    ctypes.c_int, ctypes.c_char_p,
 )
 
 
@@ -150,6 +152,7 @@ class NativeController:
             ctypes.c_longlong, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
             ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_int,
             ctypes.c_int, ctypes.c_int, ctypes.c_double, ctypes.c_double,
+            ctypes.POINTER(ctypes.c_longlong), ctypes.c_int,
         ]
         lib.hvdtpu_register_group.restype = ctypes.c_int
         lib.hvdtpu_register_group.argtypes = [ctypes.c_int]
@@ -157,6 +160,7 @@ class NativeController:
         lib.hvdtpu_initialized.restype = ctypes.c_int
         lib.hvdtpu_cache_hits.restype = ctypes.c_longlong
         lib.hvdtpu_cache_misses.restype = ctypes.c_longlong
+        lib.hvdtpu_last_request_bytes.restype = ctypes.c_longlong
         lib.hvdtpu_fusion_threshold.restype = ctypes.c_longlong
         lib.hvdtpu_cycle_time_ms.restype = ctypes.c_double
         lib.hvdtpu_pending_count.restype = ctypes.c_int
@@ -190,6 +194,12 @@ class NativeController:
     def cache_misses(self) -> int:
         return int(self._lib.hvdtpu_cache_misses())
 
+    def last_request_bytes(self) -> int:
+        """Bytes of this rank's last non-empty negotiation report — small
+        and constant in steady state (bit-vector bypass), larger when a
+        full request encoding traveled (cache miss)."""
+        return int(self._lib.hvdtpu_last_request_bytes())
+
     def fusion_threshold(self) -> int:
         return int(self._lib.hvdtpu_fusion_threshold())
 
@@ -221,6 +231,7 @@ class NativeController:
         root_rank: int = 0,
         prescale: float = 1.0,
         postscale: float = 1.0,
+        splits=None,
         extra: Any = None,
     ) -> Future:
         """Submit one tensor; returns a Future resolved by the background
@@ -249,11 +260,17 @@ class NativeController:
             self._entries[entry_id] = _Entry(arr, fut, op_type, extra)
         # reduce_op rides in the root_rank field for allreduce (the C core
         # treats both as opaque fuse keys); keep them separate fields here.
+        if splits is not None:
+            splits_list = [int(s) for s in np.asarray(splits).ravel()]
+            c_splits = (ctypes.c_longlong * len(splits_list))(*splits_list)
+            n_splits = len(splits_list)
+        else:
+            c_splits, n_splits = None, 0
         rc = self._lib.hvdtpu_enqueue(
             ctypes.c_longlong(entry_id), name.encode(), op_type, dtype_enum,
             shape, arr.ndim, process_set_id, group_id,
             root_rank if op_type == OP_BROADCAST else int(reduce_op),
-            prescale, postscale,
+            prescale, postscale, c_splits, n_splits,
         )
         if rc < 0:
             with self._entries_lock:
@@ -274,10 +291,21 @@ class NativeController:
     # -- executor callback (runs on the C++ background thread) --------------
 
     def _on_exec(self, _user, op, dtype, process_set, root_or_rop, prescale,
-                 postscale, ids_ptr, n_ids, error):
+                 postscale, ids_ptr, n_ids, extents_ptr, extent_lens_ptr,
+                 n_extent_ranks, error):
         entries: List[_Entry] = []
         try:
             ids = [int(ids_ptr[i]) for i in range(n_ids)]
+            # negotiated per-rank extents (allgather dim0s/alltoall splits)
+            extents: Optional[List[List[int]]] = None
+            if n_extent_ranks > 0:
+                extents, off = [], 0
+                for r in range(n_extent_ranks):
+                    ln = int(extent_lens_ptr[r])
+                    extents.append(
+                        [int(extents_ptr[off + j]) for j in range(ln)]
+                    )
+                    off += ln
             with self._entries_lock:
                 entries = [
                     self._entries.pop(i) for i in ids
@@ -291,7 +319,7 @@ class NativeController:
                     e.future.set_error(err)
                 return
             self._execute(op, process_set, root_or_rop, prescale, postscale,
-                          entries)
+                          entries, extents)
         except BaseException as exc:  # never let exceptions cross into C++
             get_logger().error("native exec callback failed: %s", exc)
             try:
@@ -301,7 +329,7 @@ class NativeController:
                 pass
 
     def _execute(self, op, process_set, root_or_rop, prescale, postscale,
-                 entries: List[_Entry]) -> None:
+                 entries: List[_Entry], extents=None) -> None:
         from ..common import basics as _basics
         from ..ops.reduce_ops import ReduceOp
 
@@ -333,17 +361,34 @@ class NativeController:
                 )
                 offset += sz
         elif op == OP_ALLGATHER:
+            # negotiated recvcounts: per-rank dim0 from the response
+            # (reference: MPIAllgather's recvcounts path)
+            dim0s = [ext[0] for ext in extents] if extents else None
             for e in entries:
-                e.future.set_result(eng.allgather(e.payload, ps))
+                e.future.set_result(
+                    eng.allgather(e.payload, ps, recv_dim0s=dim0s)
+                )
         elif op == OP_BROADCAST:
             for e in entries:
                 e.future.set_result(
                     eng.broadcast(e.payload, root_or_rop, ps)
                 )
         elif op == OP_ALLTOALL:
+            # negotiated splits matrix: extents[r] = [dim0, splits...];
+            # a rank with no explicit splits sends even dim0/n chunks
+            all_splits = None
+            if extents:
+                n = len(extents)
+                all_splits = []
+                for ext in extents:
+                    dim0, sp = ext[0], ext[1:]
+                    if not sp:
+                        sp = [dim0 // n] * n
+                    all_splits.append(sp)
             for e in entries:
                 e.future.set_result(
-                    eng.alltoall(e.payload, e.extra, ps)
+                    eng.alltoall(e.payload, e.extra, ps,
+                                 all_splits=all_splits)
                 )
         elif op == OP_REDUCESCATTER:
             for e in entries:

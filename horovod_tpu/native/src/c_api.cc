@@ -32,10 +32,14 @@ namespace {
 
 // Executor callback into Python: one call per fused Response.
 // ids[i] == -1 when this rank holds no entry for names[i] (join fill).
+// extents: flattened per-rank negotiated extents (allgather dim0s /
+// alltoall splits) with extent_lens[r] values for rank r; n_extent_ranks
+// is 0 for ops that negotiate no shapes.
 typedef void (*ExecCallback)(void* user, int op, int dtype, int process_set,
                              int root_rank, double prescale, double postscale,
                              const int64_t* ids, int n_ids,
-                             const char* error);
+                             const int64_t* extents, const int* extent_lens,
+                             int n_extent_ranks, const char* error);
 
 struct GlobalState {
   // Reference analog: horovod/common/global_state.h HorovodGlobalState.
@@ -108,8 +112,10 @@ int hvdtpu_init(int rank, int size, const char* coord_host, int coord_port,
   if (s->initialized.load()) return 0;
   s->queue = std::make_unique<hvdtpu::TensorQueue>();
   s->groups = std::make_unique<hvdtpu::GroupTable>();
+  // 0 disables the cache (HOROVOD_CACHE_CAPACITY=0 semantics); negative
+  // means "unset" -> reference default 1024
   s->cache = std::make_unique<hvdtpu::ResponseCache>(
-      cache_capacity > 0 ? cache_capacity : 1024);
+      cache_capacity >= 0 ? cache_capacity : 1024);
   s->stall = std::make_unique<hvdtpu::StallInspector>(stall_warn_sec,
                                                       stall_shutdown_sec);
   if (timeline_path && timeline_path[0])
@@ -131,12 +137,21 @@ int hvdtpu_init(int rank, int size, const char* coord_host, int coord_port,
         s->active_names.erase(n + "\x1f" +
                               std::to_string(resp.process_set_id));
     }
-    if (s->exec_cb)
+    if (s->exec_cb) {
+      std::vector<int64_t> extents;
+      std::vector<int> extent_lens;
+      for (const auto& ext : resp.rank_extents) {
+        extent_lens.push_back(static_cast<int>(ext.size()));
+        extents.insert(extents.end(), ext.begin(), ext.end());
+      }
       s->exec_cb(s->exec_user, static_cast<int>(resp.op),
                  static_cast<int>(resp.dtype), resp.process_set_id,
                  resp.root_rank, resp.prescale, resp.postscale, ids.data(),
-                 static_cast<int>(ids.size()),
+                 static_cast<int>(ids.size()), extents.data(),
+                 extent_lens.data(),
+                 static_cast<int>(extent_lens.size()),
                  resp.error.empty() ? nullptr : resp.error.c_str());
+    }
   };
   // Transport choice (reference: controller selection in operations.cc):
   // single process -> loopback; launcher-driven multi-process world ->
@@ -163,6 +178,7 @@ int hvdtpu_init(int rank, int size, const char* coord_host, int coord_port,
 
 void hvdtpu_set_exec_callback(void (*cb)(void*, int, int, int, int, double,
                                          double, const int64_t*, int,
+                                         const int64_t*, const int*, int,
                                          const char*),
                               void* user) {
   hvdtpu::g()->exec_cb = cb;
@@ -172,7 +188,8 @@ void hvdtpu_set_exec_callback(void (*cb)(void*, int, int, int, int, double,
 long long hvdtpu_enqueue(long long entry_id, const char* name, int op,
                          int dtype, const long long* shape, int ndim,
                          int process_set, int group_id, int root_rank,
-                         double prescale, double postscale) {
+                         double prescale, double postscale,
+                         const long long* splits, int n_splits) {
   // entry_id is caller-assigned so the Python side can register its future
   // BEFORE the entry becomes visible to the background thread — otherwise
   // a fast cycle could execute and drop the id between the enqueue call
@@ -199,6 +216,7 @@ long long hvdtpu_enqueue(long long entry_id, const char* name, int op,
   e.root_rank = root_rank;
   e.prescale = prescale;
   e.postscale = postscale;
+  if (splits && n_splits > 0) e.splits.assign(splits, splits + n_splits);
   e.enqueued_at = hvdtpu::Clock::now();
   int64_t id = e.id;
   if (!s->queue->Add(std::move(e))) return -1;  // duplicate name pending
@@ -240,6 +258,11 @@ long long hvdtpu_cache_hits() {
 long long hvdtpu_cache_misses() {
   auto* s = hvdtpu::g();
   return s->initialized.load() ? s->cache->misses() : 0;
+}
+
+long long hvdtpu_last_request_bytes() {
+  auto* s = hvdtpu::g();
+  return s->initialized.load() ? s->controller->last_request_bytes() : 0;
 }
 
 long long hvdtpu_fusion_threshold() {

@@ -85,6 +85,9 @@ struct TensorTableEntry {
   int32_t root_rank = 0;    // broadcast only
   double prescale = 1.0;
   double postscale = 1.0;
+  // alltoall only: how many dim-0 rows this rank sends to each peer
+  // (reference: Request::tensor_sizes carrying splits).  Empty = even.
+  std::vector<int64_t> splits;
   Clock::time_point enqueued_at;
 
   int64_t NumBytes() const {
@@ -109,6 +112,16 @@ struct Response {
   double postscale = 1.0;
   std::vector<std::string> names;
   std::vector<std::vector<int64_t>> shapes;
+  // Per-name: may this entry enter the ResponseCache?  Set by the
+  // coordinator (grouped entries are excluded); every rank applies the
+  // same flags from the same broadcast, keeping the replicated cache
+  // deterministic (response_cache.h contract).
+  std::vector<uint8_t> cacheable;
+  // Per-rank negotiated extents (reference: Response::tensor_sizes).
+  // ALLGATHER: rank_extents[r] = {dim0_r}.  ALLTOALL: rank_extents[r] =
+  // {dim0_r, splits_r...} (splits empty = even).  Other ops: empty.
+  // Allgather/alltoall responses are never fused, so this is per-response.
+  std::vector<std::vector<int64_t>> rank_extents;
   std::string error;  // non-empty: fail these entries
 };
 

@@ -15,37 +15,77 @@ std::string Key(const std::string& name, int32_t process_set) {
 }  // namespace
 
 bool Controller::RunLoopOnce() {
-  // 1. drain newly submitted entries (reference: PopMessagesFromQueue)
+  // 1. drain newly submitted entries (reference: PopMessagesFromQueue).
+  // Cache-hit signatures travel as bare positions (the reference's
+  // ResponseCache bit vector); only misses are fully encoded.
   auto newly = queue_->PopAll();
+  std::vector<int64_t> hit_positions;
+  std::vector<TensorTableEntry> full;
   for (auto& e : newly) {
     if (timeline_ && timeline_->active())
       timeline_->ActivityStart(e.name, "QUEUE");
     stall_->RecordPending(e);
-    cache_->Lookup(e);  // warm the signature cache (stats + LRU order)
+    int64_t pos = ResponseCache::Cacheable(e) ? cache_->Query(e) : -1;
+    if (pos >= 0)
+      hit_positions.push_back(pos);
+    else
+      full.push_back(e);
     pending_.emplace(Key(e.name, e.process_set_id), e);
   }
 
   // 2. report to the coordinator (reference: SendReadyTensors)
-  auto gathered = transport_->GatherRequests(wire::EncodeEntryList(newly));
+  auto mine = wire::EncodeCycleRequest(hit_positions, full);
+  if (!hit_positions.empty() || !full.empty())
+    last_request_bytes_.store(static_cast<int64_t>(mine.size()));
+  auto gathered = transport_->GatherRequests(mine);
 
   // 3. coordinator: account reports, build fused responses
   std::string payload;
   if (rank() == 0) {
     for (int32_t r = 0; r < static_cast<int32_t>(gathered.size()); ++r) {
+      std::vector<int64_t> positions;
       std::vector<TensorTableEntry> reqs;
-      if (!wire::DecodeEntryList(gathered[r], &reqs)) continue;
+      if (!wire::DecodeCycleRequest(gathered[r], &positions, &reqs)) continue;
+      // reconstruct position-only reports from the replicated cache
+      // (reference: Controller::ComputeResponseList cache-hit path)
+      for (auto pos : positions) {
+        TensorTableEntry meta;
+        if (cache_->GetByPosition(pos, &meta)) {
+          reqs.push_back(std::move(meta));
+        } else if (protocol_error_.empty()) {
+          // replicated-cache divergence (e.g. per-rank cache-capacity
+          // misconfiguration): unrecoverable — fail every rank loudly
+          // instead of silently dropping the entry until stall shutdown
+          protocol_error_ =
+              "rank " + std::to_string(r) + " reported cache position " +
+              std::to_string(pos) +
+              " unknown to the coordinator: replicated response-cache "
+              "divergence (is HVD_TPU_CACHE_CAPACITY identical on all "
+              "ranks?)";
+        }
+      }
       for (auto& e : reqs) {
         auto it = coord_table_.find(Key(e.name, e.process_set_id));
         if (it == coord_table_.end()) {
-          it = coord_table_
-                   .emplace(Key(e.name, e.process_set_id),
-                            PendingCoord{e, {}, order_counter_++})
+          PendingCoord pc;
+          pc.meta = e;
+          pc.order = order_counter_++;
+          it = coord_table_.emplace(Key(e.name, e.process_set_id),
+                                    std::move(pc))
                    .first;
         }
-        it->second.reported.insert(r);
+        AccountReport(&it->second, r, e);
       }
     }
-    payload = wire::EncodeResponseList(BuildResponses());
+    if (protocol_error_.empty()) {
+      payload = wire::EncodeResponseList(BuildResponses());
+    } else {
+      // a no-names error response = global protocol failure: every rank
+      // fails all pending entries and stops its loop
+      Response fatal;
+      fatal.error = protocol_error_;
+      payload = wire::EncodeResponseList({fatal});
+    }
   }
 
   // 4. broadcast the response list (reference: SendFinalTensors)
@@ -77,11 +117,47 @@ bool Controller::RunLoopOnce() {
   std::vector<Response> responses;
   wire::DecodeResponseList(payload, &responses);
 
+  // global protocol failure (no-names error response): fail everything
+  // in flight on every rank and stop the loop
+  for (const auto& resp : responses) {
+    if (resp.names.empty() && !resp.error.empty()) {
+      Response err;
+      err.error = resp.error;
+      std::vector<int64_t> ids;
+      for (auto& [key, e] : pending_) {
+        err.names.push_back(e.name);
+        err.shapes.push_back(e.shape);
+        ids.push_back(e.id);
+        stall_->RecordDone(e.name);
+      }
+      pending_.clear();
+      if (!ids.empty()) executor_(err, ids);
+      logger_(2, "fatal negotiation error: " + resp.error);
+      return false;
+    }
+  }
+
   // 5. execute: map names to local ids, invoke the XLA executor callback
   int64_t cycle_bytes = 0;
   for (const auto& resp : responses) {
     std::vector<int64_t> local_ids;
     local_ids.reserve(resp.names.size());
+    // Replicated-cache state transition: every rank commits the same
+    // entries in the same broadcast order (response_cache.h contract).
+    for (size_t i = 0; i < resp.names.size(); ++i) {
+      if (i < resp.cacheable.size() && resp.cacheable[i]) {
+        TensorTableEntry meta;
+        meta.name = resp.names[i];
+        meta.op = resp.op;
+        meta.dtype = resp.dtype;
+        meta.shape = resp.shapes[i];
+        meta.process_set_id = resp.process_set_id;
+        meta.root_rank = resp.root_rank;
+        meta.prescale = resp.prescale;
+        meta.postscale = resp.postscale;
+        cache_->Commit(meta);
+      }
+    }
     for (size_t i = 0; i < resp.names.size(); ++i) {
       auto it = pending_.find(Key(resp.names[i], resp.process_set_id));
       if (it == pending_.end()) {
@@ -129,6 +205,61 @@ bool Controller::RunLoopOnce() {
     return false;
   }
   return true;
+}
+
+void Controller::AccountReport(PendingCoord* pc, int32_t r,
+                               const TensorTableEntry& e) {
+  // Cross-rank shape negotiation (reference: the per-rank tensor_sizes
+  // the MPI ops use for allgather recvcounts / alltoall splits, plus the
+  // "mismatched shapes across ranks must raise cleanly" contract).
+  const auto& first = pc->meta;
+  auto mismatch = [&](const std::string& what) {
+    if (pc->error.empty())
+      pc->error = "rank " + std::to_string(r) + " submitted " + e.name +
+                  " with " + what + " inconsistent with other ranks";
+  };
+  if (e.op != first.op || e.dtype != first.dtype) mismatch("op/dtype");
+  auto trailing_dims_match = [&]() {
+    return e.shape.size() == first.shape.size() &&
+           std::equal(e.shape.begin() + (e.shape.empty() ? 0 : 1),
+                      e.shape.end(),
+                      first.shape.begin() + (first.shape.empty() ? 0 : 1));
+  };
+  switch (e.op) {
+    case OpType::ALLGATHER: {
+      // dim0 may differ per rank; trailing dims must match
+      if (!trailing_dims_match()) mismatch("trailing dimensions");
+      pc->rank_info[r] = {e.shape.empty() ? 0 : e.shape[0]};
+      break;
+    }
+    case OpType::ALLTOALL: {
+      if (!trailing_dims_match()) mismatch("trailing dimensions");
+      int64_t dim0 = e.shape.empty() ? 0 : e.shape[0];
+      if (!e.splits.empty()) {
+        int64_t total = 0;
+        for (auto s : e.splits) {
+          if (s < 0) mismatch("negative split");
+          total += s;
+        }
+        if (static_cast<int>(e.splits.size()) != size() || total != dim0)
+          mismatch("splits (length must be world size, sum must be dim0)");
+      } else if (size() > 0 && dim0 % size() != 0) {
+        // splitless even alltoall requires divisibility; catching it in
+        // negotiation fails ALL ranks cleanly instead of one rank raising
+        // locally while the rest enter the collective and stall
+        mismatch("dim0 not divisible by world size (and no splits given)");
+      }
+      std::vector<int64_t> info = {dim0};
+      info.insert(info.end(), e.splits.begin(), e.splits.end());
+      pc->rank_info[r] = std::move(info);
+      break;
+    }
+    default:
+      // allreduce/broadcast/reducescatter/barrier: identical shapes
+      if (e.shape != first.shape) mismatch("shape");
+      break;
+  }
+  pc->reported.insert(r);
 }
 
 void Controller::Join(int64_t) {
@@ -183,12 +314,30 @@ std::vector<Response> Controller::BuildResponses() {
   std::vector<std::string> emitted;
   for (auto* pc : ready) {
     const auto& e = pc->meta;
+    if (!pc->error.empty()) {
+      // cross-rank inconsistency: fail this entry on every rank instead
+      // of executing garbage (reference: clean shape-mismatch errors)
+      Response r;
+      r.op = e.op;
+      r.dtype = e.dtype;
+      r.process_set_id = e.process_set_id;
+      r.names = {e.name};
+      r.shapes = {e.shape};
+      r.cacheable = {0};
+      r.error = pc->error;
+      out.push_back(std::move(r));
+      emitted.push_back(Key(e.name, e.process_set_id));
+      if (e.group_id >= 0) groups_->Forget(e.group_id);
+      continue;
+    }
     int64_t threshold = params_->fusion_threshold();
     if (!out.empty() && fusable(out.back(), e) &&
         (threshold <= 0 ? out.back().names.size() < 1  // fusion disabled
                         : bucket_bytes + e.NumBytes() <= threshold)) {
       out.back().names.push_back(e.name);
       out.back().shapes.push_back(e.shape);
+      out.back().cacheable.push_back(
+          static_cast<uint8_t>(ResponseCache::Cacheable(e) ? 1 : 0));
       bucket_bytes += e.NumBytes();
     } else {
       Response r;
@@ -200,6 +349,20 @@ std::vector<Response> Controller::BuildResponses() {
       r.postscale = e.postscale;
       r.names = {e.name};
       r.shapes = {e.shape};
+      r.cacheable = {
+          static_cast<uint8_t>(ResponseCache::Cacheable(e) ? 1 : 0)};
+      if (e.op == OpType::ALLGATHER || e.op == OpType::ALLTOALL) {
+        // negotiated per-rank extents ride the response (reference:
+        // Response::tensor_sizes); joined ranks contribute zero rows
+        r.rank_extents.resize(size());
+        for (int32_t rr = 0; rr < size(); ++rr) {
+          auto info = pc->rank_info.find(rr);
+          if (info != pc->rank_info.end())
+            r.rank_extents[rr] = info->second;
+          else
+            r.rank_extents[rr] = {0};
+        }
+      }
       out.push_back(std::move(r));
       bucket_bytes = e.NumBytes();
     }

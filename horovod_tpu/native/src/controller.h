@@ -12,6 +12,7 @@
 // controller never touches tensor bytes (SURVEY.md §7.1).
 #pragma once
 
+#include <atomic>
 #include <functional>
 #include <map>
 #include <memory>
@@ -64,14 +65,30 @@ class Controller {
   int rank() const { return transport_->rank(); }
   int size() const { return transport_->size(); }
 
+  // Size of this rank's last non-empty cycle request payload — the
+  // observable for the steady-state bit-vector bypass (a cached cycle is
+  // O(positions) bytes; a miss cycle carries full encodings).
+  int64_t last_request_bytes() const { return last_request_bytes_.load(); }
+
  private:
   struct PendingCoord {  // coordinator-side per-name state
     TensorTableEntry meta;
     std::set<int32_t> reported;
     int64_t order;  // FIFO tie-break for deterministic fusion order
+    // per-rank negotiated extents (allgather dim0s / alltoall splits)
+    std::map<int32_t, std::vector<int64_t>> rank_info;
+    // first cross-rank consistency violation (mismatched shapes, bad
+    // splits): emitted as an error Response so every rank raises cleanly
+    std::string error;
   };
 
   std::vector<Response> BuildResponses();
+  void AccountReport(PendingCoord* pc, int32_t r, const TensorTableEntry& e);
+
+  std::atomic<int64_t> last_request_bytes_{0};
+  // coordinator-side unrecoverable negotiation failure (e.g. replicated
+  // cache divergence); broadcast as a no-names error response
+  std::string protocol_error_;
 
   std::unique_ptr<Transport> transport_;
   TensorQueue* queue_;

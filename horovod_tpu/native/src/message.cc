@@ -17,6 +17,8 @@ std::string EncodeEntry(const TensorTableEntry& e) {
   w.I32(e.root_rank);
   w.F64(e.prescale);
   w.F64(e.postscale);
+  w.I32(static_cast<int32_t>(e.splits.size()));
+  for (auto s : e.splits) w.I64(s);
   return w.str();
 }
 
@@ -32,8 +34,15 @@ bool DecodeEntry(Reader& r, TensorTableEntry* e) {
   e->shape.resize(ndim);
   for (auto& d : e->shape)
     if (!r.I64(&d)) return false;
-  return r.I32(&e->process_set_id) && r.I32(&e->group_id) &&
-         r.I32(&e->root_rank) && r.F64(&e->prescale) && r.F64(&e->postscale);
+  if (!r.I32(&e->process_set_id) || !r.I32(&e->group_id) ||
+      !r.I32(&e->root_rank) || !r.F64(&e->prescale) || !r.F64(&e->postscale))
+    return false;
+  int32_t nsplits;
+  if (!r.I32(&nsplits) || nsplits < 0 || nsplits > (1 << 20)) return false;
+  e->splits.resize(nsplits);
+  for (auto& s : e->splits)
+    if (!r.I64(&s)) return false;
+  return true;
 }
 
 std::string EncodeEntryList(const std::vector<TensorTableEntry>& v) {
@@ -57,6 +66,31 @@ bool DecodeEntryList(const std::string& s, std::vector<TensorTableEntry>* v) {
   return true;
 }
 
+std::string EncodeCycleRequest(const std::vector<int64_t>& positions,
+                               const std::vector<TensorTableEntry>& full) {
+  Writer w;
+  w.U8(kWireVersion);
+  w.I32(static_cast<int32_t>(positions.size()));
+  for (auto p : positions) w.I64(p);
+  w.Str(EncodeEntryList(full));
+  return w.str();
+}
+
+bool DecodeCycleRequest(const std::string& s, std::vector<int64_t>* positions,
+                        std::vector<TensorTableEntry>* full) {
+  Reader r(s.data(), s.size());
+  uint8_t ver;
+  int32_t npos;
+  if (!r.U8(&ver) || ver != kWireVersion || !r.I32(&npos) || npos < 0)
+    return false;
+  positions->resize(npos);
+  for (auto& p : *positions)
+    if (!r.I64(&p)) return false;
+  std::string entries;
+  if (!r.Str(&entries)) return false;
+  return DecodeEntryList(entries, full);
+}
+
 std::string EncodeResponseList(const std::vector<Response>& v) {
   Writer w;
   w.U8(kWireVersion);
@@ -75,6 +109,12 @@ std::string EncodeResponseList(const std::vector<Response>& v) {
       const auto& shape = resp.shapes[i];
       w.I32(static_cast<int32_t>(shape.size()));
       for (auto d : shape) w.I64(d);
+      w.U8(i < resp.cacheable.size() ? resp.cacheable[i] : 0);
+    }
+    w.I32(static_cast<int32_t>(resp.rank_extents.size()));
+    for (const auto& ext : resp.rank_extents) {
+      w.I32(static_cast<int32_t>(ext.size()));
+      for (auto v : ext) w.I64(v);
     }
   }
   return w.str();
@@ -97,6 +137,7 @@ bool DecodeResponseList(const std::string& s, std::vector<Response>* v) {
     resp.dtype = static_cast<DataType>(dtype);
     resp.names.resize(nnames);
     resp.shapes.resize(nnames);
+    resp.cacheable.resize(nnames);
     for (int32_t i = 0; i < nnames; ++i) {
       int32_t ndim;
       if (!r.Str(&resp.names[i]) || !r.I32(&ndim) || ndim < 0 || ndim > 64)
@@ -104,6 +145,17 @@ bool DecodeResponseList(const std::string& s, std::vector<Response>* v) {
       resp.shapes[i].resize(ndim);
       for (auto& d : resp.shapes[i])
         if (!r.I64(&d)) return false;
+      if (!r.U8(&resp.cacheable[i])) return false;
+    }
+    int32_t nranks;
+    if (!r.I32(&nranks) || nranks < 0 || nranks > (1 << 20)) return false;
+    resp.rank_extents.resize(nranks);
+    for (auto& ext : resp.rank_extents) {
+      int32_t nvals;
+      if (!r.I32(&nvals) || nvals < 0 || nvals > (1 << 20)) return false;
+      ext.resize(nvals);
+      for (auto& v : ext)
+        if (!r.I64(&v)) return false;
     }
   }
   return true;

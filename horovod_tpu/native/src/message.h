@@ -17,7 +17,7 @@
 namespace hvdtpu {
 namespace wire {
 
-constexpr uint8_t kWireVersion = 1;
+constexpr uint8_t kWireVersion = 2;
 
 class Writer {
  public:
@@ -70,6 +70,14 @@ std::string EncodeEntry(const TensorTableEntry& e);
 bool DecodeEntry(Reader& r, TensorTableEntry* e);
 std::string EncodeEntryList(const std::vector<TensorTableEntry>& v);
 bool DecodeEntryList(const std::string& s, std::vector<TensorTableEntry>* v);
+
+// One cycle's report from a rank: cache positions for already-negotiated
+// signatures (the reference's ResponseCache bit vector) + full encodings
+// for misses only.  Steady state sends O(positions) bytes.
+std::string EncodeCycleRequest(const std::vector<int64_t>& positions,
+                               const std::vector<TensorTableEntry>& full);
+bool DecodeCycleRequest(const std::string& s, std::vector<int64_t>* positions,
+                        std::vector<TensorTableEntry>* full);
 
 // ResponseList = coordinator's fused execution orders (reference:
 // ResponseList in message.h).

@@ -1,16 +1,32 @@
-// LRU cache of negotiated collective signatures.
+// Replicated LRU cache of negotiated collective signatures — the
+// steady-state negotiation bypass.
 //
 // Reference parity: horovod/common/response_cache.h/.cc (SURVEY.md §2.1):
-// steady-state steps skip the full Request gather — ranks exchange only a
-// bit vector of cache positions.  TPU-native reinterpretation per SURVEY.md
-// §7.1: a hit ALSO means the XLA executable for that signature is warm, so
-// the cache key doubles as the compiled-collective cache key exported to
-// the Python engine.
+// steady-state cycles skip the full Request gather — each rank sends only
+// the *cache positions* (a bit vector in the reference; a position list
+// here) of already-negotiated signatures, and the coordinator reconstructs
+// the request metadata from its own cache copy.  Full request encoding
+// travels only on a miss.
+//
+// Determinism contract (how positions stay consistent with no extra
+// traffic): the cache is MUTATED ONLY from executed Responses — which every
+// rank receives in the same broadcast, in the same order — so inserts,
+// LRU touches, evictions and therefore position assignment are replicated
+// state transitions.  Query() at submit time is read-only.  Grouped
+// entries (group_id >= 0) are never cached: their group ids are
+// per-submission and would poison the signature (the Response carries a
+// per-entry cacheable flag so all ranks agree).
+//
+// TPU-native reinterpretation per SURVEY.md §7.1: a hit also means the XLA
+// executable for that signature is warm — the Python engine keys its
+// compiled-collective cache the same way.
 #pragma once
 
 #include <cstdint>
+#include <limits>
 #include <list>
 #include <mutex>
+#include <set>
 #include <sstream>
 #include <string>
 #include <unordered_map>
@@ -23,34 +39,87 @@ class ResponseCache {
  public:
   explicit ResponseCache(size_t capacity) : capacity_(capacity) {}
 
+  // Everything that must match for a cached response to be replayable:
+  // name, op, dtype, shape, process set AND the op parameters (root rank,
+  // scale factors) — a resubmission with a different root/scale is a miss.
   static std::string Signature(const TensorTableEntry& e) {
     std::ostringstream os;
+    // full round-trip precision: default 6-digit formatting would collide
+    // nearby scale factors and replay a stale prescale on a false hit
+    os.precision(std::numeric_limits<double>::max_digits10);
     os << e.name << '|' << static_cast<int>(e.op) << '|'
        << static_cast<int>(e.dtype) << '|';
     for (auto d : e.shape) os << d << ',';
-    os << '|' << e.process_set_id;
+    os << '|' << e.process_set_id << '|' << e.root_rank << '|' << e.prescale
+       << '|' << e.postscale;
     return os.str();
   }
 
-  // Returns the cache position (bit index) or -1 on miss; records on miss.
-  int64_t Lookup(const TensorTableEntry& e) {
+  // Grouped entries (per-submission group ids) and explicit alltoall
+  // splits (not part of the signature) can't be replayed from the cache.
+  static bool Cacheable(const TensorTableEntry& e) {
+    return e.group_id < 0 && e.splits.empty();
+  }
+
+  // Read-only lookup at submit time: position or -1.  Never mutates the
+  // replicated state (only the stats counters).
+  int64_t Query(const TensorTableEntry& e) {
     std::lock_guard<std::mutex> lk(mu_);
-    auto sig = Signature(e);
-    auto it = index_.find(sig);
+    if (capacity_ == 0) {
+      ++misses_;
+      return -1;
+    }
+    auto it = index_.find(Signature(e));
     if (it != index_.end()) {
-      lru_.splice(lru_.begin(), lru_, it->second.lru_it);
       ++hits_;
       return it->second.position;
     }
     ++misses_;
-    if (capacity_ > 0 && index_.size() >= capacity_) {
-      const auto& evict = lru_.back();
-      index_.erase(evict);
+    return -1;
+  }
+
+  // Replicated state transition: called for each cacheable entry of each
+  // executed Response, in response order, on EVERY rank.  Inserts new
+  // signatures (assigning the lowest free position), touches existing
+  // ones to the LRU front, evicts the LRU tail at capacity.
+  void Commit(const TensorTableEntry& e) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (capacity_ == 0) return;
+    auto sig = Signature(e);
+    auto it = index_.find(sig);
+    if (it != index_.end()) {
+      lru_.splice(lru_.begin(), lru_, it->second.lru_it);
+      return;
+    }
+    if (index_.size() >= capacity_) {
+      const auto& evict_sig = lru_.back();
+      auto evict_it = index_.find(evict_sig);
+      by_position_.erase(evict_it->second.position);
+      free_positions_.insert(evict_it->second.position);
+      index_.erase(evict_it);
       lru_.pop_back();
     }
+    int64_t pos;
+    if (!free_positions_.empty()) {
+      pos = *free_positions_.begin();
+      free_positions_.erase(free_positions_.begin());
+    } else {
+      pos = next_position_++;
+    }
     lru_.push_front(sig);
-    index_[sig] = {next_position_++, lru_.begin()};
-    return -1;
+    index_[sig] = Slot{e, pos, lru_.begin()};
+    by_position_[pos] = sig;
+  }
+
+  // Coordinator-side reconstruction: position -> full request metadata.
+  bool GetByPosition(int64_t pos, TensorTableEntry* out) const {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto pit = by_position_.find(pos);
+    if (pit == by_position_.end()) return false;
+    auto it = index_.find(pit->second);
+    if (it == index_.end()) return false;
+    *out = it->second.meta;
+    return true;
   }
 
   int64_t hits() const { std::lock_guard<std::mutex> lk(mu_); return hits_; }
@@ -59,6 +128,7 @@ class ResponseCache {
 
  private:
   struct Slot {
+    TensorTableEntry meta;  // replayable request metadata (id/group unset)
     int64_t position;
     std::list<std::string>::iterator lru_it;
   };
@@ -67,8 +137,10 @@ class ResponseCache {
   int64_t next_position_ = 0;
   int64_t hits_ = 0;
   int64_t misses_ = 0;
-  std::list<std::string> lru_;  // front = most recent
+  std::list<std::string> lru_;  // front = most recently executed
   std::unordered_map<std::string, Slot> index_;
+  std::unordered_map<int64_t, std::string> by_position_;
+  std::set<int64_t> free_positions_;
 };
 
 }  // namespace hvdtpu

@@ -356,6 +356,7 @@ def alltoall_async(
             process_set_id=(
                 process_set.process_set_id if process_set is not None else 0
             ),
+            splits=splits,  # negotiated: coordinator gathers the matrix
             extra=splits,
         )
     eng = _engine()

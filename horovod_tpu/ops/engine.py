@@ -185,25 +185,78 @@ class CollectiveEngine:
         )
         return self._local_view(g)
 
+    def _exchange_extents(
+        self, values: Sequence[int],
+        process_set: Optional[ProcessSet] = None,
+    ) -> List[List[int]]:
+        """Gather a small per-process int vector from every process — the
+        fallback-path shape negotiation (the native controller ships these
+        extents in its Response instead; reference: the recvcounts /
+        splits exchange inside MPIAllgather/MPIAlltoall)."""
+        v = jnp.asarray(list(values), jnp.int32)[None]
+        g = self.allgather(v, process_set, recv_dim0s=[1] * self.num_contributors)
+        return np.asarray(g).astype(int).tolist()
+
     def allgather(
-        self, x: jax.Array, process_set: Optional[ProcessSet] = None
+        self, x: jax.Array, process_set: Optional[ProcessSet] = None,
+        recv_dim0s: Optional[Sequence[int]] = None,
     ) -> jax.Array:
         """Concatenate contributions along dim 0 (reference:
-        AllgatherOp / NCCLAllgather).  Even first dims for now; uneven
-        first-dim support arrives with the native controller's shape
-        negotiation (MPIAllgather's recvcounts path)."""
+        AllgatherOp / NCCLAllgather, including MPIAllgather's uneven
+        recvcounts path).  ``recv_dim0s`` is the negotiated per-process
+        dim0 list — supplied by the native controller's response, or
+        self-negotiated with a one-int exchange on the fallback path."""
         self._check_process_set(process_set)
         x = jnp.asarray(x)
         if not self.multi_process:
             return x
-        key = ("allgather", x.shape, str(x.dtype))
+        n = self.num_contributors
+        if recv_dim0s is None:
+            if x.ndim == 0:
+                counts = None  # scalars gather to (n,): trivially even
+            else:
+                counts = [
+                    int(c[0]) for c in self._exchange_extents(
+                        [x.shape[0]], process_set
+                    )
+                ]
+        else:
+            counts = [int(c) for c in recv_dim0s]
+        if x.ndim == 0 or counts is None or all(
+            c == x.shape[0] for c in counts
+        ):
+            key = ("allgather", x.shape, str(x.dtype))
 
-        def fn(a):
-            u = self._unique_rows(a)  # (P, d0, ...)
-            return u.reshape((-1,) + u.shape[2:])
+            def fn(a):
+                u = self._unique_rows(a)  # (P, d0, ...)
+                return u.reshape((-1,) + u.shape[2:])
 
-        compiled = self._compile(key, fn)
-        return self._local_view(self._run(compiled, self._stacked_global(x)))
+            compiled = self._compile(key, fn)
+            return self._local_view(
+                self._run(compiled, self._stacked_global(x))
+            )
+        # uneven first dims: pad to the max, gather, statically re-slice
+        if x.ndim == 0:
+            raise ValueError("uneven allgather requires ndim >= 1")
+        maxd = max(counts)
+        pad = maxd - x.shape[0]
+        xp = (
+            jnp.pad(x, [(0, pad)] + [(0, 0)] * (x.ndim - 1)) if pad else x
+        )
+        key = ("allgather_uneven", xp.shape, str(x.dtype), tuple(counts))
+
+        def fn_uneven(a):
+            u = self._unique_rows(a)  # (P, maxd, ...)
+            parts = [
+                jax.lax.slice_in_dim(u[p], 0, counts[p], axis=0)
+                for p in range(n)
+            ]
+            return jnp.concatenate(parts, axis=0)
+
+        compiled = self._compile(key, fn_uneven)
+        return self._local_view(
+            self._run(compiled, self._stacked_global(xp))
+        )
 
     def broadcast(
         self,
@@ -231,48 +284,99 @@ class CollectiveEngine:
         x: jax.Array,
         splits: Optional[Sequence[int]] = None,
         process_set: Optional[ProcessSet] = None,
+        all_splits: Optional[Sequence[Sequence[int]]] = None,
     ) -> Tuple[jax.Array, jax.Array]:
-        """Reference: AlltoallOp / NCCLAlltoall.  Returns (received,
-        received_splits) like horovod/torch/mpi_ops.py alltoall."""
+        """Reference: AlltoallOp / NCCLAlltoall / MPIAlltoall with splits.
+        Returns (received, received_splits) like horovod/torch/mpi_ops.py
+        alltoall.  ``all_splits`` is the negotiated (n_processes x
+        n_processes) send matrix — row r is what process r sends each peer
+        — supplied by the native controller's response, or self-negotiated
+        on the fallback path."""
         self._check_process_set(process_set)
         x = jnp.asarray(x)
         n = self.num_contributors
+        dim0 = x.shape[0] if x.ndim else 0
         if splits is not None:
-            splits = np.asarray(splits, dtype=np.int32)
-            if splits.shape != (n,) or int(splits.sum()) != (
-                x.shape[0] if x.ndim else 0
-            ):
+            splits = np.asarray(splits, dtype=np.int64)
+            if splits.shape != (n,) or int(splits.sum()) != dim0 or (
+                splits < 0
+            ).any():
                 raise ValueError(
-                    f"splits must be shape ({n},) summing to dim0 of the input"
+                    f"splits must be shape ({n},) of non-negative counts "
+                    "summing to dim0 of the input"
                 )
         if not self.multi_process:
             recv_splits = (
-                jnp.asarray(splits)
+                jnp.asarray(splits, jnp.int32)
                 if splits is not None
-                else jnp.asarray([x.shape[0]], dtype=jnp.int32)
+                else jnp.asarray([dim0], dtype=jnp.int32)
             )
             return x, recv_splits
-        if splits is not None:
-            raise NotImplementedError(
-                "uneven alltoall splits over processes land with the native "
-                "controller's shape negotiation"
-            )
-        if x.shape[0] % n != 0:
-            raise ValueError(
-                f"alltoall dim0 ({x.shape[0]}) must divide evenly by {n}"
-            )
+        if x.ndim == 0:
+            raise ValueError("alltoall requires ndim >= 1")
         me = self.topology.process_index
-        key = ("alltoall", x.shape, str(x.dtype), me)
-        chunk = x.shape[0] // n
+        if all_splits is None:
+            if splits is None and dim0 % n != 0:
+                raise ValueError(
+                    f"alltoall dim0 ({dim0}) must divide evenly by {n} "
+                    "when no splits are given"
+                )
+            my_splits = (
+                [int(s) for s in splits] if splits is not None
+                else [dim0 // n] * n
+            )
+            all_splits = self._exchange_extents(my_splits, process_set)
+        all_splits = [[int(s) for s in row] for row in all_splits]
+        recv_counts = [all_splits[p][me] for p in range(n)]
+        chunk = dim0 // n if dim0 % n == 0 else -1
+        if chunk >= 0 and all(
+            s == chunk for row in all_splits for s in row
+        ):
+            # perfectly even: the reshape/transpose fast path
+            key = ("alltoall", x.shape, str(x.dtype), me)
 
-        def fn(a):
-            u = self._unique_rows(a)  # (P, d0, ...)
-            c = u.reshape((n, n, chunk) + u.shape[2:])  # (src, dst, chunk,...)
-            return c[:, me].reshape((-1,) + u.shape[2:])
+            def fn(a):
+                u = self._unique_rows(a)  # (P, d0, ...)
+                c = u.reshape((n, n, chunk) + u.shape[2:])
+                return c[:, me].reshape((-1,) + u.shape[2:])
 
-        compiled = self._compile(key, fn)
-        out = self._local_view(self._run(compiled, self._stacked_global(x)))
-        return out, jnp.full((n,), chunk, dtype=jnp.int32)
+            compiled = self._compile(key, fn)
+            out = self._local_view(
+                self._run(compiled, self._stacked_global(x))
+            )
+            return out, jnp.full((n,), chunk, dtype=jnp.int32)
+        # general splits: pad every contribution to the max total rows,
+        # then statically slice each (src -> me) segment out
+        dim0s = [sum(row) for row in all_splits]
+        maxd = max(dim0s)
+        if dim0s[me] != dim0:
+            raise ValueError(
+                f"negotiated row total {dim0s[me]} != local dim0 {dim0}"
+            )
+        xp = (
+            jnp.pad(x, [(0, maxd - dim0)] + [(0, 0)] * (x.ndim - 1))
+            if maxd > dim0 else x
+        )
+        key = (
+            "alltoall_splits", xp.shape, str(x.dtype), me,
+            tuple(tuple(r) for r in all_splits),
+        )
+
+        def fn_splits(a):
+            u = self._unique_rows(a)  # (P, maxd, ...)
+            parts = []
+            for p in range(n):
+                off = sum(all_splits[p][:me])
+                parts.append(
+                    jax.lax.slice_in_dim(
+                        u[p], off, off + all_splits[p][me], axis=0
+                    )
+                )
+            return jnp.concatenate(parts, axis=0)
+
+        compiled = self._compile(key, fn_splits)
+        out = self._local_view(self._run(compiled, self._stacked_global(xp)))
+        return out, jnp.asarray(recv_counts, jnp.int32)
 
     def reducescatter(
         self,

@@ -67,6 +67,46 @@ def main():
             100.0 * p + 2 * hvd.cross_rank() + np.arange(2),
         )
 
+    # uneven allgather: rank r contributes r+1 rows (reference:
+    # MPIAllgather recvcounts negotiation)
+    mine = jnp.full((hvd.cross_rank() + 1, 2), float(hvd.cross_rank()))
+    gathered = hvd.allgather(mine, name="uneven_ag")
+    assert gathered.shape == (sum(p + 1 for p in range(nproc)), 2), (
+        gathered.shape
+    )
+    off = 0
+    for p in range(nproc):
+        np.testing.assert_allclose(
+            np.asarray(gathered[off:off + p + 1]),
+            np.full((p + 1, 2), float(p)),
+        )
+        off += p + 1
+
+    # alltoall with explicit uneven splits: rank r sends c+1 rows tagged
+    # 100*r + c to peer c (reference: MPIAlltoall splits negotiation)
+    me = hvd.cross_rank()
+    send = jnp.concatenate(
+        [jnp.full((c + 1,), 100.0 * me + c) for c in range(nproc)]
+    )
+    recv, rsplits = hvd.alltoall(
+        send, splits=[c + 1 for c in range(nproc)], name="uneven_a2a"
+    )
+    assert list(np.asarray(rsplits)) == [me + 1] * nproc, rsplits
+    np.testing.assert_allclose(
+        np.asarray(recv),
+        np.concatenate([np.full(me + 1, 100.0 * p + me) for p in range(nproc)]),
+    )
+
+    # cross-rank shape mismatch must raise cleanly, not execute garbage
+    # (reference: the parallel-test error cases of SURVEY.md §4)
+    if hvd.native_built() and nproc > 1:
+        try:
+            hvd.allreduce(jnp.ones((2 + me,)), name="mismatch_probe")
+        except hvd.HorovodInternalError:
+            pass
+        else:
+            raise AssertionError("mismatched shapes did not raise")
+
     # reducescatter: my chunk of the sum
     full = jnp.arange(nproc * 3, dtype=jnp.float32)
     chunk = hvd.reducescatter(full, op=hvd.Sum)
@@ -93,6 +133,25 @@ def main():
     np.testing.assert_allclose(
         np.asarray(new_params["w"]), -np.full(4, np.mean(np.arange(nproc)))
     )
+
+    # ResponseCache bit-vector steady state across processes: repeats of
+    # the same signature negotiate as cache positions (payload shrinks to
+    # O(positions)) and still reduce correctly on every rank
+    if hvd.native_built() and nproc > 1:
+        ctrl = hvd.common.basics._require_init().controller
+        hvd.allreduce(jnp.asarray([1.0]), name="steady", op=hvd.Sum)
+        full_bytes = ctrl.last_request_bytes()
+        for i in range(3):
+            out = hvd.allreduce(
+                jnp.asarray([float(hvd.cross_rank() + i)]),
+                name="steady", op=hvd.Sum,
+            )
+            np.testing.assert_allclose(
+                np.asarray(out), [sum(range(nproc)) + i * nproc]
+            )
+            assert ctrl.last_request_bytes() < full_bytes, (
+                ctrl.last_request_bytes(), full_bytes,
+            )
 
     hvd.barrier()
     print(f"WORKER_OK rank={rank} nproc={nproc} native={hvd.native_built()}")

@@ -60,6 +60,30 @@ def test_native_response_cache_hits():
     assert after_h - before_h >= 2
 
 
+def test_native_cache_bitvector_bypass():
+    """Steady state, the negotiation payload is O(cache positions), not a
+    full request list (reference: ResponseCache bit-vector sync,
+    horovod/common/response_cache.cc).  First submission of a signature
+    travels fully encoded; repeats travel as one i64 position."""
+    ctrl = hvd.common.basics._require_init().controller
+    hvd.allreduce(jnp.ones((64,)), name="bitvec_probe")
+    first = ctrl.last_request_bytes()
+    hits_before = ctrl.cache_hits()
+    steady_sizes = []
+    for _ in range(3):
+        hvd.allreduce(jnp.ones((64,)), name="bitvec_probe")
+        steady_sizes.append(ctrl.last_request_bytes())
+    assert ctrl.cache_hits() - hits_before >= 3
+    # steady-state cycles carry [version][npos][pos][empty entry list]:
+    # constant-size and far smaller than the full encoding
+    assert all(s == steady_sizes[0] for s in steady_sizes)
+    assert steady_sizes[0] < first
+    assert steady_sizes[0] <= 32
+    # a changed signature (new shape) must fall back to full encoding
+    hvd.allreduce(jnp.ones((128,)), name="bitvec_probe")
+    assert ctrl.last_request_bytes() > steady_sizes[0]
+
+
 def test_native_all_ops_roundtrip():
     x = jnp.arange(8.0)
     np.testing.assert_allclose(np.asarray(hvd.allgather(x)), np.asarray(x))

@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Native-path latency probe: enqueue→result latency of eager collectives.
+
+Run per-rank under the launcher, e.g.:
+
+    tpurun -np 2 python tools/native_latency.py
+
+Measures mean/median/p99 wall latency of a small named allreduce (the
+control-plane cost: negotiation cycle + dispatch; the tensor is tiny so
+data-plane time is noise).  Compare configs:
+
+    HVD_TPU_CACHE_CAPACITY=0 tpurun -np 2 python tools/native_latency.py
+        (every cycle ships full request encodings)
+    tpurun -np 2 python tools/native_latency.py
+        (steady state ships cache positions — the bit-vector bypass)
+
+Also prints the in-jit path for reference (psum inside a compiled step —
+no negotiation at all), the "latency table" of VERDICT r2 item 6.
+"""
+import os
+import statistics
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+import horovod_tpu as hvd
+
+
+def timeit(fn, iters):
+    lat = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn()
+        lat.append((time.perf_counter() - t0) * 1e3)
+    return lat
+
+
+def main():
+    hvd.init()
+    iters = int(os.environ.get("LAT_ITERS", "200"))
+    x = jnp.ones((16,), jnp.float32)
+
+    # eager path (negotiated, named => cacheable signature)
+    def eager():
+        out = hvd.allreduce(x, name="lat_probe", op=hvd.Sum)
+        jax.block_until_ready(out)
+
+    eager()  # warm: compile + first full negotiation
+    lat = timeit(eager, iters)
+
+    # burst: 64 concurrent named submissions per iteration — the gradient
+    # bucket pattern where negotiation payload size actually matters
+    xs = [jnp.ones((16,), jnp.float32) for _ in range(64)]
+
+    def burst():
+        hs = [
+            hvd.allreduce_async(a, name=f"lat_burst_{i}", op=hvd.Sum)
+            for i, a in enumerate(xs)
+        ]
+        for h in hs:
+            h.wait()
+
+    burst()
+    burst_lat = timeit(burst, max(iters // 4, 20))
+
+    # in-jit path: same collective compiled into a program (no controller)
+    from horovod_tpu.ops import spmd_ops
+    from jax.sharding import PartitionSpec as P
+
+    mesh = hvd.common.basics._require_init().process_set_registry.get(0).mesh
+    step = jax.jit(
+        jax.shard_map(
+            lambda a: spmd_ops.allreduce(a, op=hvd.Sum),
+            mesh=mesh, in_specs=P(None), out_specs=P(None), check_vma=False,
+        )
+    )
+    jax.block_until_ready(step(x))
+    jit_lat = timeit(lambda: jax.block_until_ready(step(x)), iters)
+
+    if hvd.rank() == 0:
+        ctrl = hvd.common.basics._require_init().controller
+        cache = os.environ.get("HVD_TPU_CACHE_CAPACITY", "default")
+        native = getattr(ctrl, "is_native", False)
+        for tag, ls in (("eager", lat), ("burst64", burst_lat),
+                        ("in-jit", jit_lat)):
+            print(
+                f"cache={cache} native={native} path={tag} "
+                f"mean={statistics.mean(ls):.3f}ms "
+                f"p50={statistics.median(ls):.3f}ms "
+                f"p99={sorted(ls)[int(len(ls) * 0.99) - 1]:.3f}ms "
+                f"n={len(ls)}"
+            )
+        if native:
+            print(f"cache_hits={ctrl.cache_hits()} "
+                  f"cache_misses={ctrl.cache_misses()} "
+                  f"last_request_bytes={ctrl.last_request_bytes()}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
