@@ -90,14 +90,28 @@ __version__ = "0.1.0"
 
 def add_process_set(ranks) -> ProcessSet:
     """Register a new process set (reference: horovod/common/process_sets.py
-    add_process_set)."""
+    add_process_set).  Must be called symmetrically on every process; the
+    set's member processes are mirrored into the native controller so
+    negotiation counts readiness against the set, not the world."""
+    st = _basics._require_init()
     ps = ranks if isinstance(ranks, ProcessSet) else ProcessSet(ranks)
-    return _basics._require_init().process_set_registry.add(ps)
+    ps = st.process_set_registry.add(ps)
+    if st.controller is not None and st.controller.is_native:
+        procs = sorted({
+            getattr(st.topology.devices[r], "process_index", 0)
+            for r in ps.ranks
+        })
+        st.controller.register_process_set(ps.process_set_id, procs)
+    return ps
 
 
 def remove_process_set(process_set: ProcessSet) -> None:
     """Reference: horovod/common/process_sets.py remove_process_set."""
-    _basics._require_init().process_set_registry.remove(process_set)
+    st = _basics._require_init()
+    set_id = process_set.process_set_id
+    st.process_set_registry.remove(process_set)
+    if st.controller is not None and st.controller.is_native:
+        st.controller.remove_process_set(set_id)
 
 
 def process_set_ids():

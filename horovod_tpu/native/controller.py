@@ -47,6 +47,7 @@ _EXEC_CB = ctypes.CFUNCTYPE(
     ctypes.c_int, ctypes.c_double, ctypes.c_double,
     ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
     ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int),
+    ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int),
     ctypes.c_int, ctypes.c_char_p,
 )
 
@@ -100,6 +101,7 @@ class NativeController:
         self._entries: Dict[int, _Entry] = {}
         self._entries_lock = threading.Lock()
         self._name_counter = 0
+        self._auto_counters: Dict[int, int] = {}
         self._lib = ctypes.CDLL(lib_path)
         self._declare(self._lib)
         # the callback object must outlive the native thread: keep the ref
@@ -156,6 +158,12 @@ class NativeController:
         ]
         lib.hvdtpu_register_group.restype = ctypes.c_int
         lib.hvdtpu_register_group.argtypes = [ctypes.c_int]
+        lib.hvdtpu_register_process_set.restype = ctypes.c_int
+        lib.hvdtpu_register_process_set.argtypes = [
+            ctypes.c_int, ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+        ]
+        lib.hvdtpu_remove_process_set.restype = ctypes.c_int
+        lib.hvdtpu_remove_process_set.argtypes = [ctypes.c_int]
         lib.hvdtpu_shutdown.restype = None
         lib.hvdtpu_initialized.restype = ctypes.c_int
         lib.hvdtpu_cache_hits.restype = ctypes.c_longlong
@@ -212,6 +220,17 @@ class NativeController:
     def register_group(self, size: int) -> int:
         return int(self._lib.hvdtpu_register_group(size))
 
+    def register_process_set(self, set_id: int, member_procs) -> None:
+        """Mirror a process set's member *process* ranks into the C++
+        controller so negotiation counts readiness against the set
+        (reference: ProcessSetTable registration)."""
+        m = [int(p) for p in member_procs]
+        arr = (ctypes.c_int * max(len(m), 1))(*(m or [0]))
+        self._lib.hvdtpu_register_process_set(set_id, arr, len(m))
+
+    def remove_process_set(self, set_id: int) -> None:
+        self._lib.hvdtpu_remove_process_set(set_id)
+
     def timeline_activity(self, tensor: str, activity: str,
                           begin: bool) -> None:
         self._lib.hvdtpu_timeline_activity(
@@ -239,8 +258,15 @@ class NativeController:
         with self._entries_lock:
             self._name_counter += 1
             counter = self._name_counter
-        if name is None:
-            name = f"op{op_type}.auto.{counter}"
+            if name is None:
+                # auto names must align ACROSS ranks: count per op type,
+                # and only unnamed submissions — a single global counter
+                # would desynchronize after ragged named calls (e.g. the
+                # post-join barrier; reference: per-op unnamed counters in
+                # horovod/torch/mpi_ops.py _allreduce_async naming)
+                n = self._auto_counters.get(op_type, 0) + 1
+                self._auto_counters[op_type] = n
+                name = f"op{op_type}.auto.{n}"
         arr = jnp.asarray(array)
         dtype_enum = _DTYPE_TO_ENUM.get(str(arr.dtype))
         if dtype_enum is None:
@@ -291,12 +317,20 @@ class NativeController:
     # -- executor callback (runs on the C++ background thread) --------------
 
     def _on_exec(self, _user, op, dtype, process_set, root_or_rop, prescale,
-                 postscale, ids_ptr, n_ids, extents_ptr, extent_lens_ptr,
-                 n_extent_ranks, error):
+                 postscale, ids_ptr, n_ids, shape_dims_ptr, shape_ndims_ptr,
+                 extents_ptr, extent_lens_ptr, n_extent_ranks, error):
         entries: List[_Entry] = []
         try:
             ids = [int(ids_ptr[i]) for i in range(n_ids)]
-            # negotiated per-rank extents (allgather dim0s/alltoall splits)
+            # per-id shapes (for zero-contribution synthesis after join)
+            shapes, off = [], 0
+            for i in range(n_ids):
+                nd = int(shape_ndims_ptr[i])
+                shapes.append(
+                    tuple(int(shape_dims_ptr[off + j]) for j in range(nd))
+                )
+                off += nd
+            # negotiated per-member extents (allgather dim0s/alltoall splits)
             extents: Optional[List[List[int]]] = None
             if n_extent_ranks > 0:
                 extents, off = [], 0
@@ -307,16 +341,37 @@ class NativeController:
                     )
                     off += ln
             with self._entries_lock:
-                entries = [
-                    self._entries.pop(i) for i in ids
+                real = {
+                    i: self._entries.pop(i) for i in ids
                     if i != -1 and i in self._entries
-                ]
-            if not entries:
-                return
+                }
             if error:
                 err = HorovodInternalError(error.decode())
-                for e in entries:
+                for e in real.values():
                     e.future.set_error(err)
+                return
+            me = self._me_in_set(process_set)
+            if me is None:
+                # not a member of this response's process set: no local
+                # entries and no participation in its data-plane program
+                return
+            # align entries with the response's name order; ids this rank
+            # doesn't hold (post-join) become zero contributions so the
+            # SPMD program still sees a symmetric participant
+            np_dtype = _ENUM_TO_DTYPE.get(dtype, "float32")
+            entries = []
+            for i, id_ in enumerate(ids):
+                if id_ in real:
+                    entries.append(real[id_])
+                else:
+                    if op in (OP_ALLGATHER, OP_ALLTOALL) and extents:
+                        shp = (extents[me][0],) + shapes[i][1:]
+                    else:
+                        shp = shapes[i]
+                    entries.append(
+                        _Entry(jnp.zeros(shp, np_dtype), None, op)
+                    )
+            if not entries:
                 return
             self._execute(op, process_set, root_or_rop, prescale, postscale,
                           entries, extents)
@@ -324,9 +379,32 @@ class NativeController:
             get_logger().error("native exec callback failed: %s", exc)
             try:
                 for e in entries:
-                    e.future.set_error(exc)
+                    if e.future is not None:
+                        e.future.set_error(exc)
             except Exception:
                 pass
+
+    def _me_in_set(self, process_set_id: int) -> Optional[int]:
+        """This process's position among the set's member processes, or
+        None when it is not a member (mirrors engine ctx.me)."""
+        if process_set_id == 0:
+            return self._topology.process_index
+        from ..common import basics as _basics
+
+        try:
+            ps = _basics._require_init().process_set_registry.get(
+                process_set_id
+            )
+        except Exception:
+            return None
+        # ascending process order — must match the sorted registration in
+        # add_process_set and the engine ctx's member order
+        members = sorted({
+            getattr(self._topology.devices[r], "process_index", 0)
+            for r in ps.ranks
+        })
+        me = self._topology.process_index
+        return members.index(me) if me in members else None
 
     def _execute(self, op, process_set, root_or_rop, prescale, postscale,
                  entries: List[_Entry], extents=None) -> None:
@@ -334,13 +412,28 @@ class NativeController:
         from ..ops.reduce_ops import ReduceOp
 
         eng = self._engine
+
+        def resolve(e, value):
+            if e.future is not None:  # None = synthesized zero (post-join)
+                e.future.set_result(value)
+
         # resolve the response's process set so the engine applies its own
         # scoping rules (world = None fast path)
         ps = (
             None if process_set == 0
             else _basics._require_init().process_set_registry.get(process_set)
         )
-        if op == OP_ALLREDUCE:
+        if op == OP_JOIN:
+            # the join barrier released: result is the last joining rank
+            # (reference: JoinOp returns last_joined_rank).  Every rank
+            # sees this response at the same protocol point, so it is the
+            # one safe moment to resynchronize the auto-name counters that
+            # ragged unnamed submissions may have skewed across ranks.
+            with self._entries_lock:
+                self._auto_counters.clear()
+            for e in entries:
+                resolve(e, int(root_or_rop))
+        elif op == OP_ALLREDUCE:
             # fused execution: one flat buffer, one collective (the native
             # fusion decision made by the controller)
             arrays = [e.payload for e in entries]
@@ -355,27 +448,24 @@ class NativeController:
             )
             offset = 0
             for e, sz, shp in zip(entries, sizes, shapes):
-                e.future.set_result(
+                resolve(
+                    e,
                     jax.lax.dynamic_slice_in_dim(out, offset, sz)
-                    .reshape(shp)
+                    .reshape(shp),
                 )
                 offset += sz
         elif op == OP_ALLGATHER:
-            # negotiated recvcounts: per-rank dim0 from the response
+            # negotiated recvcounts: per-member dim0 from the response
             # (reference: MPIAllgather's recvcounts path)
             dim0s = [ext[0] for ext in extents] if extents else None
             for e in entries:
-                e.future.set_result(
-                    eng.allgather(e.payload, ps, recv_dim0s=dim0s)
-                )
+                resolve(e, eng.allgather(e.payload, ps, recv_dim0s=dim0s))
         elif op == OP_BROADCAST:
             for e in entries:
-                e.future.set_result(
-                    eng.broadcast(e.payload, root_or_rop, ps)
-                )
+                resolve(e, eng.broadcast(e.payload, root_or_rop, ps))
         elif op == OP_ALLTOALL:
-            # negotiated splits matrix: extents[r] = [dim0, splits...];
-            # a rank with no explicit splits sends even dim0/n chunks
+            # negotiated splits matrix: extents[m] = [dim0, splits...];
+            # a member with no explicit splits sends even dim0/n chunks
             all_splits = None
             if extents:
                 n = len(extents)
@@ -386,20 +476,22 @@ class NativeController:
                         sp = [dim0 // n] * n
                     all_splits.append(sp)
             for e in entries:
-                e.future.set_result(
+                resolve(
+                    e,
                     eng.alltoall(e.payload, e.extra, ps,
-                                 all_splits=all_splits)
+                                 all_splits=all_splits),
                 )
         elif op == OP_REDUCESCATTER:
             for e in entries:
-                e.future.set_result(
-                    eng.reducescatter(e.payload, ReduceOp(root_or_rop), ps)
+                resolve(
+                    e, eng.reducescatter(e.payload, ReduceOp(root_or_rop), ps)
                 )
         elif op == OP_BARRIER:
             for e in entries:
                 eng.barrier(ps)
-                e.future.set_result(None)
+                resolve(e, None)
         else:
             err = HorovodInternalError(f"unknown native op {op}")
             for e in entries:
-                e.future.set_error(err)
+                if e.future is not None:
+                    e.future.set_error(err)

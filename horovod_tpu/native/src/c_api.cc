@@ -31,13 +31,15 @@ namespace hvdtpu {
 namespace {
 
 // Executor callback into Python: one call per fused Response.
-// ids[i] == -1 when this rank holds no entry for names[i] (join fill).
-// extents: flattened per-rank negotiated extents (allgather dim0s /
-// alltoall splits) with extent_lens[r] values for rank r; n_extent_ranks
+// ids[i] == -1 when this rank holds no entry for names[i]; the rank then
+// synthesizes a zero contribution from shape_dims/shape_ndims (join fill).
+// extents: flattened per-member negotiated extents (allgather dim0s /
+// alltoall splits) with extent_lens[m] values for member m; n_extent_ranks
 // is 0 for ops that negotiate no shapes.
 typedef void (*ExecCallback)(void* user, int op, int dtype, int process_set,
                              int root_rank, double prescale, double postscale,
                              const int64_t* ids, int n_ids,
+                             const int64_t* shape_dims, const int* shape_ndims,
                              const int64_t* extents, const int* extent_lens,
                              int n_extent_ranks, const char* error);
 
@@ -144,11 +146,17 @@ int hvdtpu_init(int rank, int size, const char* coord_host, int coord_port,
         extent_lens.push_back(static_cast<int>(ext.size()));
         extents.insert(extents.end(), ext.begin(), ext.end());
       }
+      std::vector<int64_t> shape_dims;
+      std::vector<int> shape_ndims;
+      for (const auto& shp : resp.shapes) {
+        shape_ndims.push_back(static_cast<int>(shp.size()));
+        shape_dims.insert(shape_dims.end(), shp.begin(), shp.end());
+      }
       s->exec_cb(s->exec_user, static_cast<int>(resp.op),
                  static_cast<int>(resp.dtype), resp.process_set_id,
                  resp.root_rank, resp.prescale, resp.postscale, ids.data(),
-                 static_cast<int>(ids.size()), extents.data(),
-                 extent_lens.data(),
+                 static_cast<int>(ids.size()), shape_dims.data(),
+                 shape_ndims.data(), extents.data(), extent_lens.data(),
                  static_cast<int>(extent_lens.size()),
                  resp.error.empty() ? nullptr : resp.error.c_str());
     }
@@ -178,11 +186,27 @@ int hvdtpu_init(int rank, int size, const char* coord_host, int coord_port,
 
 void hvdtpu_set_exec_callback(void (*cb)(void*, int, int, int, int, double,
                                          double, const int64_t*, int,
+                                         const int64_t*, const int*,
                                          const int64_t*, const int*, int,
                                          const char*),
                               void* user) {
   hvdtpu::g()->exec_cb = cb;
   hvdtpu::g()->exec_user = user;
+}
+
+int hvdtpu_register_process_set(int set_id, const int* members, int n) {
+  auto* s = hvdtpu::g();
+  if (!s->initialized.load()) return -1;
+  std::vector<int32_t> m(members, members + (n > 0 ? n : 0));
+  s->controller->RegisterProcessSet(set_id, std::move(m));
+  return 0;
+}
+
+int hvdtpu_remove_process_set(int set_id) {
+  auto* s = hvdtpu::g();
+  if (!s->initialized.load()) return -1;
+  s->controller->RemoveProcessSet(set_id);
+  return 0;
 }
 
 long long hvdtpu_enqueue(long long entry_id, const char* name, int op,

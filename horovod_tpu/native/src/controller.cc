@@ -65,6 +65,13 @@ bool Controller::RunLoopOnce() {
         }
       }
       for (auto& e : reqs) {
+        if (e.op == OpType::JOIN) {
+          // reference: Join rides the request stream; the coordinator
+          // excludes joined ranks from readiness until everyone joins
+          joined_ranks_.insert(r);
+          last_join_rank_ = r;
+          continue;
+        }
         auto it = coord_table_.find(Key(e.name, e.process_set_id));
         if (it == coord_table_.end()) {
           PendingCoord pc;
@@ -142,8 +149,10 @@ bool Controller::RunLoopOnce() {
   for (const auto& resp : responses) {
     std::vector<int64_t> local_ids;
     local_ids.reserve(resp.names.size());
-    // Replicated-cache state transition: every rank commits the same
-    // entries in the same broadcast order (response_cache.h contract).
+    // Replicated-cache state transition: every rank — member of the
+    // response's process set or not — commits the same entries in the
+    // same broadcast order (response_cache.h contract: skipping any
+    // would diverge position assignment).
     for (size_t i = 0; i < resp.names.size(); ++i) {
       if (i < resp.cacheable.size() && resp.cacheable[i]) {
         TensorTableEntry meta;
@@ -157,6 +166,13 @@ bool Controller::RunLoopOnce() {
         meta.postscale = resp.postscale;
         cache_->Commit(meta);
       }
+    }
+    // non-members hold no entries and must not participate in the set's
+    // data-plane program (its mesh spans member processes only)
+    auto members = SetMembers(resp.process_set_id);
+    if (std::find(members.begin(), members.end(), rank()) ==
+        members.end()) {
+      continue;
     }
     for (size_t i = 0; i < resp.names.size(); ++i) {
       auto it = pending_.find(Key(resp.names[i], resp.process_set_id));
@@ -235,15 +251,18 @@ void Controller::AccountReport(PendingCoord* pc, int32_t r,
     case OpType::ALLTOALL: {
       if (!trailing_dims_match()) mismatch("trailing dimensions");
       int64_t dim0 = e.shape.empty() ? 0 : e.shape[0];
+      auto set_size =
+          static_cast<int64_t>(SetMembers(e.process_set_id).size());
       if (!e.splits.empty()) {
         int64_t total = 0;
         for (auto s : e.splits) {
           if (s < 0) mismatch("negative split");
           total += s;
         }
-        if (static_cast<int>(e.splits.size()) != size() || total != dim0)
-          mismatch("splits (length must be world size, sum must be dim0)");
-      } else if (size() > 0 && dim0 % size() != 0) {
+        if (static_cast<int64_t>(e.splits.size()) != set_size ||
+            total != dim0)
+          mismatch("splits (length must be set size, sum must be dim0)");
+      } else if (set_size > 0 && dim0 % set_size != 0) {
         // splitless even alltoall requires divisibility; catching it in
         // negotiation fails ALL ranks cleanly instead of one rank raising
         // locally while the rest enter the collective and stall
@@ -259,27 +278,57 @@ void Controller::AccountReport(PendingCoord* pc, int32_t r,
       if (e.shape != first.shape) mismatch("shape");
       break;
   }
+  // op parameters must agree too — otherwise the first reporter's
+  // root/scale silently wins on the disagreeing rank
+  if (e.root_rank != first.root_rank) mismatch("root_rank");
+  if (e.prescale != first.prescale || e.postscale != first.postscale)
+    mismatch("prescale/postscale factors");
   pc->reported.insert(r);
 }
 
-void Controller::Join(int64_t) {
-  // Coordinator bookkeeping arrives via the JOIN op in the request stream;
-  // the loopback world is a single rank, so joining is immediate.
-  joined_ranks_.insert(rank());
+void Controller::RegisterProcessSet(int32_t set_id,
+                                    std::vector<int32_t> members) {
+  std::lock_guard<std::mutex> lk(sets_mu_);
+  set_members_[set_id] = std::move(members);
+}
+
+void Controller::RemoveProcessSet(int32_t set_id) {
+  std::lock_guard<std::mutex> lk(sets_mu_);
+  set_members_.erase(set_id);
+}
+
+std::vector<int32_t> Controller::SetMembers(int32_t set_id) const {
+  {
+    std::lock_guard<std::mutex> lk(sets_mu_);
+    auto it = set_members_.find(set_id);
+    if (it != set_members_.end() && !it->second.empty()) return it->second;
+  }
+  std::vector<int32_t> all(size());
+  for (int32_t r = 0; r < size(); ++r) all[r] = r;
+  return all;
 }
 
 std::vector<Response> Controller::BuildResponses() {
-  // Ready = reported by all non-joined ranks of the process set world.
-  // Deterministic order: FIFO by coordinator first-sight (reference:
-  // responses preserve request arrival order before fusion).
+  // Ready = reported by all non-joined member ranks of the entry's
+  // process set (reference: per-ProcessSet controllers count readiness
+  // against their own membership).  Deterministic order: FIFO by
+  // coordinator first-sight (responses preserve request arrival order
+  // before fusion).  When every member has joined, remaining reported
+  // entries flush with zero contributions from the joined ranks.
   std::vector<const PendingCoord*> ready;
   for (auto& [name, pc] : coord_table_) {
+    auto members = SetMembers(pc.meta.process_set_id);
     size_t need = 0;
-    for (int32_t r = 0; r < size(); ++r)
-      if (joined_ranks_.find(r) == joined_ranks_.end()) ++need;
-    std::set<int32_t> effective = pc.reported;
-    for (auto r : joined_ranks_) effective.erase(r);
-    if (effective.size() >= need && need > 0) ready.push_back(&pc);
+    std::set<int32_t> effective;
+    for (auto m : members) {
+      if (joined_ranks_.find(m) == joined_ranks_.end()) {
+        ++need;
+        if (pc.reported.count(m)) effective.insert(m);
+      }
+    }
+    bool is_ready =
+        need > 0 ? effective.size() >= need : !pc.reported.empty();
+    if (is_ready) ready.push_back(&pc);
   }
   // group atomicity (reference: GroupTable): only emit a group's entries
   // when the whole group is ready
@@ -352,15 +401,17 @@ std::vector<Response> Controller::BuildResponses() {
       r.cacheable = {
           static_cast<uint8_t>(ResponseCache::Cacheable(e) ? 1 : 0)};
       if (e.op == OpType::ALLGATHER || e.op == OpType::ALLTOALL) {
-        // negotiated per-rank extents ride the response (reference:
-        // Response::tensor_sizes); joined ranks contribute zero rows
-        r.rank_extents.resize(size());
-        for (int32_t rr = 0; rr < size(); ++rr) {
-          auto info = pc->rank_info.find(rr);
+        // negotiated per-member extents ride the response (reference:
+        // Response::tensor_sizes), indexed in set-member order; joined
+        // ranks contribute zero rows
+        auto members = SetMembers(e.process_set_id);
+        r.rank_extents.resize(members.size());
+        for (size_t mi = 0; mi < members.size(); ++mi) {
+          auto info = pc->rank_info.find(members[mi]);
           if (info != pc->rank_info.end())
-            r.rank_extents[rr] = info->second;
+            r.rank_extents[mi] = info->second;
           else
-            r.rank_extents[rr] = {0};
+            r.rank_extents[mi] = {0};
         }
       }
       out.push_back(std::move(r));
@@ -372,6 +423,21 @@ std::vector<Response> Controller::BuildResponses() {
     if (e.group_id >= 0) groups_->Forget(e.group_id);
   }
   for (const auto& key : emitted) coord_table_.erase(key);
+
+  // everyone joined: release the join barrier (reference: JoinOp response
+  // carrying the last joining rank) and reset the joined state
+  if (!joined_ranks_.empty() &&
+      static_cast<int>(joined_ranks_.size()) == size()) {
+    Response jr;
+    jr.op = OpType::JOIN;
+    jr.root_rank = last_join_rank_;
+    jr.names = {"__join__"};
+    jr.shapes = {{}};
+    jr.cacheable = {0};
+    out.push_back(std::move(jr));
+    joined_ranks_.clear();
+    last_join_rank_ = -1;
+  }
   return out;
 }
 

@@ -16,6 +16,7 @@
 #include <functional>
 #include <map>
 #include <memory>
+#include <mutex>
 #include <set>
 #include <unordered_map>
 #include <vector>
@@ -58,12 +59,15 @@ class Controller {
   // Returns false when a shutdown condition tripped (stall hard-limit).
   bool RunLoopOnce();
 
-  // Rank declares it has no more data (reference: Join op).  Subsequent
-  // tensors become ready without this rank's report.
-  void Join(int64_t entry_id);
-
   int rank() const { return transport_->rank(); }
   int size() const { return transport_->size(); }
+
+  // Process-set membership (process ranks), mirrored from the Python
+  // registry on every process (reference: ProcessSetTable).  Readiness for
+  // a set's tensors is counted against its members, not the world.
+  void RegisterProcessSet(int32_t set_id, std::vector<int32_t> members);
+  void RemoveProcessSet(int32_t set_id);
+  std::vector<int32_t> SetMembers(int32_t set_id) const;
 
   // Size of this rank's last non-empty cycle request payload — the
   // observable for the steady-state bit-vector bypass (a cached cycle is
@@ -105,7 +109,11 @@ class Controller {
   // coordinator state (rank 0 only)
   std::map<std::string, PendingCoord> coord_table_;
   std::set<int32_t> joined_ranks_;
+  int32_t last_join_rank_ = -1;
   int64_t order_counter_ = 0;
+  // set id -> member process ranks (absent/empty = all ranks)
+  mutable std::mutex sets_mu_;
+  std::unordered_map<int32_t, std::vector<int32_t>> set_members_;
 };
 
 }  // namespace hvdtpu

@@ -55,10 +55,11 @@ class ResponseCache {
     return os.str();
   }
 
-  // Grouped entries (per-submission group ids) and explicit alltoall
-  // splits (not part of the signature) can't be replayed from the cache.
+  // Grouped entries (per-submission group ids), explicit alltoall splits
+  // (not part of the signature) and join markers (coordinator state, not
+  // negotiated tensors) can't be replayed from the cache.
   static bool Cacheable(const TensorTableEntry& e) {
-    return e.group_id < 0 && e.splits.empty();
+    return e.group_id < 0 && e.splits.empty() && e.op != OpType::JOIN;
   }
 
   // Read-only lookup at submit time: position or -1.  Never mutates the

@@ -419,14 +419,22 @@ def barrier(process_set: Optional[ProcessSet] = None) -> None:
 
 
 def join() -> int:
-    """Reference: horovod/torch/mpi_ops.py join() — signals this worker is
-    out of data; returns the last joining rank.  Meaningful only in
-    multi-process deployments; lands with the native controller's
-    negotiation (it must pump zero-contributions for peers' collectives).
+    """Reference: horovod/torch/mpi_ops.py join() + JoinOp — signals this
+    worker is out of data.  While waiting, the background controller keeps
+    this process participating in peers' collectives with zero
+    contributions (ragged per-rank dataset sizes); returns once every
+    process has joined, with the process rank of the last one to join.
     """
     st = basics._require_init()
     if not st.engine.multi_process:
-        return st.topology.rank
-    raise NotImplementedError(
-        "join() over processes requires the native controller (M3+)"
-    )
+        return st.topology.process_index
+    ctrl = _native()
+    if ctrl is None:
+        raise NotImplementedError(
+            "join() over processes requires the native controller "
+            "(launch via tpurun so the negotiation channel exists)"
+        )
+    from ..native.controller import OP_JOIN
+
+    fut = ctrl.enqueue(jnp.zeros((), jnp.int32), OP_JOIN, name="__join__")
+    return int(fut.result())

@@ -68,21 +68,69 @@ class CollectiveEngine:
     def __init__(self, topology: Topology, config: Config):
         self.topology = topology
         self.config = config
-        self._mesh = topology.mesh()
         self._cache = {}  # signature -> compiled callable
-        # Global slot index of each process's lead device ("unique rows" of
-        # the tiled contribution stack).
-        self._lead_slots = self._compute_lead_slots()
+        self._set_ctxs = {}  # process_set_id -> _SetCtx
+        self._world_ctx = self._build_ctx(None)
 
-    # -- topology helpers ---------------------------------------------------
+    # -- per-set topology contexts ------------------------------------------
 
-    def _compute_lead_slots(self) -> Tuple[int, ...]:
-        slots = {}
-        for i, d in enumerate(self.topology.devices):
+    class _SetCtx:
+        """Execution scope of one process set: its sub-mesh, the member
+        processes, and this process's place among them (reference analog:
+        the per-ProcessSet controller + communicators of
+        horovod/common/process_set.h, collapsed to mesh bookkeeping)."""
+
+        __slots__ = (
+            "set_id", "mesh", "devices", "local_devices", "member_procs",
+            "lead_slots", "me", "n",
+        )
+
+    def _build_ctx(self, process_set: Optional[ProcessSet]) -> "_SetCtx":
+        ctx = self._SetCtx()
+        if process_set is None or process_set.process_set_id in (0, None):
+            ctx.set_id = 0
+            ctx.devices = tuple(self.topology.devices)
+            ctx.mesh = self.topology.mesh()
+        else:
+            ctx.set_id = process_set.process_set_id
+            ctx.devices = tuple(
+                self.topology.devices[r] for r in process_set.ranks
+            )
+            ctx.mesh = process_set.mesh
+        my_proc = self.topology.process_index
+        ctx.local_devices = tuple(
+            d for d in ctx.devices
+            if getattr(d, "process_index", 0) == my_proc
+        )
+        first_slot = {}
+        for i, d in enumerate(ctx.devices):
             p = getattr(d, "process_index", 0)
-            if p not in slots:
-                slots[p] = i
-        return tuple(slots[p] for p in sorted(slots))
+            if p not in first_slot:
+                first_slot[p] = i
+        # member order is ASCENDING process index everywhere — the C++
+        # controller registers sorted members and indexes rank_extents by
+        # that order, so first-occurrence ordering would misalign when the
+        # device list interleaves processes
+        member_procs = sorted(first_slot)
+        ctx.member_procs = tuple(member_procs)
+        ctx.lead_slots = tuple(first_slot[p] for p in member_procs)
+        ctx.me = (
+            member_procs.index(my_proc) if my_proc in member_procs else None
+        )
+        ctx.n = max(len(member_procs), 1)
+        return ctx
+
+    def _ctx(self, process_set: Optional[ProcessSet]) -> "_SetCtx":
+        if process_set is None or process_set.process_set_id in (0, None):
+            return self._world_ctx
+        sid = process_set.process_set_id
+        ctx = self._set_ctxs.get(sid)
+        if ctx is None or ctx.devices != tuple(
+            self.topology.devices[r] for r in process_set.ranks
+        ):
+            ctx = self._build_ctx(process_set)
+            self._set_ctxs[sid] = ctx
+        return ctx
 
     @property
     def num_contributors(self) -> int:
@@ -94,39 +142,39 @@ class CollectiveEngine:
 
     # -- global-array plumbing ---------------------------------------------
 
-    def _stacked_global(self, x: jax.Array) -> jax.Array:
-        """Tile this process's contribution onto each local chip and view
-        the result as one global (size, ...) array sharded over the world
-        axis.  This is the 'memcpy into the fusion buffer' moment of the
-        reference (gpu_operations.cc MemcpyInFusionBuffer) — except it is a
-        zero-copy resharding hint, not a copy kernel."""
+    def _stacked_global(self, x: jax.Array, ctx: "_SetCtx") -> jax.Array:
+        """Tile this process's contribution onto each of its chips in the
+        set and view the result as one global (set_size, ...) array sharded
+        over the set's axis.  This is the 'memcpy into the fusion buffer'
+        moment of the reference (gpu_operations.cc MemcpyInFusionBuffer) —
+        except it is a zero-copy resharding hint, not a copy kernel."""
         x = jnp.asarray(x)
-        shards = [
-            jax.device_put(x[None], d) for d in self.topology.local_devices
-        ]
-        global_shape = (self.topology.size,) + tuple(x.shape)
-        sharding = NamedSharding(self._mesh, P(WORLD_AXIS))
+        shards = [jax.device_put(x[None], d) for d in ctx.local_devices]
+        global_shape = (len(ctx.devices),) + tuple(x.shape)
+        sharding = NamedSharding(ctx.mesh, P(WORLD_AXIS))
         return jax.make_array_from_single_device_arrays(
             global_shape, sharding, shards
         )
 
-    def _replicated(self):
-        return NamedSharding(self._mesh, P())
+    def _replicated(self, ctx: "_SetCtx"):
+        return NamedSharding(ctx.mesh, P())
 
     def _local_view(self, global_arr: jax.Array) -> jax.Array:
         """Local copy of a fully replicated global array."""
         return global_arr.addressable_data(0)
 
-    def _compile(self, key, fn, *example_args):
+    def _compile(self, key, fn, ctx: "_SetCtx"):
+        key = key + (ctx.set_id,)
         cached = self._cache.get(key)
         if cached is None:
-            cached = jax.jit(fn, out_shardings=self._replicated())
+            cached = jax.jit(fn, out_shardings=self._replicated(ctx))
             self._cache[key] = cached
         return cached
 
-    def _unique_rows(self, a: jax.Array) -> jax.Array:
-        """(size, ...) tiled stack -> (num_processes, ...) unique rows."""
-        return a[jnp.asarray(self._lead_slots)]
+    def _unique_rows(self, a: jax.Array, ctx: "_SetCtx") -> jax.Array:
+        """(set_size, ...) tiled stack -> (n_member_procs, ...) unique
+        rows."""
+        return a[jnp.asarray(ctx.lead_slots)]
 
     def _run(self, compiled, *args):
         """Execute a compiled collective, translating runtime comm
@@ -149,8 +197,9 @@ class CollectiveEngine:
         process_set: Optional[ProcessSet] = None,
     ) -> jax.Array:
         """Reference: AllreduceOp::Execute (collective_operations.cc) /
-        NCCLAllreduce (nccl_operations.cc)."""
-        self._check_process_set(process_set)
+        NCCLAllreduce (nccl_operations.cc); per-set scoping mirrors the
+        per-ProcessSet controllers of process_set.cc."""
+        ctx = self._member_ctx(process_set)
         x = jnp.asarray(x)
         if op not in (ReduceOp.AVERAGE, ReduceOp.SUM) and (
             prescale_factor != 1.0 or postscale_factor != 1.0
@@ -158,11 +207,11 @@ class CollectiveEngine:
             raise ValueError(
                 f"prescale/postscale factors are not supported with op={op!r}"
             )
-        if op == ReduceOp.ADASUM and self.multi_process:
+        if op == ReduceOp.ADASUM and ctx.n > 1:
             raise NotImplementedError(
                 "eager Adasum over processes lands with the native controller"
             )
-        if not self.multi_process:
+        if ctx.n == 1:
             if op in (ReduceOp.AVERAGE, ReduceOp.SUM):
                 if prescale_factor != 1.0 or postscale_factor != 1.0:
                     return x * jnp.asarray(
@@ -170,16 +219,16 @@ class CollectiveEngine:
                     )
             return x
         key = ("allreduce", x.shape, str(x.dtype), int(op))
-        n = self.num_contributors
+        n = ctx.n
 
         def fn(a, pre, post):
-            u = self._unique_rows(a)
+            u = self._unique_rows(a, ctx)
             return _reduce_unique(u, op, n, pre, post)
 
-        compiled = self._compile(key, fn)
+        compiled = self._compile(key, fn, ctx)
         g = self._run(
             compiled,
-            self._stacked_global(x),
+            self._stacked_global(x, ctx),
             jnp.asarray(prescale_factor, x.dtype),
             jnp.asarray(postscale_factor, x.dtype),
         )
@@ -189,12 +238,13 @@ class CollectiveEngine:
         self, values: Sequence[int],
         process_set: Optional[ProcessSet] = None,
     ) -> List[List[int]]:
-        """Gather a small per-process int vector from every process — the
-        fallback-path shape negotiation (the native controller ships these
-        extents in its Response instead; reference: the recvcounts /
+        """Gather a small per-process int vector from every member process
+        — the fallback-path shape negotiation (the native controller ships
+        these extents in its Response instead; reference: the recvcounts /
         splits exchange inside MPIAllgather/MPIAlltoall)."""
+        ctx = self._member_ctx(process_set)
         v = jnp.asarray(list(values), jnp.int32)[None]
-        g = self.allgather(v, process_set, recv_dim0s=[1] * self.num_contributors)
+        g = self.allgather(v, process_set, recv_dim0s=[1] * ctx.n)
         return np.asarray(g).astype(int).tolist()
 
     def allgather(
@@ -206,11 +256,11 @@ class CollectiveEngine:
         recvcounts path).  ``recv_dim0s`` is the negotiated per-process
         dim0 list — supplied by the native controller's response, or
         self-negotiated with a one-int exchange on the fallback path."""
-        self._check_process_set(process_set)
+        ctx = self._member_ctx(process_set)
         x = jnp.asarray(x)
-        if not self.multi_process:
+        if ctx.n == 1:
             return x
-        n = self.num_contributors
+        n = ctx.n
         if recv_dim0s is None:
             if x.ndim == 0:
                 counts = None  # scalars gather to (n,): trivially even
@@ -228,12 +278,12 @@ class CollectiveEngine:
             key = ("allgather", x.shape, str(x.dtype))
 
             def fn(a):
-                u = self._unique_rows(a)  # (P, d0, ...)
+                u = self._unique_rows(a, ctx)  # (P, d0, ...)
                 return u.reshape((-1,) + u.shape[2:])
 
-            compiled = self._compile(key, fn)
+            compiled = self._compile(key, fn, ctx)
             return self._local_view(
-                self._run(compiled, self._stacked_global(x))
+                self._run(compiled, self._stacked_global(x, ctx))
             )
         # uneven first dims: pad to the max, gather, statically re-slice
         if x.ndim == 0:
@@ -246,16 +296,16 @@ class CollectiveEngine:
         key = ("allgather_uneven", xp.shape, str(x.dtype), tuple(counts))
 
         def fn_uneven(a):
-            u = self._unique_rows(a)  # (P, maxd, ...)
+            u = self._unique_rows(a, ctx)  # (P, maxd, ...)
             parts = [
                 jax.lax.slice_in_dim(u[p], 0, counts[p], axis=0)
                 for p in range(n)
             ]
             return jnp.concatenate(parts, axis=0)
 
-        compiled = self._compile(key, fn_uneven)
+        compiled = self._compile(key, fn_uneven, ctx)
         return self._local_view(
-            self._run(compiled, self._stacked_global(xp))
+            self._run(compiled, self._stacked_global(xp, ctx))
         )
 
     def broadcast(
@@ -265,19 +315,22 @@ class CollectiveEngine:
         process_set: Optional[ProcessSet] = None,
     ) -> jax.Array:
         """Reference: BroadcastOp / NCCLBroadcast.  ``root_rank`` is a world
-        (chip) rank; the owning process's contribution wins."""
-        self._check_process_set(process_set)
+        (chip) rank that must belong to the set; the owning process's
+        contribution wins."""
+        ctx = self._member_ctx(process_set)
         x = jnp.asarray(x)
-        root_slot = self._root_slot(root_rank)
-        if not self.multi_process:
+        root_slot = self._root_slot(root_rank, ctx)
+        if ctx.n == 1:
             return x
         key = ("broadcast", x.shape, str(x.dtype), root_slot)
 
         def fn(a):
             return a[root_slot]
 
-        compiled = self._compile(key, fn)
-        return self._local_view(self._run(compiled, self._stacked_global(x)))
+        compiled = self._compile(key, fn, ctx)
+        return self._local_view(
+            self._run(compiled, self._stacked_global(x, ctx))
+        )
 
     def alltoall(
         self,
@@ -292,9 +345,9 @@ class CollectiveEngine:
         n_processes) send matrix — row r is what process r sends each peer
         — supplied by the native controller's response, or self-negotiated
         on the fallback path."""
-        self._check_process_set(process_set)
+        ctx = self._member_ctx(process_set)
         x = jnp.asarray(x)
-        n = self.num_contributors
+        n = ctx.n
         dim0 = x.shape[0] if x.ndim else 0
         if splits is not None:
             splits = np.asarray(splits, dtype=np.int64)
@@ -305,7 +358,7 @@ class CollectiveEngine:
                     f"splits must be shape ({n},) of non-negative counts "
                     "summing to dim0 of the input"
                 )
-        if not self.multi_process:
+        if ctx.n == 1:
             recv_splits = (
                 jnp.asarray(splits, jnp.int32)
                 if splits is not None
@@ -314,7 +367,7 @@ class CollectiveEngine:
             return x, recv_splits
         if x.ndim == 0:
             raise ValueError("alltoall requires ndim >= 1")
-        me = self.topology.process_index
+        me = ctx.me
         if all_splits is None:
             if splits is None and dim0 % n != 0:
                 raise ValueError(
@@ -336,13 +389,13 @@ class CollectiveEngine:
             key = ("alltoall", x.shape, str(x.dtype), me)
 
             def fn(a):
-                u = self._unique_rows(a)  # (P, d0, ...)
+                u = self._unique_rows(a, ctx)  # (P, d0, ...)
                 c = u.reshape((n, n, chunk) + u.shape[2:])
                 return c[:, me].reshape((-1,) + u.shape[2:])
 
-            compiled = self._compile(key, fn)
+            compiled = self._compile(key, fn, ctx)
             out = self._local_view(
-                self._run(compiled, self._stacked_global(x))
+                self._run(compiled, self._stacked_global(x, ctx))
             )
             return out, jnp.full((n,), chunk, dtype=jnp.int32)
         # general splits: pad every contribution to the max total rows,
@@ -363,7 +416,7 @@ class CollectiveEngine:
         )
 
         def fn_splits(a):
-            u = self._unique_rows(a)  # (P, maxd, ...)
+            u = self._unique_rows(a, ctx)  # (P, maxd, ...)
             parts = []
             for p in range(n):
                 off = sum(all_splits[p][:me])
@@ -374,8 +427,10 @@ class CollectiveEngine:
                 )
             return jnp.concatenate(parts, axis=0)
 
-        compiled = self._compile(key, fn_splits)
-        out = self._local_view(self._run(compiled, self._stacked_global(xp)))
+        compiled = self._compile(key, fn_splits, ctx)
+        out = self._local_view(
+            self._run(compiled, self._stacked_global(xp, ctx))
+        )
         return out, jnp.asarray(recv_counts, jnp.int32)
 
     def reducescatter(
@@ -386,50 +441,72 @@ class CollectiveEngine:
     ) -> jax.Array:
         """Reference: ReducescatterOp / NCCLReducescatter — reduce then
         scatter dim-0 chunks; this process keeps its own chunk."""
-        self._check_process_set(process_set)
+        ctx = self._member_ctx(process_set)
         x = jnp.asarray(x)
-        if not self.multi_process:
+        if ctx.n == 1:
             return x
-        n = self.num_contributors
+        n = ctx.n
         if x.shape[0] % n != 0:
             raise ValueError(
                 f"reducescatter dim0 ({x.shape[0]}) must divide evenly by {n}"
             )
-        me = self.topology.process_index
+        me = ctx.me
         key = ("reducescatter", x.shape, str(x.dtype), int(op), me)
         chunk = x.shape[0] // n
         one = jnp.asarray(1.0, x.dtype)
 
         def fn(a):
-            u = self._unique_rows(a)
+            u = self._unique_rows(a, ctx)
             r = _reduce_unique(u, op, n, one, one)
             return jax.lax.dynamic_slice_in_dim(r, me * chunk, chunk, axis=0)
 
-        compiled = self._compile(key, fn)
-        return self._local_view(self._run(compiled, self._stacked_global(x)))
+        compiled = self._compile(key, fn, ctx)
+        return self._local_view(
+            self._run(compiled, self._stacked_global(x, ctx))
+        )
 
     def barrier(self, process_set: Optional[ProcessSet] = None) -> None:
         """Reference: BarrierOp (collective_operations.cc)."""
-        self._check_process_set(process_set)
-        if not self.multi_process:
+        ctx = self._member_ctx(process_set)
+        if ctx.n == 1:
             return
         token = jnp.zeros((), jnp.int32)
-        jax.block_until_ready(self.allreduce(token, ReduceOp.SUM))
+        jax.block_until_ready(
+            self.allreduce(token, ReduceOp.SUM, process_set=process_set)
+        )
 
     # -- helpers ------------------------------------------------------------
 
-    def _root_slot(self, root_rank: int) -> int:
+    def _root_slot(self, root_rank: int, ctx: "_SetCtx" = None) -> int:
+        """Slot of the world chip ``root_rank`` inside the set's device
+        order; validates range and set membership."""
         if not 0 <= root_rank < self.topology.size:
             raise ValueError(
                 f"root_rank {root_rank} out of range [0, {self.topology.size})"
             )
-        return root_rank
-
-    def _check_process_set(self, process_set: Optional[ProcessSet]) -> None:
-        ps = process_set if process_set is not None else global_process_set
-        if ps.process_set_id not in (0, None) and self.multi_process:
-            raise NotImplementedError(
-                "eager process-set collectives across processes land with "
-                "the native controller; in-jit process sets work today via "
-                "ops.spmd_ops over the set's sub-mesh"
+        if ctx is None:
+            ctx = self._world_ctx
+        dev = self.topology.devices[root_rank]
+        try:
+            return ctx.devices.index(dev)
+        except ValueError:
+            raise ValueError(
+                f"root_rank {root_rank} is not a member of process set "
+                f"{ctx.set_id}"
             )
+
+    def _member_ctx(self, process_set: Optional[ProcessSet]) -> "_SetCtx":
+        """Resolve the set's execution context; a non-member process must
+        not call (reference: ProcessSets reject collectives from ranks
+        outside the set)."""
+        ctx = self._ctx(
+            process_set if process_set is not None else global_process_set
+        )
+        if ctx.me is None:
+            from ..common.exceptions import ProcessSetError
+
+            raise ProcessSetError(
+                f"process {self.topology.process_index} is not a member of "
+                f"process set {ctx.set_id}"
+            )
+        return ctx

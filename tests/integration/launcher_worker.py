@@ -134,6 +134,37 @@ def main():
         np.asarray(new_params["w"]), -np.full(4, np.mean(np.arange(nproc)))
     )
 
+    # join(): ragged per-rank batch counts (reference: JoinOp).  Rank r
+    # runs r+1 allreduce steps; finished ranks join and keep contributing
+    # zeros, so step i sums 1 from every rank still running (nproc - i).
+    if hvd.native_built() and nproc > 1:
+        got = []
+        for i in range(me + 1):
+            out = hvd.allreduce(
+                jnp.asarray(1.0), name=f"ragged_{i}", op=hvd.Sum
+            )
+            got.append(float(out))
+        last = hvd.join()
+        assert got == [float(nproc - i) for i in range(me + 1)], got
+        assert last == nproc - 1, f"last joining rank {last}"
+
+    # eager cross-process process-set collectives: a sub-world of the
+    # first two processes (reference: process_set= scoped collectives)
+    if nproc >= 3:
+        ps = hvd.add_process_set([0, 1])
+        if me in (0, 1):
+            out = hvd.allreduce(
+                jnp.asarray([float(me + 1)]), op=hvd.Sum,
+                name="subset_ar", process_set=ps,
+            )
+            np.testing.assert_allclose(np.asarray(out), [3.0])
+            sub = hvd.allgather(
+                jnp.asarray([[float(me)]]), name="subset_ag",
+                process_set=ps,
+            )
+            np.testing.assert_allclose(np.asarray(sub), [[0.0], [1.0]])
+        hvd.remove_process_set(ps)
+
     # ResponseCache bit-vector steady state across processes: repeats of
     # the same signature negotiate as cache positions (payload shrinks to
     # O(positions)) and still reduce correctly on every rank

@@ -98,11 +98,13 @@ def test_tpurun_failure_propagates():
     assert res.returncode == 3
 
 
-def test_tpurun_multiprocess_native_controller():
+@pytest.mark.parametrize("np_", [2, 3])
+def test_tpurun_multiprocess_native_controller(np_):
     """Same per-rank assertions with the C++ controller negotiating over
     its TCP star (reference analog: the gloo-controller path of
-    test_static_run)."""
-    res = _run_tpurun(2)
+    test_static_run).  np=3 additionally exercises eager cross-process
+    process-set collectives and ragged join fills."""
+    res = _run_tpurun(np_)
     assert res.returncode == 0, f"stdout:\n{res.stdout}\nstderr:\n{res.stderr}"
-    assert res.stdout.count("WORKER_OK") == 2
+    assert res.stdout.count("WORKER_OK") == np_
     assert "native=True" in res.stdout
