@@ -27,6 +27,7 @@ from .common.basics import (
     shutdown,
     is_initialized,
     rank,
+    local_process_count,
     local_rank,
     size,
     local_size,

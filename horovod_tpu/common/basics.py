@@ -232,10 +232,20 @@ def local_size() -> int:
 
 
 def local_rank() -> int:
-    """Index of this process among processes on the same host
-    (reference: horovod_local_rank).  One process per host on TPU pods, so
-    this is almost always 0; kept for API parity."""
-    return 0
+    """Index of this process among processes on the same host (reference:
+    horovod_local_rank).  The launcher exports HVD_TPU_LOCAL_RANK (the
+    per-host slot, like HOROVOD_LOCAL_RANK from horovodrun); without a
+    launcher the TPU-pod layout is one process per host, so 0."""
+    env = os.environ.get("HVD_TPU_LOCAL_RANK")
+    return int(env) if env is not None else 0
+
+
+def local_process_count() -> int:
+    """Processes launched on this host (reference: the process count behind
+    horovod_local_size when several workers share a host; distinct from
+    :func:`local_size`, which counts this process's chips)."""
+    env = os.environ.get("HVD_TPU_LOCAL_SIZE")
+    return int(env) if env is not None else 1
 
 
 def cross_size() -> int:

@@ -40,18 +40,51 @@ def _adasum_pair(v: jax.Array, pv: jax.Array) -> jax.Array:
     return ca * v + cb * pv
 
 
+def _next_pow2(n: int) -> int:
+    m = 1
+    while m < n:
+        m <<= 1
+    return m
+
+
+def adasum_combine_rows(u: jax.Array) -> jax.Array:
+    """Adasum-combine the rows of a (n, d) stack into one (d,) vector,
+    with the SAME fold-then-hypercube pairing as :func:`adasum_allreduce`
+    (adasum is not associative, so the eager and in-jit paths must pair
+    identically to agree numerically).  Used by the eager engine, where
+    all contributions are rows of one stacked array inside one program.
+    """
+    n = int(u.shape[0])
+    if n == 1:
+        return u[0]
+    m = _next_pow2(n)
+    if m > n:
+        m //= 2  # largest power of two <= n
+    excess = n - m
+    pair = jax.vmap(_adasum_pair)
+    if excess:
+        # fold: row m+i absorbs into row i (reference odd-rank fold)
+        folded = pair(u[:excess], u[m:m + excess])
+        u = jnp.concatenate([folded, u[excess:m]])
+    else:
+        u = u[:m]
+    step = 1
+    while step < m:
+        u = pair(u, u[jnp.arange(m) ^ step])
+        step <<= 1
+    return u[0]
+
+
 def adasum_allreduce(tensor: Any, axis: str = WORLD_AXIS) -> Any:
     """Adasum-allreduce a pytree across the mesh axis (inside shard_map).
 
     The pytree is flattened into one vector so the dot products span the
     whole gradient, matching the reference's whole-buffer semantics for a
-    fused entry set.  Axis size must be a power of two (the reference's
-    recursive-halving has the same requirement and pads ranks otherwise —
-    we raise instead and document the restriction).
+    fused entry set.  Non-power-of-two axes fold the excess ranks into the
+    low hypercube first and broadcast back after (reference:
+    adasum_mpi.cc's odd-rank fold), so any axis size works.
     """
     n = jax.lax.axis_size(axis)
-    if n & (n - 1):
-        raise ValueError(f"Adasum requires a power-of-two axis size, got {n}")
     leaves, treedef = jax.tree_util.tree_flatten(tensor)
     if not leaves:
         return tensor
@@ -60,12 +93,30 @@ def adasum_allreduce(tensor: Any, axis: str = WORLD_AXIS) -> Any:
     dtype = leaves[0].dtype
     vec = jnp.concatenate([jnp.ravel(l).astype(dtype) for l in leaves])
 
-    step = 1
-    while step < n:
-        perm = [(i, i ^ step) for i in range(n)]
+    m = _next_pow2(n)
+    if m > n:
+        m //= 2  # largest power of two <= n
+    excess = n - m
+    idx = jax.lax.axis_index(axis)
+    if excess:
+        # fold: rank m+i sends to rank i, which absorbs it pairwise; a
+        # rank that receives nothing gets zeros = identity partner
+        perm = [(m + i, i) for i in range(excess)]
         pvec = jax.lax.ppermute(vec, axis, perm=perm)
-        vec = _adasum_pair(vec, pvec)
+        vec = jnp.where(idx < m, _adasum_pair(vec, pvec), vec)
+
+    step = 1
+    while step < m:
+        perm = [(i, i ^ step) for i in range(m)]
+        pvec = jax.lax.ppermute(vec, axis, perm=perm)
+        vec = jnp.where(idx < m, _adasum_pair(vec, pvec), vec)
         step <<= 1
+
+    if excess:
+        # unfold: broadcast the combined vector back to the folded ranks
+        perm = [(i, m + i) for i in range(excess)]
+        pvec = jax.lax.ppermute(vec, axis, perm=perm)
+        vec = jnp.where(idx >= m, pvec, vec)
 
     out, offset = [], 0
     for sz, shape in zip(sizes, shapes):

@@ -208,8 +208,24 @@ class CollectiveEngine:
                 f"prescale/postscale factors are not supported with op={op!r}"
             )
         if op == ReduceOp.ADASUM and ctx.n > 1:
-            raise NotImplementedError(
-                "eager Adasum over processes lands with the native controller"
+            # all contributions are rows of the stacked global, so the
+            # pairwise hypercube runs inside ONE compiled program — the
+            # TPU-native shape of adasum_mpi_operations.cc's send/recv
+            # rounds; pairing matches ops/adasum.py (fold + XOR hypercube)
+            from .adasum import adasum_combine_rows
+
+            key = ("adasum", x.shape, str(x.dtype))
+            out_shape = x.shape  # don't capture x: the jit cache would
+            # pin the first input's device buffer for the engine lifetime
+
+            def fn_adasum(a):
+                u = self._unique_rows(a, ctx)
+                out = adasum_combine_rows(u.reshape((u.shape[0], -1)))
+                return out.reshape(out_shape)
+
+            compiled = self._compile(key, fn_adasum, ctx)
+            return self._local_view(
+                self._run(compiled, self._stacked_global(x, ctx))
             )
         if ctx.n == 1:
             if op in (ReduceOp.AVERAGE, ReduceOp.SUM):

@@ -30,7 +30,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..common import basics
 from ..common.process_sets import ProcessSet
-from ..common.topology import WORLD_AXIS
+from ..common.topology import DCN_AXIS, ICI_AXIS, WORLD_AXIS
 from .reduce_ops import Average, ReduceOp, Sum
 
 
@@ -102,6 +102,69 @@ def allreduce(
 
         return adasum_allreduce(tensor, axis)
     raise ValueError(f"unknown reduce op {op!r}")
+
+
+def hierarchical_allreduce(
+    tensor: Any,
+    average: Optional[bool] = None,
+    op: Optional[ReduceOp] = None,
+    ici_axis: str = ICI_AXIS,
+    dcn_axis: str = DCN_AXIS,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+) -> Any:
+    """Two-level allreduce over a 2-D ``(dcn, ici)`` mesh
+    (``topology.hierarchical_mesh()``): intra-slice ICI reduce-scatter →
+    inter-slice DCN allreduce of the 1/n_ici-sized shard → ICI allgather.
+
+    Reference: NCCLHierarchicalAllreduce (nccl_operations.cc,
+    HOROVOD_HIERARCHICAL_ALLREDUCE) — intra-node NCCL reduce-scatter/
+    allgather around an inter-node MPI allreduce.  The payoff is the same
+    on TPU: each byte crosses the slow inter-group fabric once per
+    ``n_ici`` chips instead of once per chip.
+
+    Numerically identical to a flat ``psum`` over both axes (modulo
+    floating-point association order).  Sum/Average only, like the
+    reference op.
+    """
+    if op is not None and average is not None:
+        raise ValueError("specify either op or average, not both")
+    if op is None:
+        op = Average if (average is None or average) else Sum
+    if op not in (ReduceOp.AVERAGE, ReduceOp.SUM):
+        raise ValueError(
+            f"hierarchical_allreduce supports Sum/Average, got {op!r}"
+        )
+    n_ici = jax.lax.axis_size(ici_axis)
+    n_total = n_ici * jax.lax.axis_size(dcn_axis)
+
+    def hier_leaf(t):
+        t = jnp.asarray(t)
+        flat = t.reshape(-1)
+        pad = (-flat.size) % n_ici
+        if pad:
+            flat = jnp.concatenate(
+                [flat, jnp.zeros((pad,), flat.dtype)]
+            )
+        # ICI reduce-scatter: each chip owns 1/n_ici of the slice sum
+        piece = jax.lax.psum_scatter(
+            flat, ici_axis, scatter_dimension=0, tiled=True
+        )
+        # DCN allreduce of the shard (the only inter-group traffic)
+        piece = jax.lax.psum(piece, dcn_axis)
+        # ICI allgather reassembles the full reduced tensor
+        full = jax.lax.all_gather(piece, ici_axis, tiled=True)
+        if pad:
+            full = full[: t.size]
+        return full.reshape(t.shape)
+
+    x = _scale(tensor, prescale_factor)
+    red = jax.tree_util.tree_map(hier_leaf, x)
+    if op == ReduceOp.AVERAGE:
+        red = jax.tree_util.tree_map(
+            lambda t: t / jnp.asarray(n_total, t.dtype), red
+        )
+    return _scale(red, postscale_factor)
 
 
 def allgather(tensor: Any, axis: str = WORLD_AXIS) -> Any:

@@ -30,7 +30,7 @@ import optax
 
 from .common import basics
 from .common.process_sets import ProcessSet
-from .common.topology import WORLD_AXIS
+from .common.topology import DCN_AXIS, ICI_AXIS, WORLD_AXIS
 from .ops import collective_ops, spmd_ops
 from .ops.reduce_ops import Average, ReduceOp
 
@@ -57,9 +57,35 @@ def allreduce_gradients(
     prescale_factor: float = 1.0,
     postscale_factor: float = 1.0,
     process_set: Optional[ProcessSet] = None,
+    hierarchical: Optional[bool] = None,
+    ici_axis: str = ICI_AXIS,
+    dcn_axis: str = DCN_AXIS,
 ) -> Any:
     """Average a gradient pytree across workers, picking the SPMD or eager
-    path automatically.  Reference: the allreduce step of §3.2."""
+    path automatically.  Reference: the allreduce step of §3.2.
+
+    ``hierarchical`` selects the two-level ICI×DCN reduction (reference:
+    HOROVOD_HIERARCHICAL_ALLREDUCE / NCCLHierarchicalAllreduce); it
+    defaults to the env flag and requires tracing over a
+    ``hierarchical_mesh()``'s (dcn, ici) axes — in a flat or eager context
+    it falls back to the flat reduction (numerically identical).
+    """
+    if hierarchical is None:
+        st = basics._state
+        hierarchical = bool(
+            st.config is not None and st.config.hierarchical_allreduce
+        )
+    if (
+        hierarchical
+        and op in (ReduceOp.SUM, ReduceOp.AVERAGE)
+        and _in_spmd_context(ici_axis)
+        and _in_spmd_context(dcn_axis)
+    ):
+        return spmd_ops.hierarchical_allreduce(
+            grads, op=op, ici_axis=ici_axis, dcn_axis=dcn_axis,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor,
+        )
     if _in_spmd_context(axis):
         return spmd_ops.allreduce(
             grads, op=op, axis=axis,
@@ -83,6 +109,9 @@ def DistributedOptimizer(
     process_set: Optional[ProcessSet] = None,
     backward_passes_per_step: int = 1,
     compression=None,
+    hierarchical: Optional[bool] = None,
+    ici_axis: str = ICI_AXIS,
+    dcn_axis: str = DCN_AXIS,
 ) -> optax.GradientTransformation:
     """Wrap an optax optimizer so updates see globally reduced gradients.
 
@@ -90,7 +119,9 @@ def DistributedOptimizer(
     contract (wraps an existing optimizer, averages grads across workers,
     supports op=Sum/Average/Adasum, pre/postscale, process sets, fp16/bf16
     ``compression`` on the wire, and local aggregation), expressed as an
-    optax gradient transformation.
+    optax gradient transformation.  ``hierarchical=True`` (or the
+    HVD_TPU_HIERARCHICAL_ALLREDUCE env flag) selects the two-level
+    ICI×DCN reduction when stepping inside a ``hierarchical_mesh()``.
     """
     def _reduce(updates, params=None):
         if compression is not None:
@@ -100,6 +131,8 @@ def DistributedOptimizer(
             prescale_factor=prescale_factor,
             postscale_factor=postscale_factor,
             process_set=process_set,
+            hierarchical=hierarchical,
+            ici_axis=ici_axis, dcn_axis=dcn_axis,
         )
         if compression is not None:
             updates = compression.decompress(updates, ctx)

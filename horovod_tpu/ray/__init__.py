@@ -27,7 +27,7 @@ import sys
 import tempfile
 from typing import Any, Callable, List, Optional
 
-__all__ = ["RayExecutor"]
+__all__ = ["RayExecutor", "ElasticRayExecutor"]
 
 
 def _ray_available() -> bool:
@@ -160,4 +160,98 @@ class RayExecutor:
                 with open(os.path.join(tmp, f"result_{rank}.pkl"),
                           "rb") as f:
                     results.append(pickle.load(f))
+            return results
+
+
+class ElasticRayExecutor:
+    """Elastic executor with the RayExecutor API (reference:
+    horovod/ray/elastic.py ElasticRayExecutor).
+
+    Design mapping: the reference drives worker discovery from the Ray
+    autoscaler and respawns actors on membership change.  Here discovery
+    is a callable returning ``[(host, slots), ...]`` fed to the same
+    :class:`~horovod_tpu.runner.elastic_driver.ElasticDriver` that powers
+    ``tpurun --host-discovery-script`` — workers that die are blacklisted
+    and replaced, survivors recover via the elastic State contract
+    (commit/restore/sync), and ``run()`` returns the per-rank results of
+    the final world.  With ray installed the actor-fleet backend would
+    plug in at ``_spawn`` (placement-group per worker); this image ships
+    no ray, so the subprocess backend is the tested path and the ray
+    backend is EXPERIMENTAL (see README).
+
+    Usage::
+
+        executor = ElasticRayExecutor(min_workers=1, max_workers=4)
+        executor.start()
+        results = executor.run(train_fn)   # train_fn uses hvd.elastic.run
+        executor.shutdown()
+    """
+
+    def __init__(self, settings: Optional[dict] = None,
+                 min_workers: int = 1, max_workers: Optional[int] = None,
+                 env_vars: Optional[dict] = None,
+                 discovery: Optional[Callable] = None):
+        self.settings = settings or {}
+        self.min_workers = min_workers
+        self.max_workers = max_workers or min_workers
+        self.env_vars = dict(env_vars or {})
+        self._discovery_fn = discovery
+        self._started = False
+
+    def start(self) -> None:
+        self._started = True
+
+    def shutdown(self) -> None:
+        self._started = False
+
+    def run(self, fn: Callable, args: Optional[List[Any]] = None,
+            kwargs: Optional[dict] = None) -> List[Any]:
+        if not self._started:
+            raise RuntimeError("call start() before run()")
+        from ..runner.elastic_driver import ElasticDriver, HostDiscovery
+
+        args, kwargs = list(args or []), dict(kwargs or {})
+        discovery_fn = self._discovery_fn or (
+            lambda: [("localhost", self.max_workers)]
+        )
+
+        class _CallableDiscovery(HostDiscovery):
+            def __init__(self):  # no script: discovery is the callable
+                super().__init__(script="", default_slots=1)
+
+            def find_available_hosts(self):
+                return discovery_fn()
+
+        with tempfile.TemporaryDirectory(prefix="hvd_tpu_rayel_") as tmp:
+            payload = os.path.join(tmp, "payload.pkl")
+            with open(payload, "wb") as f:
+                pickle.dump((fn, args, kwargs), f)
+            repo_root = os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))))
+            knob_env = dict(self.env_vars)
+            knob_env["PYTHONPATH"] = (
+                repo_root + os.pathsep + os.environ.get("PYTHONPATH", "")
+            )
+            driver = ElasticDriver(
+                command=[sys.executable, "-m",
+                         "horovod_tpu.ray._elastic_worker", payload, tmp],
+                discovery=_CallableDiscovery(),
+                min_np=self.min_workers,
+                max_np=self.max_workers,
+                knob_env=knob_env,
+            )
+            rc = driver.run()
+            if rc != 0:
+                raise RuntimeError(
+                    f"ElasticRayExecutor job failed with exit code {rc}"
+                )
+            results = []
+            rank = 0
+            while True:
+                path = os.path.join(tmp, f"result_{rank}.pkl")
+                if not os.path.exists(path):
+                    break
+                with open(path, "rb") as f:
+                    results.append(pickle.load(f))
+                rank += 1
             return results

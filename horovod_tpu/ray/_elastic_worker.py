@@ -1,0 +1,31 @@
+"""Local-backend worker entrypoint for ElasticRayExecutor (reference
+analog: the elastic remote function ElasticRayExecutor.run dispatches in
+horovod/ray/elastic.py).  Unlike _worker.py, the rank is only known after
+the elastic rendezvous, so the result file is keyed by the final rank."""
+
+import os
+import pickle
+import sys
+
+
+def main():
+    payload_path, result_dir = sys.argv[1], sys.argv[2]
+    with open(payload_path, "rb") as f:
+        fn, args, kwargs = pickle.load(f)
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    result = fn(*args, **kwargs)
+    rank = hvd.cross_rank()
+    tmp = os.path.join(result_dir, f".result_{rank}.tmp")
+    with open(tmp, "wb") as f:
+        pickle.dump(result, f)
+    os.replace(tmp, os.path.join(result_dir, f"result_{rank}.pkl"))
+    from horovod_tpu.elastic.worker import clean_shutdown
+
+    clean_shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -156,7 +156,8 @@ def _torch_available() -> bool:
 
 def _worker_env(base: Dict[str, str], knob_env: Dict[str, str],
                 coordinator: str, native_port: int, num_proc: int,
-                rank: int, disable_native: bool) -> Dict[str, str]:
+                rank: int, disable_native: bool,
+                local_rank: int = 0, local_size: int = 1) -> Dict[str, str]:
     env = dict(base)
     env.update(knob_env)
     env["HVD_TPU_COORDINATOR"] = coordinator
@@ -165,6 +166,10 @@ def _worker_env(base: Dict[str, str], knob_env: Dict[str, str],
     env["HVD_TPU_NATIVE_PORT"] = str(native_port)
     env["HVD_TPU_NUM_PROCESSES"] = str(num_proc)
     env["HVD_TPU_PROCESS_ID"] = str(rank)
+    # per-host placement (reference: HOROVOD_LOCAL_RANK/LOCAL_SIZE the
+    # launchers export) — hvd.local_rank() reads these
+    env["HVD_TPU_LOCAL_RANK"] = str(local_rank)
+    env["HVD_TPU_LOCAL_SIZE"] = str(local_size)
     if disable_native:
         env["HVD_TPU_DISABLE_NATIVE"] = "1"
     return env
@@ -182,7 +187,8 @@ def _launch_local(command: List[str], num_proc: int,
     try:
         for rank in range(num_proc):
             env = _worker_env(os.environ.copy(), knob_env, coordinator,
-                              native_port, num_proc, rank, disable_native)
+                              native_port, num_proc, rank, disable_native,
+                              local_rank=rank, local_size=num_proc)
             stdout = stderr = None
             if output_filename:
                 f = open(f"{output_filename}.{rank}", "w")
@@ -231,11 +237,11 @@ def _launch_ssh(command: List[str], hosts: List[Tuple[str, int]],
     procs: List[subprocess.Popen] = []
     rank = 0
     for host, slots in hosts:
-        for _ in range(slots):
-            if rank >= num_proc:
-                break
+        used = min(slots, max(num_proc - rank, 0))
+        for local_rank in range(used):
             env = _worker_env({}, knob_env, coordinator, native_port,
-                              num_proc, rank, disable_native)
+                              num_proc, rank, disable_native,
+                              local_rank=local_rank, local_size=used)
             env_prefix = " ".join(
                 f"{k}={subprocess.list2cmdline([v])}" for k, v in env.items()
             )

@@ -1,0 +1,31 @@
+"""NumPy emulation of the Adasum fold + XOR-hypercube pairing shared by
+ops/adasum.py (in-jit) and the eager engine — the single no-golden-files
+oracle both the spmd tests and the launcher worker validate against
+(keeping one copy means a pairing change can't silently desync a test)."""
+
+import numpy as np
+
+
+def host_adasum(vs):
+    def pair(a, b):
+        d = float((a * b).sum())
+        na = float((a * a).sum())
+        nb = float((b * b).sum())
+        ca = 1.0 - d / (2.0 * na) if na > 0 else 1.0
+        cb = 1.0 - d / (2.0 * nb) if nb > 0 else 1.0
+        return ca * a + cb * b
+
+    n = len(vs)
+    m = 1
+    while m * 2 <= n:
+        m *= 2
+    excess = n - m
+    work = [
+        pair(vs[i], vs[m + i]) if i < excess else np.array(vs[i])
+        for i in range(m)
+    ]
+    step = 1
+    while step < m:
+        work = [pair(work[i], work[i ^ step]) for i in range(m)]
+        step <<= 1
+    return work[0]

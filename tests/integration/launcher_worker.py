@@ -7,6 +7,7 @@ the REAL multi-process path: jax.distributed rendezvous + the eager engine
 over a cross-process device mesh.
 """
 
+import os
 import sys
 
 import jax
@@ -118,6 +119,12 @@ def main():
     # object plumbing
     objs = hvd.allgather_object({"rank": hvd.cross_rank()})
     assert [o["rank"] for o in objs] == list(range(nproc))
+
+    # local_rank: all workers share localhost, so the local ranks must be
+    # exactly {0..nproc-1} (reference: horovod_local_rank per-host slots)
+    locals_ = hvd.allgather_object(hvd.local_rank())
+    assert sorted(locals_) == list(range(nproc)), locals_
+    assert hvd.local_process_count() == nproc
     obj = hvd.broadcast_object({"x": 42} if rank == 0 else None, 0)
     assert obj == {"x": 42}
 
@@ -132,6 +139,22 @@ def main():
     new_params = optax.apply_updates(params, updates)
     np.testing.assert_allclose(
         np.asarray(new_params["w"]), -np.full(4, np.mean(np.arange(nproc)))
+    )
+
+    # eager cross-process Adasum (reference: adasum_mpi_operations.cc):
+    # must match the shared fold+hypercube oracle (tests/adasum_oracle.py)
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+    )
+    from tests.adasum_oracle import host_adasum
+
+    vs = [np.arange(1.0, 5.0, dtype=np.float32) + p * p for p in range(nproc)]
+    out = hvd.allreduce(
+        jnp.asarray(vs[hvd.cross_rank()]), op=hvd.Adasum, name="adasum_probe"
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), host_adasum(vs), rtol=1e-5
     )
 
     # join(): ragged per-rank batch counts (reference: JoinOp).  Rank r

@@ -34,6 +34,28 @@ def test_ray_executor_local_backend(monkeypatch):
     assert all(r["arg"] == 7 for r in results)
 
 
+@pytest.mark.integration
+def test_elastic_ray_executor_local_backend(monkeypatch):
+    """ElasticRayExecutor contract on the subprocess backend: callable
+    discovery feeds the same ElasticDriver as tpurun --host-discovery-
+    script; per-rank results of the final world come back in rank order
+    (reference: horovod/ray/elastic.py ElasticRayExecutor)."""
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "")
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.delenv("XLA_FLAGS", raising=False)
+    executor = hvd_ray.ElasticRayExecutor(
+        min_workers=2, max_workers=2,
+        discovery=lambda: [("localhost", 2)],
+    )
+    executor.start()
+    results = executor.run(rank_report, args=[3])
+    executor.shutdown()
+    assert len(results) == 2
+    assert [r["rank"] for r in results] == [0, 1]
+    assert all(r["world"] == 2 for r in results)
+    assert all(abs(r["allreduce_sum"] - 2.0) < 1e-6 for r in results)
+
+
 def test_ray_executor_requires_start():
     executor = hvd_ray.RayExecutor(num_workers=1)
     with pytest.raises(RuntimeError):

@@ -232,6 +232,37 @@ def test_adasum_orthogonal_sums():
     np.testing.assert_allclose(np.asarray(out[0]), np.ones(N), rtol=1e-5)
 
 
+from tests.adasum_oracle import host_adasum  # noqa: E402
+
+
+@pytest.mark.parametrize("set_size", [6, 5])
+def test_adasum_non_power_of_two_axis(set_size):
+    """Non-pow2 axes fold the excess ranks first (reference:
+    adasum_mpi.cc odd-rank handling) — every rank must hold the same
+    combination, matching the host emulation."""
+    ps = hvd.add_process_set(list(range(set_size)))
+    try:
+        out = hvd.run_per_rank(
+            lambda r: hvd.spmd.allreduce(
+                per_rank_tensor(r, (4,), jnp.float32), op=hvd.Adasum
+            ),
+            process_set=ps,
+        )
+        vs = [
+            np.asarray(per_rank_tensor(jnp.asarray(i), (4,), jnp.float32),
+                       dtype=np.float32).ravel()
+            for i in range(set_size)
+        ]
+        expected = host_adasum(vs).reshape(4)
+        for i in range(set_size):
+            np.testing.assert_allclose(
+                np.asarray(out[i]), expected, rtol=1e-5,
+                err_msg=f"rank {i}",
+            )
+    finally:
+        hvd.remove_process_set(ps)
+
+
 def test_barrier_traces():
     out = hvd.run_per_rank(
         lambda r: (hvd.spmd.barrier(), jnp.asarray(1))[1]
@@ -252,6 +283,79 @@ def test_process_set_submesh_collective():
         np.testing.assert_allclose(np.asarray(out[0]), [4.0])
     finally:
         hvd.remove_process_set(ps)
+
+
+@pytest.mark.parametrize("shape", [(4,), (5,), (3, 5), ()])
+@pytest.mark.parametrize("op", [hvd.Sum, hvd.Average])
+def test_hierarchical_allreduce_matches_flat_psum(shape, op):
+    """ICI reduce-scatter -> DCN allreduce -> ICI allgather over the 2x4
+    hierarchical mesh must equal the flat psum over both axes (reference:
+    NCCLHierarchicalAllreduce vs NCCLAllreduce parity).  Odd shapes
+    exercise the pad/unpad path (5 elements over 4 ICI chips)."""
+    from jax.sharding import PartitionSpec as P
+    from horovod_tpu.common.topology import DCN_AXIS, ICI_AXIS
+
+    mesh = hvd.hierarchical_mesh(num_groups=2)  # (2, 4) over the 8 chips
+
+    def body(r):
+        x = per_rank_tensor(r[0][0], shape, jnp.float32)
+        h = hvd.spmd.hierarchical_allreduce(x, op=op)
+        flat = jax.lax.psum(x, (DCN_AXIS, ICI_AXIS))
+        if op == hvd.Average:
+            flat = flat / 8.0
+        return h[None, None], flat[None, None]
+
+    h, flat = jax.jit(
+        jax.shard_map(
+            body, mesh=mesh,
+            in_specs=P(DCN_AXIS, ICI_AXIS),
+            out_specs=(P(DCN_AXIS, ICI_AXIS), P(DCN_AXIS, ICI_AXIS)),
+            check_vma=False,
+        )
+    )(jnp.arange(8, dtype=jnp.int32).reshape(2, 4))
+    np.testing.assert_allclose(
+        np.asarray(h), np.asarray(flat), rtol=1e-6
+    )
+    expected = host_stack(shape, jnp.float32).sum(axis=0)
+    if op == hvd.Average:
+        expected = expected / 8.0
+    np.testing.assert_allclose(
+        np.asarray(h[0, 0]), expected, rtol=1e-5
+    )
+
+
+def test_hierarchical_allreduce_from_distributed_optimizer():
+    """hierarchical=True routes DistributedOptimizer's gradient reduce
+    through the two-level op when stepping inside a hierarchical mesh."""
+    import optax
+    from jax.sharding import PartitionSpec as P
+    from horovod_tpu.common.topology import DCN_AXIS, ICI_AXIS
+
+    mesh = hvd.hierarchical_mesh(num_groups=2)
+    opt = hvd.DistributedOptimizer(optax.sgd(1.0), hierarchical=True)
+    params = {"w": jnp.zeros((6,))}
+
+    def step(r):
+        grads = {"w": per_rank_tensor(r[0][0], (6,), jnp.float32)}
+        state = opt.init(params)
+        updates, _ = opt.update(grads, state, params)
+        new = optax.apply_updates(params, updates)
+        return jax.tree_util.tree_map(lambda t: t[None, None], new)
+
+    out = jax.jit(
+        jax.shard_map(
+            step, mesh=mesh,
+            in_specs=P(DCN_AXIS, ICI_AXIS),
+            out_specs=P(DCN_AXIS, ICI_AXIS),
+            check_vma=False,
+        )
+    )(jnp.arange(8, dtype=jnp.int32).reshape(2, 4))
+    expected = -host_stack((6,), jnp.float32).mean(axis=0)
+    for i in range(2):
+        for j in range(4):
+            np.testing.assert_allclose(
+                np.asarray(out["w"][i, j]), expected, rtol=1e-5
+            )
 
 
 def test_spmd_prescale_rejected_for_min():
