@@ -179,6 +179,9 @@ def apply_assignment(msg: dict) -> None:
     os.environ["HVD_TPU_NUM_PROCESSES"] = str(msg["num_processes"])
     os.environ["HVD_TPU_PROCESS_ID"] = str(msg["rank"])
     os.environ["HVD_TPU_NATIVE_PORT"] = str(msg["native_port"])
+    if "local_rank" in msg:
+        os.environ["HVD_TPU_LOCAL_RANK"] = str(msg["local_rank"])
+        os.environ["HVD_TPU_LOCAL_SIZE"] = str(msg["local_size"])
 
 
 def ensure_assignment() -> None:

@@ -82,13 +82,14 @@ class Future:
 
 
 class _Entry:
-    __slots__ = ("payload", "future", "op", "extra")
+    __slots__ = ("payload", "future", "op", "extra", "name")
 
-    def __init__(self, payload, future, op, extra=None):
+    def __init__(self, payload, future, op, extra=None, name=None):
         self.payload = payload
         self.future = future
         self.op = op
         self.extra = extra
+        self.name = name  # set for locally submitted entries (timeline)
 
 
 class NativeController:
@@ -97,6 +98,7 @@ class NativeController:
     def __init__(self, lib_path: str, topology: Topology, config: Config):
         self._topology = topology
         self._config = config
+        self._timeline_active = bool(config.timeline_filename)
         self._engine = None  # set via set_engine after engine construction
         self._entries: Dict[int, _Entry] = {}
         self._entries_lock = threading.Lock()
@@ -283,7 +285,9 @@ class NativeController:
         # execute the entry before control returns from the ctypes call.
         entry_id = counter
         with self._entries_lock:
-            self._entries[entry_id] = _Entry(arr, fut, op_type, extra)
+            self._entries[entry_id] = _Entry(
+                arr, fut, op_type, extra, name=name
+            )
         # reduce_op rides in the root_rank field for allreduce (the C core
         # treats both as opaque fuse keys); keep them separate fields here.
         if splits is not None:
@@ -381,6 +385,11 @@ class NativeController:
                 for e in entries:
                     if e.future is not None:
                         e.future.set_error(exc)
+                    if self._timeline_active and e.name:
+                        # close the XLA_COMM span C++ opened — the
+                        # success path ends it in resolve(), which this
+                        # entry never reached
+                        self.timeline_activity(e.name, "XLA_COMM", False)
             except Exception:
                 pass
 
@@ -414,8 +423,16 @@ class NativeController:
         eng = self._engine
 
         def resolve(e, value):
-            if e.future is not None:  # None = synthesized zero (post-join)
-                e.future.set_result(value)
+            if e.future is None:  # synthesized zero contribution (post-join)
+                return
+            if self._timeline_active and e.name:
+                # end XLA_COMM when the data is actually ready, not at
+                # async dispatch — tracing trades a bg-thread block for
+                # span accuracy (reference: the op-completion events the
+                # GPU completion-queue thread timestamps)
+                jax.block_until_ready(value)
+                self.timeline_activity(e.name, "XLA_COMM", False)
+            e.future.set_result(value)
 
         # resolve the response's process set so the engine applies its own
         # scoping rules (world = None fast path)
@@ -435,24 +452,45 @@ class NativeController:
                 resolve(e, int(root_or_rop))
         elif op == OP_ALLREDUCE:
             # fused execution: one flat buffer, one collective (the native
-            # fusion decision made by the controller)
-            arrays = [e.payload for e in entries]
-            sizes = [a.size for a in arrays]
+            # fusion decision made by the controller).  The buffer is
+            # padded to the next power of two: fusion buckets form by
+            # arrival timing, so raw bucket sizes vary run to run and
+            # each new size would compile a fresh executable (measured:
+            # 225 ms mean burst-64 latency from recompile churn, PERF.md).
+            # Quantized sizes bound the signature count to log2(max) per
+            # dtype; zero padding is identity-safe for every reduce op
+            # (elementwise ops ignore it, Adasum dots are unchanged by
+            # zero elements) and the pad region is sliced away below.
+            # Fuse/unfuse happen on the HOST with numpy: fusion buckets
+            # form by arrival timing, so their compositions vary cycle to
+            # cycle, and any per-composition XLA program (eager concat /
+            # per-offset slices / a jitted unfuse) recompiles endlessly —
+            # measured 150-1500 ms burst-64 latencies from exactly that
+            # (PERF.md).  Host memcpys are composition-insensitive; only
+            # the collective itself stays compiled, over a buffer padded
+            # to a power of two so its signature count stays bounded
+            # (zero padding is identity-safe for every reduce op,
+            # including Adasum's dot products, and is sliced away below).
+            from ..ops.adasum import _next_pow2
+
+            arrays = [np.asarray(e.payload) for e in entries]
+            sizes = [int(a.size) for a in arrays]
             shapes = [a.shape for a in arrays]
-            buf = (
-                jnp.concatenate([jnp.ravel(a) for a in arrays])
-                if len(arrays) > 1 else jnp.ravel(arrays[0])
-            )
+            total = sum(sizes)
+            padded = _next_pow2(total)
+            buf = np.zeros((padded,), arrays[0].dtype)
+            offset = 0
+            for a in arrays:
+                buf[offset:offset + a.size] = a.ravel()
+                offset += a.size
             out = eng.allreduce(
-                buf, ReduceOp(root_or_rop), prescale, postscale, ps
+                jnp.asarray(buf), ReduceOp(root_or_rop), prescale,
+                postscale, ps,
             )
+            out_np = np.asarray(out)  # one transfer; also a real sync
             offset = 0
             for e, sz, shp in zip(entries, sizes, shapes):
-                resolve(
-                    e,
-                    jax.lax.dynamic_slice_in_dim(out, offset, sz)
-                    .reshape(shp),
-                )
+                resolve(e, out_np[offset:offset + sz].reshape(shp))
                 offset += sz
         elif op == OP_ALLGATHER:
             # negotiated recvcounts: per-member dim0 from the response

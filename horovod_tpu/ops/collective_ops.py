@@ -48,7 +48,18 @@ class Handle:
 
     def wait(self) -> Any:
         if self._futures is not None:
-            vals = [f.result() for f in self._futures]
+            # the native fused path resolves with host (numpy) views of
+            # the fusion buffer; convert on the caller's thread so the
+            # public API keeps returning jax arrays and the copy unpins
+            # the underlying bucket
+            import numpy as _np
+
+            vals = []
+            for f in self._futures:
+                v = f.result()
+                vals.append(
+                    jnp.asarray(v) if isinstance(v, _np.ndarray) else v
+                )
             self._value = self._builder(vals)
             self._futures = None
         leaves = jax.tree_util.tree_leaves(self._value)

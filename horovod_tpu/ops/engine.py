@@ -234,8 +234,66 @@ class CollectiveEngine:
                         prescale_factor * postscale_factor, x.dtype
                     )
             return x
-        key = ("allreduce", x.shape, str(x.dtype), int(op))
         n = ctx.n
+        if x.dtype != jnp.bool_ and op in (
+            ReduceOp.AVERAGE, ReduceOp.SUM, ReduceOp.MIN, ReduceOp.MAX
+        ):  # bool has no psum/fill semantics; row-stack path handles it
+            # REDUCE, don't stack: a masked psum/pmin/pmax under
+            # shard_map is one ICI tree/ring; the row-stack path below
+            # would all-gather every contribution to every chip first
+            # (O(P·tensor) transient — round-2 verdict item 6).  The mask
+            # counts each process's tiled contribution exactly once.
+            key = ("allreduce_psum", x.shape, str(x.dtype), int(op))
+            compiled = self._cache.get(key + (ctx.set_id,))
+            if compiled is None:
+                lead = jnp.asarray(ctx.lead_slots)
+
+                def body(a, pre, post):
+                    a0 = a[0]
+                    idx = jax.lax.axis_index(WORLD_AXIS)
+                    is_lead = jnp.any(idx == lead)
+                    if op in (ReduceOp.AVERAGE, ReduceOp.SUM):
+                        v = jnp.where(is_lead, a0 * pre,
+                                      jnp.zeros_like(a0))
+                        red = jax.lax.psum(v, WORLD_AXIS)
+                        if op == ReduceOp.AVERAGE:
+                            red = red / jnp.asarray(n, red.dtype)
+                        return red * post
+                    if jnp.issubdtype(a0.dtype, jnp.floating):
+                        fill = jnp.asarray(
+                            jnp.inf if op == ReduceOp.MIN else -jnp.inf,
+                            a0.dtype,
+                        )
+                    else:
+                        info = jnp.iinfo(a0.dtype)
+                        fill = jnp.asarray(
+                            info.max if op == ReduceOp.MIN else info.min,
+                            a0.dtype,
+                        )
+                    v = jnp.where(is_lead, a0, jnp.full_like(a0, fill))
+                    return (
+                        jax.lax.pmin(v, WORLD_AXIS)
+                        if op == ReduceOp.MIN
+                        else jax.lax.pmax(v, WORLD_AXIS)
+                    )
+
+                compiled = jax.jit(
+                    jax.shard_map(
+                        body, mesh=ctx.mesh,
+                        in_specs=(P(WORLD_AXIS), P(), P()),
+                        out_specs=P(),
+                        check_vma=False,
+                    )
+                )
+                self._cache[key + (ctx.set_id,)] = compiled
+            g = self._run(
+                compiled,
+                self._stacked_global(x, ctx),
+                jnp.asarray(prescale_factor, x.dtype),
+                jnp.asarray(postscale_factor, x.dtype),
+            )
+            return self._local_view(g)
+        key = ("allreduce", x.shape, str(x.dtype), int(op))
 
         def fn(a, pre, post):
             u = self._unique_rows(a, ctx)

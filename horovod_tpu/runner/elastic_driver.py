@@ -182,6 +182,10 @@ class ElasticDriver:
         wid = self._next_worker_id
         self._next_worker_id += 1
         env = dict(os.environ)
+        # a driver itself launched under tpurun must not leak its own
+        # placement into workers; the rendezvous assignment supplies theirs
+        env.pop("HVD_TPU_LOCAL_RANK", None)
+        env.pop("HVD_TPU_LOCAL_SIZE", None)
         env.update(self.knob_env)
         env[ENV_ELASTIC] = "1"
         env[ENV_DRIVER] = driver_addr
@@ -291,14 +295,24 @@ class ElasticDriver:
                 return False
             coordinator = f"{coord_host}:{ports['coordinator_port']}"
             native_port = ports["native_port"]
+            # per-host placement for hvd.local_rank()/local_process_count()
+            # (reference: the per-host slot numbering horovodrun exports)
+            host_members: Dict[str, List[int]] = {}
+            for wid in members:
+                host_members.setdefault(
+                    self._workers[wid].host, []
+                ).append(wid)
             for rank, wid in enumerate(members):
                 sock = self._pending_rendezvous.pop(wid)
+                peers = host_members[self._workers[wid].host]
                 reply = {
                     "type": "assignment",
                     "rank": rank,
                     "num_processes": len(members),
                     "coordinator": coordinator,
                     "native_port": native_port,
+                    "local_rank": peers.index(wid),
+                    "local_size": len(peers),
                     "epoch": self._epoch,
                 }
                 try:

@@ -118,6 +118,46 @@ def test_native_duplicate_name_rejected():
         hvd.init()
 
 
+def test_native_timeline_comm_span_covers_execution(tmp_path):
+    """XLA_COMM must end when the result data is READY, not when the
+    async dispatch returns (round-2 verdict item 6: dispatch-time spans
+    showed near-zero COMM).  A large reduction's COMM span must cover a
+    meaningful fraction of its measured wall time."""
+    path = str(tmp_path / "timeline_comm.json")
+    hvd.shutdown()
+    os.environ["HVD_TPU_TIMELINE"] = path
+    try:
+        hvd.init()
+        big = jnp.ones((4 << 20,), jnp.float32)  # 16 MB: >> dispatch time
+        t0 = time.perf_counter()
+        out = hvd.allreduce(big, name="comm_span_probe", op=hvd.Sum)
+        import jax
+
+        jax.block_until_ready(out)
+        wall = time.perf_counter() - t0
+        hvd.shutdown()
+    finally:
+        os.environ.pop("HVD_TPU_TIMELINE", None)
+        hvd.init()
+    with open(path) as f:
+        events = json.load(f)
+    spans = {}
+    for e in events:
+        if (
+            e.get("name") == "XLA_COMM"
+            and str(e.get("args", {}).get("tensor", "")).startswith(
+                "comm_span_probe"
+            )
+        ):
+            spans.setdefault(e["ph"], e["ts"])
+    assert "B" in spans and "E" in spans, spans
+    comm_s = (spans["E"] - spans["B"]) / 1e6  # chrome trace ts is in us
+    # the span includes compile on first use, so it can exceed wall-start
+    # measurement; the regression being pinned is span ~= dispatch-only
+    # (tens of microseconds) — demand a real fraction of the wall time
+    assert comm_s >= 0.05 * wall, (comm_s, wall)
+
+
 def test_native_autotune_knobs_readable():
     ctrl = hvd.common.basics._require_init().controller
     assert ctrl.fusion_threshold() > 0
