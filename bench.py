@@ -135,7 +135,12 @@ def worker(mode: str) -> int:
     image_size = 224 if on_tpu else 64
     warmup, iters = (5, 30) if on_tpu else (1, 2)
 
-    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    # space_to_depth stem: mathematically identical to the classic 7x7/s2
+    # stem (equivalence proven by test_space_to_depth_stem_equivalence)
+    # but MXU-friendly — measured ~3.5% faster end-to-end (PERF.md)
+    model = ResNet50(
+        num_classes=1000, dtype=jnp.bfloat16, stem="space_to_depth"
+    )
     rng = jax.random.PRNGKey(0)
     images = jnp.asarray(
         np.random.RandomState(0)

@@ -77,6 +77,26 @@ class ResNetBlock(nn.Module):
         return self.act(residual + y)
 
 
+def space_to_depth_stem(x, features, conv, name="conv_init"):
+    """The MLPerf space-to-depth stem: mathematically identical to the
+    7x7/stride-2 stem conv but MXU-friendly.
+
+    A 7x7/s2 conv over 3 channels runs the MXU at 3/128 input-channel
+    occupancy.  Re-expressing the SAME linear map as a 2x2
+    space-to-depth (H,W,3 -> H/2,W/2,12) followed by a 4x4/s1 conv with
+    asymmetric (2,1) padding quadruples the contraction depth and
+    removes the strided gather.  Weight correspondence (proven by
+    tests/test_models_and_ring.py::test_space_to_depth_stem_equivalence):
+    w4[kp,kq,(a,b,c),o] = w7[2kp+a-1, 2kq+b-1, c, o] with out-of-range
+    taps zero (the 'pad 7x7 to 8x8' trick).
+    """
+    n, h, w, c = x.shape
+    xs = x.reshape(n, h // 2, 2, w // 2, 2, c)
+    xs = xs.transpose(0, 1, 3, 2, 4, 5).reshape(n, h // 2, w // 2, 4 * c)
+    return conv(features, (4, 4), (1, 1),
+                padding=[(2, 1), (2, 1)], name=name)(xs)
+
+
 class ResNet(nn.Module):
     stage_sizes: Sequence[int]
     block_cls: ModuleDef
@@ -84,6 +104,7 @@ class ResNet(nn.Module):
     num_filters: int = 64
     dtype: Any = jnp.bfloat16
     bn_axis_name: Optional[str] = None  # set to mesh axis for sync-BN
+    stem: str = "conv"  # "conv" (classic 7x7/s2) | "space_to_depth"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -98,8 +119,11 @@ class ResNet(nn.Module):
             epsilon=1e-5, dtype=self.dtype, axis_name=self.bn_axis_name,
         )
         x = x.astype(self.dtype)
-        x = conv(self.num_filters, (7, 7), (2, 2),
-                 padding=[(3, 3), (3, 3)], name="conv_init")(x)
+        if self.stem == "space_to_depth":
+            x = space_to_depth_stem(x, self.num_filters, conv)
+        else:
+            x = conv(self.num_filters, (7, 7), (2, 2),
+                     padding=[(3, 3), (3, 3)], name="conv_init")(x)
         x = norm(name="bn_init")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))

@@ -433,6 +433,10 @@ class NativeController:
                 jax.block_until_ready(value)
                 self.timeline_activity(e.name, "XLA_COMM", False)
             e.future.set_result(value)
+            # mark consumed so a later exception in THIS callback can't
+            # overwrite the delivered result or double-close the span
+            e.future = None
+            e.name = None
 
         # resolve the response's process set so the engine applies its own
         # scoping rules (world = None fast path)
@@ -467,17 +471,19 @@ class NativeController:
             # per-offset slices / a jitted unfuse) recompiles endlessly —
             # measured 150-1500 ms burst-64 latencies from exactly that
             # (PERF.md).  Host memcpys are composition-insensitive; only
-            # the collective itself stays compiled, over a buffer padded
-            # to a power of two so its signature count stays bounded
-            # (zero padding is identity-safe for every reduce op,
-            # including Adasum's dot products, and is sliced away below).
+            # the collective itself stays compiled.  Multi-entry buckets
+            # pad to the next power of two so the collective's signature
+            # count stays bounded (zero padding is identity-safe for all
+            # reduce ops including Adasum's dots, and is sliced away
+            # below); a single-entry bucket has a stable shape already —
+            # padding it would only waste up to 2x transfer/ICI bytes.
             from ..ops.adasum import _next_pow2
 
             arrays = [np.asarray(e.payload) for e in entries]
             sizes = [int(a.size) for a in arrays]
             shapes = [a.shape for a in arrays]
             total = sum(sizes)
-            padded = _next_pow2(total)
+            padded = _next_pow2(total) if len(arrays) > 1 else total
             buf = np.zeros((padded,), arrays[0].dtype)
             offset = 0
             for a in arrays:

@@ -194,9 +194,12 @@ bool Controller::RunLoopOnce() {
     // actually ready — executor_() returning only means the async XLA
     // dispatch was issued (round-2 verdict: dispatch-time spans made
     // traces show near-zero COMM).  Error responses never reach that
-    // code, so close their spans here.
+    // code, so close their spans here — but only the spans actually
+    // opened above (ids of -1 are join fills with no local span).
     if (timeline_ && timeline_->active() && !resp.error.empty())
-      for (const auto& n : resp.names) timeline_->ActivityEnd(n, "XLA_COMM");
+      for (size_t i = 0; i < resp.names.size(); ++i)
+        if (local_ids[i] != -1)
+          timeline_->ActivityEnd(resp.names[i], "XLA_COMM");
   }
   if (cycle_bytes > 0) params_->Observe(cycle_bytes);
   if (timeline_ && timeline_->active() && !responses.empty())

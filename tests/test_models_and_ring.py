@@ -50,6 +50,60 @@ def test_resnet_tiny_train_step():
     assert "batch_stats" in updates
 
 
+def test_space_to_depth_stem_equivalence():
+    """The space-to-depth stem computes EXACTLY the classic 7x7/s2 stem's
+    linear map when the 4x4x12 kernel carries the mapped 7x7x3 weights
+    (models/resnet.py space_to_depth_stem docstring); this is the proof
+    the bench's fast stem is the same model."""
+    import numpy as np
+    from horovod_tpu.models import resnet as rn
+
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(rng, (2, 32, 32, 3), jnp.float32)
+
+    classic = rn.ResNet(
+        stage_sizes=[1], block_cls=rn.ResNetBlock, num_filters=8,
+        num_classes=4, dtype=jnp.float32, stem="conv",
+    )
+    s2d = rn.ResNet(
+        stage_sizes=[1], block_cls=rn.ResNetBlock, num_filters=8,
+        num_classes=4, dtype=jnp.float32, stem="space_to_depth",
+    )
+    v_classic = classic.init(rng, x)
+    v_s2d = s2d.init(jax.random.PRNGKey(1), x)
+
+    # map the classic 7x7x3xF stem kernel into the 4x4x12xF layout
+    w7 = np.asarray(v_classic["params"]["conv_init"]["kernel"])
+    w4 = np.zeros((4, 4, 12, w7.shape[-1]), np.float32)
+    for kp in range(4):
+        for a in range(2):
+            di = 2 * kp + a - 1
+            if not 0 <= di < 7:
+                continue
+            for kq in range(4):
+                for b in range(2):
+                    dj = 2 * kq + b - 1
+                    if not 0 <= dj < 7:
+                        continue
+                    w4[kp, kq, a * 6 + b * 3:a * 6 + b * 3 + 3] = (
+                        w7[di, dj]
+                    )
+    params = jax.tree_util.tree_map(lambda t: t, v_classic["params"])
+    params = dict(params)
+    params["conv_init"] = {"kernel": jnp.asarray(w4)}
+    variables = {
+        "params": params,
+        "batch_stats": v_classic["batch_stats"],
+    }
+    out_classic = classic.apply(v_classic, x, train=False)
+    out_s2d = s2d.apply(variables, x, train=False)
+    np.testing.assert_allclose(
+        np.asarray(out_classic), np.asarray(out_s2d), rtol=1e-4, atol=1e-4
+    )
+    # shapes of the fresh s2d init agree with the mapped layout
+    assert v_s2d["params"]["conv_init"]["kernel"].shape == (4, 4, 12, 8)
+
+
 def test_transformer_forward():
     cfg = gpt_tiny(dtype=jnp.float32)
     model = Transformer(cfg)
