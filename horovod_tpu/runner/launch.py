@@ -28,6 +28,26 @@ from typing import Dict, List, Optional, Tuple
 from .config_parser import config_to_env, load_config_file
 
 
+def monitor_lockstep(procs: List["subprocess.Popen"],
+                     label: str = "tpurun") -> int:
+    """Exit-code lockstep monitoring: first nonzero exit terminates the
+    rest (reference: gloo_run's monitor loop).  Shared by the launcher
+    and the estimator/executor subprocess backends."""
+    while True:
+        codes = [p.poll() for p in procs]
+        for rank, code in enumerate(codes):
+            if code is not None and code != 0:
+                print(f"[{label}] rank {rank} exited with {code}; "
+                      "terminating remaining workers", file=sys.stderr)
+                for p in procs:
+                    if p.poll() is None:
+                        p.terminate()
+                return code
+        if all(c == 0 for c in codes):
+            return 0
+        time.sleep(0.1)
+
+
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
@@ -201,19 +221,7 @@ def _launch_local(command: List[str], num_proc: int,
                 command, env=env, stdout=stdout, stderr=stderr
             ))
         # monitor: first nonzero exit kills the job (reference behavior)
-        while True:
-            codes = [p.poll() for p in procs]
-            for rank, code in enumerate(codes):
-                if code is not None and code != 0:
-                    print(f"[tpurun] rank {rank} exited with {code}; "
-                          "terminating remaining workers", file=sys.stderr)
-                    for p in procs:
-                        if p.poll() is None:
-                            p.terminate()
-                    return code
-            if all(c == 0 for c in codes):
-                return 0
-            time.sleep(0.1)
+        return monitor_lockstep(procs)
     except KeyboardInterrupt:
         for p in procs:
             if p.poll() is None:

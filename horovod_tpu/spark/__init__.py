@@ -9,15 +9,25 @@ no pyspark, so the integration is scoped to:
     Spark tasks, each joined into the framework's world; without pyspark
     it raises ImportError with guidance (use ``horovod_tpu.ray
     .RayExecutor`` or ``tpurun`` for the same contract locally).
-  * Estimators (KerasEstimator/TorchEstimator analogs) are out of scope
-    until a pyspark environment exists; documented in README's coverage
-    table.
+  * Estimators: :mod:`horovod_tpu.spark.keras` (``KerasEstimator`` — the
+    flax analog) and :mod:`horovod_tpu.spark.torch` (``TorchEstimator``)
+    implement the reference's fit(df) -> Transformer contract over a
+    :mod:`~horovod_tpu.spark.store` Store, training across launcher-
+    managed subprocess workers (the Spark-barrier transport being
+    pyspark-gated in this image).
 """
 
 from __future__ import annotations
 
 import socket
 from typing import Any, Callable, List, Optional
+
+from .estimator import (  # noqa: F401
+    FlaxEstimator, FlaxModel, TorchEstimator, TorchModel,
+)
+from .store import (  # noqa: F401
+    GCSStore, HDFSStore, LocalStore, S3Store, Store,
+)
 
 
 def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,

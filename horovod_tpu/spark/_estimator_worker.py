@@ -1,0 +1,267 @@
+"""Per-rank estimator training worker.
+
+Reference parity: the task body horovod/spark's estimators run inside
+each barrier task (SURVEY.md §3.5): hvd.init(), read this rank's shard
+from the Store, train with DistributedOptimizer, rank 0 checkpoints to
+the Store.  Launched as subprocesses with the standard coordination env
+(the Spark-barrier transport being pyspark-gated in this image).
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import pickle
+import sys
+
+import numpy as np
+
+
+def _load_store(spec):
+    from . import store as store_mod
+
+    cls = getattr(store_mod, spec["store_cls"], None)
+    if cls is None or not isinstance(cls, type) or not issubclass(
+        cls, store_mod.Store
+    ):
+        # a silent LocalStore fallback would read wrong/absent paths for
+        # custom Store subclasses — fail loudly instead
+        raise ValueError(
+            f"worker cannot reconstruct store class {spec['store_cls']!r}; "
+            "estimator subprocess workers support the built-in stores "
+            "(LocalStore/HDFSStore/S3Store/GCSStore)"
+        )
+    return cls(spec["store_prefix"])
+
+
+def _load_val(store, spec):
+    path = os.path.join(
+        store.get_val_data_path(spec["run_id"]), "part_0.npz"
+    )
+    if not store.exists(path):
+        return None
+    with np.load(io.BytesIO(store.read_bytes(path))) as z:
+        return {k: z[k] for k in z.files}
+
+
+def _write_history(store, spec, history):
+    import json
+
+    store.write_bytes(
+        os.path.join(store.get_logs_path(spec["run_id"]), "history.json"),
+        json.dumps(history).encode(),
+    )
+
+
+def _load_shard(store, spec, rank):
+    path = os.path.join(
+        store.get_train_data_path(spec["run_id"]), f"part_{rank}.npz"
+    )
+    with np.load(io.BytesIO(store.read_bytes(path))) as z:
+        return {k: z[k] for k in z.files}
+
+
+def _batches(shard, spec, rng):
+    feats = [shard[c] for c in spec["feature_cols"]]
+    labels = [shard[c] for c in spec["label_cols"]]
+    n = len(feats[0])
+    bs = spec["batch_size"]
+    idx = rng.permutation(n)
+    # drop the ragged tail so every rank steps the same number of times
+    # (reference: Petastorm loaders make epochs divisible; ragged tails
+    # would desynchronize the allreduce count across ranks)
+    for start in range(0, n - bs + 1, bs):
+        take = idx[start:start + bs]
+        yield [f[take] for f in feats], [l[take] for l in labels]
+
+
+def _resolve_flax_pieces(extra):
+    import optax
+
+    opt_spec = extra["optimizer"]
+    if callable(opt_spec):
+        optimizer = opt_spec()
+    else:
+        name, kw = opt_spec
+        optimizer = getattr(optax, name)(**kw)
+    loss_spec = extra["loss"]
+    if callable(loss_spec):
+        loss_fn = loss_spec
+    elif loss_spec == "softmax_cross_entropy":
+        def loss_fn(out, y):
+            return optax.softmax_cross_entropy_with_integer_labels(
+                out, y
+            ).mean()
+    elif loss_spec == "mse":
+        def loss_fn(out, y):
+            return ((out - y) ** 2).mean()
+    else:
+        raise ValueError(f"unknown loss {loss_spec!r}")
+    return optimizer, loss_fn
+
+
+def _train_flax(spec, store, rank):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import horovod_tpu as hvd
+
+    model = spec["model"]
+    optimizer, loss_fn = _resolve_flax_pieces(spec["extra"])
+    shard = _load_shard(store, spec, rank)
+    rng = np.random.RandomState(spec["seed"] + 1)
+
+    sample_feats, _ = next(_batches(shard, spec, rng))
+    variables = model.init(
+        jax.random.PRNGKey(spec["seed"]), *map(jnp.asarray, sample_feats)
+    )
+    params = variables["params"]
+    # identical start everywhere (reference: broadcast_parameters)
+    params = hvd.broadcast_parameters(params, root_rank=0)
+    opt = hvd.DistributedOptimizer(optimizer)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def grads_of(p, feats, labels):
+        def compute(p_):
+            out = model.apply({"params": p_}, *feats)
+            return loss_fn(out, labels[0] if len(labels) == 1 else labels)
+
+        return jax.value_and_grad(compute)(p)
+
+    val = _load_val(store, spec) if hvd.cross_rank() == 0 else None
+    history = {"loss": [], "val_loss": []}
+    for epoch in range(spec["epochs"]):
+        epoch_rng = np.random.RandomState(spec["seed"] + 1 + epoch)
+        loss = None
+        for feats, labels in _batches(shard, spec, epoch_rng):
+            feats = [jnp.asarray(f) for f in feats]
+            labels = [jnp.asarray(l) for l in labels]
+            loss, grads = grads_of(params, feats, labels)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+        if hvd.cross_rank() == 0:
+            history["loss"].append(float(loss) if loss is not None else None)
+            if val is not None:
+                vfeats = [jnp.asarray(val[c]) for c in spec["feature_cols"]]
+                vlabels = [jnp.asarray(val[c]) for c in spec["label_cols"]]
+                vloss, _ = grads_of(params, vfeats, vlabels)
+                history["val_loss"].append(float(vloss))
+            if spec["verbose"]:
+                print(f"[estimator] epoch {epoch}: {history}",
+                      file=sys.stderr)
+
+    if hvd.cross_rank() == 0:
+        out_vars = dict(variables)
+        out_vars["params"] = jax.device_get(params)
+        store.write_bytes(
+            os.path.join(store.get_checkpoint_path(spec["run_id"]),
+                         "model.bin"),
+            pickle.dumps(out_vars),
+        )
+        _write_history(store, spec, history)
+
+
+def _train_torch(spec, store, rank):
+    import torch
+
+    import horovod_tpu.torch as hvd_torch
+
+    model = spec["model"]
+    extra = spec["extra"]
+    opt_spec = extra["optimizer"]
+    if callable(opt_spec):
+        optimizer = opt_spec(model.parameters())
+    else:
+        name, kw = opt_spec
+        optimizer = {
+            "sgd": torch.optim.SGD, "adam": torch.optim.Adam,
+        }[name](model.parameters(), **kw)
+    loss_spec = extra["loss"]
+    if callable(loss_spec):
+        loss_fn = loss_spec
+    else:
+        loss_fn = {
+            "cross_entropy": torch.nn.functional.cross_entropy,
+            "mse": torch.nn.functional.mse_loss,
+        }[loss_spec]
+
+    hvd_torch.init()
+    hvd_torch.broadcast_parameters(model.state_dict(), root_rank=0)
+    optimizer = hvd_torch.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters()
+    )
+    shard = _load_shard(store, spec, rank)
+
+    def to_tensors(feats, labels):
+        tf = [torch.as_tensor(np.asarray(f, np.float32)) for f in feats]
+        y = labels[0]
+        ty = torch.as_tensor(
+            y if np.issubdtype(y.dtype, np.integer)
+            else np.asarray(y, np.float32)
+        )
+        return tf, ty
+
+    val = _load_val(store, spec) if hvd_torch.cross_rank() == 0 else None
+    history = {"loss": [], "val_loss": []}
+    for epoch in range(spec["epochs"]):
+        epoch_rng = np.random.RandomState(spec["seed"] + 1 + epoch)
+        loss = None
+        for feats, labels in _batches(shard, spec, epoch_rng):
+            tf, ty = to_tensors(feats, labels)
+            optimizer.zero_grad()
+            loss = loss_fn(model(*tf), ty)
+            loss.backward()
+            optimizer.step()
+        if hvd_torch.cross_rank() == 0:
+            history["loss"].append(
+                float(loss) if loss is not None else None
+            )
+            if val is not None:
+                tf, ty = to_tensors(
+                    [val[c] for c in spec["feature_cols"]],
+                    [val[c] for c in spec["label_cols"]],
+                )
+                with torch.no_grad():
+                    history["val_loss"].append(
+                        float(loss_fn(model(*tf), ty))
+                    )
+
+    if hvd_torch.cross_rank() == 0:
+        bio = io.BytesIO()
+        torch.save(model.state_dict(), bio)
+        store.write_bytes(
+            os.path.join(store.get_checkpoint_path(spec["run_id"]),
+                         "model.bin"),
+            bio.getvalue(),
+        )
+        _write_history(store, spec, history)
+
+
+def main() -> int:
+    payload_path = sys.argv[1]
+    with open(payload_path, "rb") as f:
+        spec = pickle.load(f)
+    store = _load_store(spec)
+    rank = int(os.environ.get("HVD_TPU_PROCESS_ID", "0"))
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    if spec["kind"] == "flax":
+        _train_flax(spec, store, rank)
+    elif spec["kind"] == "torch":
+        _train_torch(spec, store, rank)
+    else:
+        raise ValueError(f"unknown estimator kind {spec['kind']!r}")
+    hvd.barrier()  # rank 0's checkpoint write completes before exit
+
+    from horovod_tpu.elastic.worker import clean_shutdown
+
+    clean_shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

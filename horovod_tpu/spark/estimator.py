@@ -1,0 +1,376 @@
+"""DataFrame-in, model-out estimators.
+
+Reference parity: horovod/spark/keras/estimator.py (KerasEstimator) and
+horovod/spark/torch/estimator.py (TorchEstimator) — SURVEY.md §2.4 and
+§3.5's call stack: ``est.fit(df)`` materializes the DataFrame into the
+Store, trains data-parallel across ``num_proc`` workers, and returns a
+Transformer-style model that reads rank 0's checkpoint.
+
+TPU-native mapping:
+  * the Petastorm parquet materialization becomes numpy shards in the
+    Store (one ``.npz`` per rank — row-sliced, like Petastorm row-group
+    assignment);
+  * Spark barrier tasks become launcher-managed subprocesses (the same
+    coordination env ``tpurun``/RayExecutor use; with pyspark installed
+    ``horovod_tpu.spark.run`` can carry the same worker fn inside barrier
+    tasks);
+  * ``FlaxEstimator`` is the Keras-analog for this stack (flax is the
+    high-level model library here); ``TorchEstimator`` matches the
+    reference name and trains through the torch adapter.
+
+Inputs accepted by ``fit``: a pandas DataFrame, a dict of equal-length
+numpy arrays, or a pyspark DataFrame (converted via ``toPandas`` when
+pyspark is present).  Models, loss and optimizer factories must be
+picklable (module-level), like the reference's cloudpickled estimator
+params.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import subprocess
+import sys
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from .store import LocalStore, Store
+
+
+def _as_dense(v) -> np.ndarray:
+    """Coerce a column to a dense numeric array.  pandas columns holding
+    per-row vectors come out dtype=object (which np.savez would pickle
+    and the worker's allow_pickle=False load would refuse) — stack them."""
+    arr = np.asarray(v)
+    if arr.dtype == object:
+        arr = np.stack([np.asarray(row) for row in arr])
+    return arr
+
+
+def _to_columns(df: Any) -> dict:
+    """Normalize fit() input to a dict of numpy arrays."""
+    if isinstance(df, dict):
+        cols = {k: _as_dense(v) for k, v in df.items()}
+    elif hasattr(df, "toPandas"):  # pyspark DataFrame
+        cols = {
+            k: _as_dense(v)
+            for k, v in df.toPandas().to_dict("list").items()
+        }
+    elif hasattr(df, "columns") and hasattr(df, "__getitem__"):  # pandas
+        cols = {str(c): _as_dense(df[c]) for c in df.columns}
+    else:
+        raise TypeError(
+            f"unsupported dataframe type {type(df).__name__}: pass a "
+            "pandas DataFrame, a dict of numpy arrays, or a pyspark "
+            "DataFrame"
+        )
+    lengths = {k: len(v) for k, v in cols.items()}
+    if len(set(lengths.values())) > 1:
+        raise ValueError(f"ragged column lengths: {lengths}")
+    return cols
+
+
+class _EstimatorBase:
+    """Shared param surface (reference: spark/common/params.py
+    EstimatorParams)."""
+
+    def __init__(
+        self,
+        model: Any,
+        store: Optional[Store] = None,
+        feature_cols: Sequence[str] = ("features",),
+        label_cols: Sequence[str] = ("label",),
+        batch_size: int = 32,
+        epochs: int = 1,
+        num_proc: int = 1,
+        validation: float = 0.0,
+        shuffle: bool = True,
+        seed: int = 0,
+        verbose: int = 0,
+        run_id: Optional[str] = None,
+    ):
+        self.model = model
+        self.store = store or LocalStore(
+            os.path.join(os.getcwd(), ".hvd_tpu_runs")
+        )
+        self.feature_cols = list(feature_cols)
+        self.label_cols = list(label_cols)
+        self.batch_size = batch_size
+        self.epochs = epochs
+        self.num_proc = num_proc
+        self.validation = validation
+        self.shuffle = shuffle
+        self.seed = seed
+        self.verbose = verbose
+        self.run_id = run_id
+
+    # -- data materialization (reference: util.prepare_data -> Petastorm) --
+
+    def _materialize(self, cols: dict, run_id: str) -> None:
+        n = len(next(iter(cols.values())))
+        idx = np.arange(n)
+        if self.shuffle:
+            np.random.RandomState(self.seed).shuffle(idx)
+        n_val = int(n * self.validation)
+        val_idx, train_idx = idx[:n_val], idx[n_val:]
+        # truncate to a whole number of GLOBAL batches so every rank runs
+        # the same number of steps — unequal shard lengths would leave one
+        # rank's allreduce without partners (collective desync/hang)
+        per_step = self.num_proc * self.batch_size
+        usable = (len(train_idx) // per_step) * per_step
+        if usable == 0:
+            raise ValueError(
+                f"not enough training rows ({len(train_idx)}) for one "
+                f"global batch of num_proc*batch_size = {per_step}"
+            )
+        train_idx = train_idx[:usable]
+        for rank in range(self.num_proc):
+            shard = train_idx[rank::self.num_proc]
+            buf = {k: v[shard] for k, v in cols.items()}
+            path = os.path.join(
+                self.store.get_train_data_path(run_id), f"part_{rank}.npz"
+            )
+            self._write_npz(path, buf)
+        if n_val:
+            buf = {k: v[val_idx] for k, v in cols.items()}
+            self._write_npz(
+                os.path.join(self.store.get_val_data_path(run_id),
+                             "part_0.npz"),
+                buf,
+            )
+
+    def _write_npz(self, path: str, arrays: dict) -> None:
+        import io
+
+        bio = io.BytesIO()
+        np.savez(bio, **arrays)
+        self.store.write_bytes(path, bio.getvalue())
+
+    # -- worker fleet (reference: SparkBackend.run over barrier tasks) -----
+
+    def _run_workers(self, payload_path: str) -> None:
+        from ..runner.launch import _free_port, monitor_lockstep
+
+        coordinator = f"127.0.0.1:{_free_port()}"
+        native_port = _free_port()
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        procs = []
+        for rank in range(self.num_proc):
+            env = dict(os.environ)
+            env.update({
+                "HVD_TPU_COORDINATOR": coordinator,
+                "HVD_TPU_NATIVE_PORT": str(native_port),
+                "HVD_TPU_NUM_PROCESSES": str(self.num_proc),
+                "HVD_TPU_PROCESS_ID": str(rank),
+                "HVD_TPU_LOCAL_RANK": str(rank),
+                "HVD_TPU_LOCAL_SIZE": str(self.num_proc),
+                "PYTHONPATH": repo_root + os.pathsep + env.get(
+                    "PYTHONPATH", ""
+                ),
+            })
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m",
+                 "horovod_tpu.spark._estimator_worker", payload_path],
+                env=env,
+            ))
+        code = monitor_lockstep(procs, label="estimator")
+        if code != 0:
+            raise RuntimeError(
+                f"estimator training failed (first worker exit code {code})"
+            )
+
+    def _fit(self, df: Any, kind: str) -> dict:
+        cols = _to_columns(df)
+        missing = [
+            c for c in self.feature_cols + self.label_cols if c not in cols
+        ]
+        if missing:
+            raise ValueError(
+                f"columns {missing} not in dataframe (has {sorted(cols)})"
+            )
+        run_id = self.run_id or self.store.new_run_id()
+        self.run_id = run_id
+        self._materialize(cols, run_id)
+        spec = {
+            "kind": kind,
+            "model": self.model,
+            "feature_cols": self.feature_cols,
+            "label_cols": self.label_cols,
+            "batch_size": self.batch_size,
+            "epochs": self.epochs,
+            "seed": self.seed,
+            "verbose": self.verbose,
+            "store_prefix": self.store.prefix_path,
+            "store_cls": type(self.store).__name__,
+            "run_id": run_id,
+            "extra": self._worker_extra(),
+        }
+        # the spec travels via a LOCAL temp file (workers are subprocesses
+        # on this host even when the data Store is remote); a copy lands
+        # in the store for the run record
+        import tempfile
+
+        blob = pickle.dumps(spec)
+        self.store.write_bytes(
+            os.path.join(self.store.get_run_path(run_id),
+                         "estimator_spec.pkl"),
+            blob,
+        )
+        fd, payload_path = tempfile.mkstemp(suffix=".pkl",
+                                            prefix="hvd_tpu_est_")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(blob)
+            self._run_workers(payload_path)
+        finally:
+            os.unlink(payload_path)
+        ckpt = os.path.join(
+            self.store.get_checkpoint_path(run_id), "model.bin"
+        )
+        if not self.store.exists(ckpt):
+            raise RuntimeError(f"training produced no checkpoint at {ckpt}")
+        return {"checkpoint": ckpt, "run_id": run_id}
+
+    def _history(self, run_id: str) -> Optional[dict]:
+        """Per-epoch train/val losses rank 0 recorded (reference: the
+        Keras history the estimator model carries)."""
+        import json
+
+        path = os.path.join(self.store.get_logs_path(run_id),
+                            "history.json")
+        if not self.store.exists(path):
+            return None
+        return json.loads(self.store.read_bytes(path).decode())
+
+    def _worker_extra(self) -> dict:
+        return {}
+
+
+class FlaxEstimator(_EstimatorBase):
+    """Keras-analog estimator for flax modules (reference:
+    horovod/spark/keras/estimator.py KerasEstimator — same fit contract,
+    flax standing in for Keras on this stack).
+
+    ``optimizer`` is an optax GradientTransformation factory name +
+    kwargs (e.g. ``("sgd", {"learning_rate": 0.1})``) or a picklable
+    zero-arg callable returning one; ``loss`` is ``"softmax_cross_entropy"``
+    / ``"mse"`` or a picklable ``fn(outputs, labels) -> scalar``.
+    """
+
+    def __init__(self, model, optimizer=("sgd", {"learning_rate": 0.01}),
+                 loss: Any = "softmax_cross_entropy", **kwargs):
+        super().__init__(model, **kwargs)
+        self.optimizer = optimizer
+        self.loss = loss
+
+    def _worker_extra(self) -> dict:
+        return {"optimizer": self.optimizer, "loss": self.loss}
+
+    def fit(self, df: Any) -> "FlaxModel":
+        info = self._fit(df, kind="flax")
+        params_bytes = self.store.read_bytes(info["checkpoint"])
+        model = FlaxModel(
+            self.model, params_bytes, self.feature_cols, self.label_cols,
+            run_id=info["run_id"],
+        )
+        model.history = self._history(info["run_id"])
+        return model
+
+
+class FlaxModel:
+    """Transformer-style trained model (reference: KerasModel —
+    ``transform`` appends prediction columns)."""
+
+    def __init__(self, model, params_bytes: bytes, feature_cols,
+                 label_cols, run_id: Optional[str] = None):
+        self.model = model
+        self.run_id = run_id
+        self.feature_cols = list(feature_cols)
+        self.label_cols = list(label_cols)
+        self._variables = pickle.loads(params_bytes)
+
+    def transform(self, df: Any) -> dict:
+        import jax.numpy as jnp
+
+        cols = _to_columns(df)
+        feats = [jnp.asarray(cols[c]) for c in self.feature_cols]
+        out = self.model.apply(self._variables, *feats, train=False) \
+            if _model_takes_train(self.model) else \
+            self.model.apply(self._variables, *feats)
+        result = dict(cols)
+        result[self.label_cols[0] + "__output"] = np.asarray(out)
+        return result
+
+
+def _model_takes_train(model) -> bool:
+    import inspect
+
+    try:
+        return "train" in inspect.signature(model.__call__).parameters
+    except (TypeError, ValueError):
+        return False
+
+
+class TorchEstimator(_EstimatorBase):
+    """Reference: horovod/spark/torch/estimator.py TorchEstimator — the
+    same fit contract over a ``torch.nn.Module``, trained through the
+    torch adapter's DistributedOptimizer (CPU bridge in this image).
+
+    ``optimizer`` is ``("sgd"|"adam", kwargs)`` or a picklable
+    ``fn(params) -> torch.optim.Optimizer``; ``loss`` is
+    ``"cross_entropy"``/``"mse"`` or a picklable callable.
+    """
+
+    def __init__(self, model, optimizer=("sgd", {"lr": 0.01}),
+                 loss: Any = "cross_entropy", **kwargs):
+        super().__init__(model, **kwargs)
+        self.optimizer = optimizer
+        self.loss = loss
+
+    def _worker_extra(self) -> dict:
+        return {"optimizer": self.optimizer, "loss": self.loss}
+
+    def fit(self, df: Any) -> "TorchModel":
+        info = self._fit(df, kind="torch")
+        state_bytes = self.store.read_bytes(info["checkpoint"])
+        model = TorchModel(
+            self.model, state_bytes, self.feature_cols, self.label_cols,
+            run_id=info["run_id"],
+        )
+        model.history = self._history(info["run_id"])
+        return model
+
+
+class TorchModel:
+    """Reference: spark/torch TorchModel transformer."""
+
+    def __init__(self, model, state_bytes: bytes, feature_cols, label_cols,
+                 run_id: Optional[str] = None):
+        import io
+
+        import torch
+
+        self.model = model
+        self.model.load_state_dict(torch.load(
+            io.BytesIO(state_bytes), weights_only=True
+        ))
+        self.model.eval()
+        self.run_id = run_id
+        self.feature_cols = list(feature_cols)
+        self.label_cols = list(label_cols)
+
+    def transform(self, df: Any) -> dict:
+        import torch
+
+        cols = _to_columns(df)
+        feats = [
+            torch.as_tensor(np.asarray(cols[c], np.float32))
+            for c in self.feature_cols
+        ]
+        with torch.no_grad():
+            out = self.model(*feats)
+        result = dict(cols)
+        result[self.label_cols[0] + "__output"] = out.numpy()
+        return result

@@ -1,0 +1,13 @@
+"""Reference-name surface: ``horovod.spark.keras`` (SURVEY.md §2.4).
+
+Keras itself is TF-bound and absent from this stack; flax is the
+high-level model library here, so ``KerasEstimator`` is the
+:class:`~horovod_tpu.spark.estimator.FlaxEstimator` under the reference's
+import path — same fit(df) -> Transformer contract and Store layout
+(documented divergence, like callbacks.py re-expressing the Keras
+callbacks for optax/flax)."""
+
+from .estimator import FlaxEstimator as KerasEstimator  # noqa: F401
+from .estimator import FlaxModel as KerasModel  # noqa: F401
+
+__all__ = ["KerasEstimator", "KerasModel"]

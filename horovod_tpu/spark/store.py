@@ -1,0 +1,135 @@
+"""Artifact stores for estimator runs.
+
+Reference parity: horovod/spark/common/store.py (SURVEY.md §2.4 "Spark
+Estimators") — a Store owns the run directories estimators materialize
+training data into and checkpoint models out of (LocalStore, HDFSStore,
+S3Store, GCSStore, DBFSLocalStore upstream).  TPU-native scope: the
+LocalStore is fully functional (and is what the tests exercise); the
+remote stores resolve through fsspec when available, mirroring the
+upstream URL-prefix dispatch in Store.create().
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+
+class Store:
+    """Reference: spark/common/store.py Store — path layout contract."""
+
+    def __init__(self, prefix_path: str):
+        self.prefix_path = prefix_path
+
+    # -- layout (reference: Store.get_*_path methods) -----------------------
+
+    def get_run_path(self, run_id: str) -> str:
+        return os.path.join(self.prefix_path, run_id)
+
+    def get_train_data_path(self, run_id: str) -> str:
+        return os.path.join(self.get_run_path(run_id), "train_data")
+
+    def get_val_data_path(self, run_id: str) -> str:
+        return os.path.join(self.get_run_path(run_id), "val_data")
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        return os.path.join(self.get_run_path(run_id), "checkpoint")
+
+    def get_logs_path(self, run_id: str) -> str:
+        return os.path.join(self.get_run_path(run_id), "logs")
+
+    def new_run_id(self) -> str:
+        return f"run_{int(time.time() * 1e3):x}_{os.getpid()}"
+
+    # -- IO (overridden per backend) ---------------------------------------
+
+    def makedirs(self, path: str) -> None:
+        raise NotImplementedError
+
+    def write_bytes(self, path: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def read_bytes(self, path: str) -> bytes:
+        raise NotImplementedError
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    @staticmethod
+    def create(prefix_path: str) -> "Store":
+        """URL-prefix dispatch (reference: Store.create)."""
+        for scheme, cls in (("hdfs://", HDFSStore), ("s3://", S3Store),
+                            ("gs://", GCSStore)):
+            if prefix_path.startswith(scheme):
+                return cls(prefix_path)
+        return LocalStore(prefix_path)
+
+
+class LocalStore(Store):
+    """Local-filesystem store (reference: LocalStore) — the tested
+    backend in this image."""
+
+    def makedirs(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+
+    def write_bytes(self, path: str, data: bytes) -> None:
+        self.makedirs(os.path.dirname(path))
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+
+    def read_bytes(self, path: str) -> bytes:
+        with open(path, "rb") as f:
+            return f.read()
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+
+class _FsspecStore(Store):
+    """Remote store via fsspec (reference: HDFSStore/S3Store/GCSStore).
+    fsspec is not installed in this image, so these are load-bearing only
+    where it exists; construction fails fast with guidance otherwise."""
+
+    protocol: Optional[str] = None
+
+    def __init__(self, prefix_path: str):
+        super().__init__(prefix_path)
+        try:
+            import fsspec
+
+            self._fs = fsspec.filesystem(self.protocol)
+        except ImportError as e:
+            raise ImportError(
+                f"{type(self).__name__} requires fsspec (pip install "
+                f"fsspec) with the {self.protocol} backend; use "
+                "LocalStore in environments without it"
+            ) from e
+
+    def makedirs(self, path: str) -> None:
+        self._fs.makedirs(path, exist_ok=True)
+
+    def write_bytes(self, path: str, data: bytes) -> None:
+        with self._fs.open(path, "wb") as f:
+            f.write(data)
+
+    def read_bytes(self, path: str) -> bytes:
+        with self._fs.open(path, "rb") as f:
+            return f.read()
+
+    def exists(self, path: str) -> bool:
+        return self._fs.exists(path)
+
+
+class HDFSStore(_FsspecStore):
+    protocol = "hdfs"
+
+
+class S3Store(_FsspecStore):
+    protocol = "s3"
+
+
+class GCSStore(_FsspecStore):
+    protocol = "gs"

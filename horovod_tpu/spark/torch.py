@@ -1,0 +1,5 @@
+"""Reference-name surface: ``horovod.spark.torch`` (SURVEY.md §2.4)."""
+
+from .estimator import TorchEstimator, TorchModel  # noqa: F401
+
+__all__ = ["TorchEstimator", "TorchModel"]

@@ -1,0 +1,26 @@
+"""Module-level models for estimator tests (estimator specs are pickled
+into worker subprocesses, so models must be importable — the same
+constraint the reference's cloudpickled Spark estimators have on
+lambda-free models)."""
+
+import flax.linen as nn
+import torch
+
+
+class TinyMLP(nn.Module):
+    features: int = 3
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Dense(16)(x)
+        x = nn.relu(x)
+        return nn.Dense(self.features)(x)
+
+
+class TinyTorchNet(torch.nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc = torch.nn.Linear(4, 1)
+
+    def forward(self, x):
+        return self.fc(x).squeeze(-1)
