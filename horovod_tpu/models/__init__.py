@@ -1,2 +1,10 @@
 """Model zoo used by benchmarks and examples (reference analog: examples/
 model definitions, e.g. pytorch_synthetic_benchmark's ResNet-50)."""
+
+from .resnet import (  # noqa: F401
+    ResNet, ResNet18, ResNet34, ResNet50, ResNet101, ResNet152, ResNetTiny,
+)
+from .simple import LeNet, MLP  # noqa: F401
+from .transformer import (  # noqa: F401
+    Transformer, TransformerConfig, gpt_small,
+)

@@ -173,6 +173,9 @@ class NativeController:
         lib.hvdtpu_last_request_bytes.restype = ctypes.c_longlong
         lib.hvdtpu_fusion_threshold.restype = ctypes.c_longlong
         lib.hvdtpu_cycle_time_ms.restype = ctypes.c_double
+        lib.hvdtpu_autotune_active.restype = ctypes.c_int
+        lib.hvdtpu_autotune_inject.restype = None
+        lib.hvdtpu_autotune_inject.argtypes = [ctypes.c_double]
         lib.hvdtpu_pending_count.restype = ctypes.c_int
         lib.hvdtpu_timeline_activity.restype = None
         lib.hvdtpu_timeline_activity.argtypes = [
@@ -215,6 +218,13 @@ class NativeController:
 
     def cycle_time_ms(self) -> float:
         return float(self._lib.hvdtpu_cycle_time_ms())
+
+    def autotune_active(self) -> bool:
+        return bool(self._lib.hvdtpu_autotune_active())
+
+    def autotune_inject(self, score: float) -> None:
+        """Test hook: one tuner step with a synthetic score."""
+        self._lib.hvdtpu_autotune_inject(float(score))
 
     def pending_count(self) -> int:
         return int(self._lib.hvdtpu_pending_count())

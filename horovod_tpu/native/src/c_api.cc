@@ -299,6 +299,19 @@ double hvdtpu_cycle_time_ms() {
   return s->initialized.load() ? s->params->cycle_time_ms() : -1.0;
 }
 
+int hvdtpu_autotune_active() {
+  auto* s = hvdtpu::g();
+  return s->initialized.load() && s->params->tuning() ? 1 : 0;
+}
+
+void hvdtpu_autotune_inject(double score) {
+  // Test hook: drive one search step with a synthetic score for the
+  // current configuration (lets tests assert the tuner converges on a
+  // known score surface without waiting out real sample windows).
+  auto* s = hvdtpu::g();
+  if (s->initialized.load()) s->params->Advance(score);
+}
+
 int hvdtpu_pending_count() {
   auto* s = hvdtpu::g();
   return s->initialized.load()
