@@ -93,6 +93,10 @@ class ParameterManager {
   void Advance(double score) {
     std::lock_guard<std::mutex> lk(mu_);
     AdvanceLocked(score);
+    // the configuration just changed: restart Observe's sampling window
+    // so bytes measured under the old config aren't attributed to the new
+    sample_bytes_ = 0;
+    sample_start_ = std::chrono::steady_clock::now();
   }
 
  private:

@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""Flash-attention kernel benchmark on the real chip: pallas vs the XLA
+dense attention (materialized S x S logits).  Chained iterations with a
+scalar fetch as the sync (axon contract, see PERF.md)."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from horovod_tpu.models.transformer import causal_dot_attention  # noqa: E402
+from horovod_tpu.ops.flash_attention import flash_attention  # noqa: E402
+
+
+def bench(fn, q, k, v, iters=20, warmup=3):
+    out = None
+    for _ in range(warmup):
+        out = fn(q, k, v)
+        q = out  # chain so iterations cannot overlap/elide
+    float(jnp.sum(out[0, 0, 0]))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(q, k, v)
+        q = out
+    float(jnp.sum(out[0, 0, 0]))
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def main():
+    print("backend:", jax.default_backend(), file=sys.stderr)
+    dense = jax.jit(causal_dot_attention)
+    for (b, s, h, d) in [(4, 1024, 8, 128), (4, 2048, 8, 128),
+                         (2, 4096, 8, 128)]:
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        q, k, v = (
+            jax.random.normal(kk, (b, s, h, d), jnp.float32)
+            .astype(jnp.bfloat16) for kk in ks
+        )
+        t_dense = bench(dense, q, k, v)
+        t_flash = bench(
+            lambda a, b_, c: flash_attention(a, b_, c, block_q=256,
+                                             block_k=256),
+            q, k, v,
+        )
+        # causal attention FLOPs: ~0.5 * 2 * 2 * B*H*S^2*D (QK^T + PV)
+        flops = 2 * b * h * s * s * d  # two matmuls, halved by causality
+        print(
+            f"B{b} S{s} H{h} D{d}: dense {t_dense:7.2f} ms  "
+            f"flash {t_flash:7.2f} ms  speedup {t_dense / t_flash:4.2f}x  "
+            f"flash {flops / (t_flash / 1e3) / 1e12:.1f} TFLOP/s"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
