@@ -7,7 +7,7 @@ same role, designed so sequence parallelism can shard the context:
 
   * ``attention_impl='dot'`` — plain causal attention (default);
   * ``attention_impl='flash'`` — the pallas VMEM-resident flash kernel
-    (ops/flash_attention.py; 3x over dense at S=4096 on v5e);
+    (ops/flash_attention.py; 2-3x over dense at S=4096 on v5e);
   * ``attention_impl='ring'`` — ring attention over a mesh axis
     (parallel/ring_attention.py): the sequence dimension is sharded and
     KV blocks rotate via ``ppermute``, enabling contexts far beyond one

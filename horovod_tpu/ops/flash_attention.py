@@ -96,28 +96,7 @@ def _pad_to(x, multiple, axis):
     return jnp.pad(x, widths)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("causal", "block_q", "block_k", "interpret"),
-)
-def flash_attention(
-    q: jax.Array,
-    k: jax.Array,
-    v: jax.Array,
-    causal: bool = True,
-    block_q: int = 512,
-    block_k: int = 512,
-    interpret: Optional[bool] = None,
-) -> jax.Array:
-    """Flash attention over (B, S, H, D) tensors (same layout and
-    numerics contract as ``models.transformer.causal_dot_attention``:
-    softmax statistics in float32, output in the input dtype).
-
-    Sequences that don't divide the block sizes are zero-padded and the
-    pad keys masked out, so any S works.  Default 512-blocks measured
-    best on v5e (tools/flash_bench.py: 3.0x over XLA dense at S=4096);
-    blocks clamp down for short sequences.
-    """
+def _forward_impl(q, k, v, causal, block_q, block_k, interpret):
     b, s, h, d = q.shape
     orig_s = s
     s128 = s + (-s) % 128  # shortest padded length the tiling allows
@@ -156,3 +135,78 @@ def flash_attention(
     )(qf, kf, vf)
     out = out.reshape(b, h, s_q, d).transpose(0, 2, 1, 3)
     return out[:, :orig_s]
+
+
+def _dense_attention(q, k, v, causal):
+    """Dense recomputation mirroring the KERNEL's numerics — all matmuls
+    on float32-upcast operands, statistics in float32, final cast to the
+    input dtype.  This intentionally differs from
+    models.transformer.causal_dot_attention (which runs the QK matmul in
+    the input dtype), so the backward differentiates the same function
+    the pallas forward computes, bf16 included.  Used only by
+    _flash_bwd."""
+    d = q.shape[-1]
+    qf = q.astype(jnp.float32) / jnp.sqrt(float(d))
+    logits = jnp.einsum("bqhd,bkhd->bhqk", qf, k.astype(jnp.float32))
+    if causal:
+        s_q, s_k = q.shape[1], k.shape[1]
+        mask = jnp.arange(s_q)[:, None] >= jnp.arange(s_k)[None, :]
+        logits = jnp.where(mask[None, None], logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    return _forward_impl(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    return _forward_impl(q, k, v, causal, block_q, block_k, interpret), (
+        q, k, v,
+    )
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, residuals, g):
+    # Backward recomputes densely with the kernel's own upcast numerics
+    # (_dense_attention): gradients of the function the forward actually
+    # computes, but the (S x S) logits materialize, so training keeps
+    # only the forward's speed win, not the memory win.  A pallas
+    # backward kernel (dq/dk/dv with recomputed p blocks) is the
+    # follow-up.
+    q, k, v = residuals
+    _, vjp = jax.vjp(lambda a, b_, c: _dense_attention(a, b_, c, causal),
+                     q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "block_q", "block_k", "interpret"),
+)
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    block_q: int = 256,
+    block_k: int = 256,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Flash attention over (B, S, H, D) tensors (same layout and
+    numerics contract as ``models.transformer.causal_dot_attention``:
+    softmax statistics in float32, output in the input dtype).
+
+    Sequences that don't divide the block sizes are zero-padded and the
+    pad keys masked out, so any S works.  Default 256-blocks are the
+    robust v5e choice across chip-load conditions (tools/flash_bench.py;
+    512 sometimes wins, sometimes regresses 2x under pool contention);
+    blocks clamp down for short sequences.  Differentiable: the backward
+    pass recomputes through the dense path (exact, O(S^2) memory — see
+    _flash_bwd).
+    """
+    return _flash(q, k, v, causal, block_q, block_k, interpret)

@@ -60,6 +60,31 @@ def test_transformer_flash_impl_matches_dot():
     )
 
 
+@pytest.mark.parametrize("dtype,tol", [(jnp.float32, 1e-3),
+                                       (jnp.bfloat16, 1e-1)])
+def test_flash_gradients_match_dense(dtype, tol):
+    """Training through the kernel: custom_vjp gradients must match the
+    dense path's (backward recomputes with the kernel's upcast numerics;
+    bf16 compares loosely against the model's dense reference)."""
+    q, k, v = _qkv(1, 256, 2, 32, dtype, seed=3)
+
+    def loss_flash(q, k, v):
+        return (flash_attention(q, k, v).astype(jnp.float32) ** 2).sum()
+
+    def loss_dense(q, k, v):
+        return (
+            causal_dot_attention(q, k, v).astype(jnp.float32) ** 2
+        ).sum()
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=tol, atol=tol,
+        )
+
+
 def test_flash_non_causal():
     q, k, v = _qkv(1, 256, 2, 64, jnp.float32, seed=2)
     d = q.shape[-1]
