@@ -1,4 +1,5 @@
-"""Pallas TPU flash-attention forward kernel — the framework's hot op.
+"""Pallas TPU flash-attention kernels (forward + backward) — the
+framework's hot op.
 
 No reference analog (the reference is a communication framework), but the
 build mandate is TPU-first: the attention inner loop is where transformer
@@ -31,8 +32,8 @@ from jax.experimental import pallas as pl
 _NEG_INF = -1e30
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, causal, block_q,
-                block_k, seq_len):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
+                block_q, block_k, seq_len):
     qi = pl.program_id(1)
     head_dim = q_ref.shape[-1]
     q = q_ref[0].astype(jnp.float32) * sm_scale  # (block_q, D)
@@ -84,6 +85,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, causal, block_q,
     # rows past the true sequence are all-masked (l == 0): emit zeros
     safe_l = jnp.where(l > 0, l, 1.0)
     o_ref[0] = (acc / safe_l[:, None]).astype(o_ref.dtype)
+    # per-row logsumexp of the SCALED logits, for the backward's exact
+    # softmax recomputation; all-masked rows get 0 (their s is -inf, so
+    # exp(s - 0) = 0 keeps them inert)
+    lse_ref[0, :, 0] = jnp.where(l > 0, m + jnp.log(safe_l), 0.0)
 
 
 def _pad_to(x, multiple, axis):
@@ -96,21 +101,32 @@ def _pad_to(x, multiple, axis):
     return jnp.pad(x, widths)
 
 
-def _forward_impl(q, k, v, causal, block_q, block_k, interpret):
+def _fold(x, b, h, d):
+    """(B, S, H, D) -> (B*H, S, D): one grid row per (batch, head)."""
+    return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
+
+def _unfold(x, b, h, s, d):
+    return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+def _clamp_blocks(s, block_q, block_k):
+    s128 = s + (-s) % 128  # shortest padded length the tiling allows
+    return min(block_q, s128), min(block_k, s128)
+
+
+def _forward_impl(q, k, v, causal, block_q, block_k, interpret,
+                  with_lse=False):
     b, s, h, d = q.shape
     orig_s = s
-    s128 = s + (-s) % 128  # shortest padded length the tiling allows
-    block_q = min(block_q, s128)
-    block_k = min(block_k, s128)
+    block_q, block_k = _clamp_blocks(s, block_q, block_k)
     qp = _pad_to(q, block_q, axis=1)
     kp = _pad_to(k, block_k, axis=1)
     vp = _pad_to(v, block_k, axis=1)
     s_q, s_k = qp.shape[1], kp.shape[1]
-    # (B, S, H, D) -> (B*H, S, D): one grid row per (batch, head)
-    def fold(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
-
-    qf, kf, vf = fold(qp), fold(kp), fold(vp)
+    qf = _fold(qp, b, h, d)
+    kf = _fold(kp, b, h, d)
+    vf = _fold(vp, b, h, d)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     kernel = functools.partial(
@@ -121,7 +137,7 @@ def _forward_impl(q, k, v, causal, block_q, block_k, interpret):
         block_k=block_k,
         seq_len=orig_s,
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, s_q // block_q),
         in_specs=[
@@ -129,32 +145,203 @@ def _forward_impl(q, k, v, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, s_k, d), lambda bh, qi: (bh, 0, 0)),
             pl.BlockSpec((1, s_k, d), lambda bh, qi: (bh, 0, 0)),
         ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            # trailing singleton: TPU block tiling requires the last two
+            # block dims divisible by (8, 128) or equal to the array's
+            pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, s_q, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    out = _unfold(out, b, h, s_q, d)[:, :orig_s]
+    if with_lse:
+        return out, lse  # lse stays folded+padded: (B*H, S_q_padded)
+    return out
+
+
+def _recompute_p(q_blk, k_blk, lse_blk, q_off, k_off, *, sm_scale, causal,
+                 seq_len, block_q, block_k):
+    """Exact softmax probabilities of one (block_q, block_k) tile from
+    the saved logsumexp — shared by both backward kernels."""
+    s = jax.lax.dot_general(
+        q_blk.astype(jnp.float32) * sm_scale, k_blk.astype(jnp.float32),
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    q_pos = q_off + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    k_pos = k_off + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    mask = jnp.logical_and(k_pos < seq_len, q_pos < seq_len)
+    if causal:
+        mask = jnp.logical_and(mask, q_pos >= k_pos)
+    s = jnp.where(mask, s, _NEG_INF)
+    return jnp.exp(s - lse_blk[:, None])  # masked entries: exp(-inf-.)=0
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, sm_scale, causal, block_q, block_k, seq_len):
+    qi = pl.program_id(1)
+    q_off = qi * block_q
+    q = q_ref[0]
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, :, 0]
+    delta = delta_ref[0, :, 0]
+
+    def body(kb, dq):
+        k_off = kb * block_k
+        k_blk = k_ref[0, pl.ds(k_off, block_k), :]
+        v_blk = v_ref[0, pl.ds(k_off, block_k), :]
+        p = _recompute_p(
+            q, k_blk, lse, q_off, k_off, sm_scale=sm_scale, causal=causal,
+            seq_len=seq_len, block_q=block_q, block_k=block_k,
+        )
+        dp = jax.lax.dot_general(
+            do, v_blk.astype(jnp.float32),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta[:, None])
+        return dq + jax.lax.dot_general(
+            ds, k_blk.astype(jnp.float32),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        n_kb = jax.lax.div(q_off + block_q - 1, block_k) + 1
+    else:
+        n_kb = k_ref.shape[1] // block_k
+    dq = jax.lax.fori_loop(
+        0, n_kb, body, jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+    )
+    dq_ref[0] = (dq * sm_scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, sm_scale, causal, block_q, block_k,
+                    seq_len):
+    ki = pl.program_id(1)
+    k_off = ki * block_k
+    k_blk = k_ref[0]
+    v_blk = v_ref[0]
+    d = k_blk.shape[-1]
+
+    def body(qb, carry):
+        dk, dv = carry
+        q_off = qb * block_q
+        q_blk = q_ref[0, pl.ds(q_off, block_q), :]
+        do_blk = do_ref[0, pl.ds(q_off, block_q), :].astype(jnp.float32)
+        lse_blk = lse_ref[0, pl.ds(q_off, block_q), 0]
+        delta_blk = delta_ref[0, pl.ds(q_off, block_q), 0]
+        p = _recompute_p(
+            q_blk, k_blk, lse_blk, q_off, k_off, sm_scale=sm_scale,
+            causal=causal, seq_len=seq_len, block_q=block_q,
+            block_k=block_k,
+        )
+        dv = dv + jax.lax.dot_general(
+            p, do_blk,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do_blk, v_blk.astype(jnp.float32),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_blk[:, None])
+        dk = dk + jax.lax.dot_general(
+            ds, q_blk.astype(jnp.float32),
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dk, dv
+
+    n_qb = q_ref.shape[1] // block_q
+    # causal: the first Q block that can see this K block
+    qb_start = (k_off // block_q) if causal else 0
+    dk, dv = jax.lax.fori_loop(
+        qb_start, n_qb, body,
+        (jnp.zeros((block_k, d), jnp.float32),
+         jnp.zeros((block_k, d), jnp.float32)),
+    )
+    dk_ref[0] = (dk * sm_scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _backward_impl(q, k, v, out, lse, g, causal, block_q, block_k,
+                   interpret):
+    b, s, h, d = q.shape
+    orig_s = s
+    block_q, block_k = _clamp_blocks(s, block_q, block_k)
+    # delta = rowsum(dO * O) per (bh, row): O(S) memory, plain jnp
+    delta = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )  # (B, S, H)
+    delta = delta.transpose(0, 2, 1).reshape(b * h, s, 1)
+    qp = _pad_to(q, block_q, axis=1)
+    kp = _pad_to(k, block_k, axis=1)
+    vp = _pad_to(v, block_k, axis=1)
+    gp = _pad_to(g, block_q, axis=1)
+    s_q, s_k = qp.shape[1], kp.shape[1]
+    qf, kf, vf, gf = (
+        _fold(qp, b, h, d), _fold(kp, b, h, d), _fold(vp, b, h, d),
+        _fold(gp, b, h, d),
+    )
+    # lse comes from the forward already folded and padded to this same
+    # s_q (identical block clamp on identical shapes)
+    lse_f = lse
+    delta_f = _pad_to(delta, block_q, axis=1)  # (BH, s_q, 1)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    kw = dict(sm_scale=1.0 / (d ** 0.5), causal=causal, block_q=block_q,
+              block_k=block_k, seq_len=orig_s)
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **kw),
+        grid=(b * h, s_q // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, s_k, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, s_k, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0)),
+        ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
         interpret=interpret,
-    )(qf, kf, vf)
-    out = out.reshape(b, h, s_q, d).transpose(0, 2, 1, 3)
-    return out[:, :orig_s]
-
-
-def _dense_attention(q, k, v, causal):
-    """Dense recomputation mirroring the KERNEL's numerics — all matmuls
-    on float32-upcast operands, statistics in float32, final cast to the
-    input dtype.  This intentionally differs from
-    models.transformer.causal_dot_attention (which runs the QK matmul in
-    the input dtype), so the backward differentiates the same function
-    the pallas forward computes, bf16 included.  Used only by
-    _flash_bwd."""
-    d = q.shape[-1]
-    qf = q.astype(jnp.float32) / jnp.sqrt(float(d))
-    logits = jnp.einsum("bqhd,bkhd->bhqk", qf, k.astype(jnp.float32))
-    if causal:
-        s_q, s_k = q.shape[1], k.shape[1]
-        mask = jnp.arange(s_q)[:, None] >= jnp.arange(s_k)[None, :]
-        logits = jnp.where(mask[None, None], logits, _NEG_INF)
-    probs = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
-    return out.astype(q.dtype)
+    )(qf, kf, vf, gf, lse_f, delta_f)
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, **kw),
+        grid=(b * h, s_k // block_k),
+        in_specs=[
+            pl.BlockSpec((1, s_q, d), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, s_q, d), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, s_q, 1), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, s_q, 1), lambda bh, ki: (bh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s_k, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, s_k, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, gf, lse_f, delta_f)
+    dq = _unfold(dq, b, h, s_q, d)[:, :orig_s]
+    dk = _unfold(dk, b, h, s_k, d)[:, :orig_s]
+    dv = _unfold(dv, b, h, s_k, d)[:, :orig_s]
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -163,22 +350,21 @@ def _flash(q, k, v, causal, block_q, block_k, interpret):
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-    return _forward_impl(q, k, v, causal, block_q, block_k, interpret), (
-        q, k, v,
+    out, lse = _forward_impl(
+        q, k, v, causal, block_q, block_k, interpret, with_lse=True
     )
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, block_q, block_k, interpret, residuals, g):
-    # Backward recomputes densely with the kernel's own upcast numerics
-    # (_dense_attention): gradients of the function the forward actually
-    # computes, but the (S x S) logits materialize, so training keeps
-    # only the forward's speed win, not the memory win.  A pallas
-    # backward kernel (dq/dk/dv with recomputed p blocks) is the
-    # follow-up.
-    q, k, v = residuals
-    _, vjp = jax.vjp(lambda a, b_, c: _dense_attention(a, b_, c, causal),
-                     q, k, v)
-    return vjp(g)
+    # FlashAttention-2-style backward: two pallas kernels (dq; dk+dv)
+    # recompute the probability tiles from the forward's saved logsumexp
+    # — no (S x S) materialization, so training keeps the memory win too.
+    # causal_dot_attention is the numerics oracle in the tests.
+    q, k, v, out, lse = residuals
+    return _backward_impl(
+        q, k, v, out, lse, g, causal, block_q, block_k, interpret
+    )
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -205,8 +391,8 @@ def flash_attention(
     pad keys masked out, so any S works.  Default 256-blocks are the
     robust v5e choice across chip-load conditions (tools/flash_bench.py;
     512 sometimes wins, sometimes regresses 2x under pool contention);
-    blocks clamp down for short sequences.  Differentiable: the backward
-    pass recomputes through the dense path (exact, O(S^2) memory — see
-    _flash_bwd).
+    blocks clamp down for short sequences.  Fully differentiable with an
+    O(S)-memory FlashAttention-2-style pallas backward (see _flash_bwd;
+    fwd+bwd 1.84x over dense at S=4096 on v5e).
     """
     return _flash(q, k, v, causal, block_q, block_k, interpret)

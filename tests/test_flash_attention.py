@@ -85,6 +85,25 @@ def test_flash_gradients_match_dense(dtype, tol):
         )
 
 
+def test_flash_gradients_unpadded_sequence():
+    """Backward over a padded sequence: pad rows/keys must contribute
+    zero gradient (S=200 pads to 256 inside the kernels)."""
+    q, k, v = _qkv(1, 200, 2, 32, jnp.float32, seed=5)
+
+    gf = jax.grad(
+        lambda a, b, c: (flash_attention(a, b, c) ** 2).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    gd = jax.grad(
+        lambda a, b, c: (causal_dot_attention(a, b, c) ** 2).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-3
+        )
+
+
 def test_flash_non_causal():
     q, k, v = _qkv(1, 256, 2, 64, jnp.float32, seed=2)
     d = q.shape[-1]
