@@ -78,8 +78,12 @@ class MultiAxisTransformer(nn.Module):
         head_dim = self.d_model // self.num_heads
 
         def attn_fn(q, k, v):
+            # SP_AXIS always exists on the (dp, sp, tp) mesh (size 1 when
+            # sp folded away, where ulysses degenerates to local
+            # attention); passing None here would make ulysses look for
+            # the unbound world axis and crash at sp=1, tp>1
             return ulysses_attention(
-                q, k, v, axis_name=SP_AXIS if sp > 1 else None
+                q, k, v, axis_name=SP_AXIS
             )
 
         for i in range(self.num_layers):
