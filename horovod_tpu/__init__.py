@@ -69,6 +69,7 @@ from .ops.collective_ops import (
     reducescatter_async,
     synchronize,
 )
+from .ops.flash_attention import flash_attention
 from .ops.reduce_ops import Adasum, Average, Max, Min, Product, ReduceOp, Sum
 from .ops.spmd_ops import run_per_rank
 from .functions import (
