@@ -36,6 +36,25 @@ def main():
     out = hvd.allreduce(jnp.ones((4,)), op=hvd.Sum, prescale_factor=2.0)
     np.testing.assert_allclose(np.asarray(out), np.full(4, 2.0 * nproc))
 
+    # integer min/max exercise the masked pmin/pmax fill values; bool
+    # takes the row-stack path (no psum/fill semantics)
+    me0 = hvd.cross_rank()
+    out = hvd.allreduce(
+        jnp.asarray([me0 + 1, 10 - me0], jnp.int32), op=hvd.Min,
+        name="int_min",
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out), [1, 10 - (nproc - 1)]
+    )
+    out = hvd.allreduce(
+        jnp.asarray([me0 + 1], jnp.int32), op=hvd.Max, name="int_max"
+    )
+    np.testing.assert_array_equal(np.asarray(out), [nproc])
+    out = hvd.allreduce(
+        jnp.asarray([me0 == 0, True]), op=hvd.Min, name="bool_min"
+    )
+    np.testing.assert_array_equal(np.asarray(out), [nproc == 1, True])
+
     # pytree fusion across a dict
     tree = {"a": jnp.full((3,), float(hvd.cross_rank())),
             "b": jnp.ones((2, 2))}

@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Transformer training-step benchmark on the real chip: flash vs dense
+attention end-to-end (GPT-style 138M decoder, bf16, AdamW, S=2048).
+MFU uses the standard 6*N*D decoder train-FLOPs convention."""
+import sys, time
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax, jax.numpy as jnp, numpy as np, optax
+import horovod_tpu as hvd
+from horovod_tpu.models.transformer import Transformer, TransformerConfig
+from bench import PEAK_FLOPS
+
+def run(attention_impl, batch=8, seq=2048):
+    cfg = TransformerConfig(
+        vocab_size=32000, num_layers=12, num_heads=12, head_dim=64,
+        max_seq_len=seq, dtype=jnp.bfloat16, attention_impl=attention_impl,
+    )
+    model = Transformer(cfg)
+    rs = np.random.RandomState(0)
+    tok = jnp.asarray(rs.randint(0, 32000, (batch, seq)))
+    tgt = jnp.asarray(rs.randint(0, 32000, (batch, seq)))
+    variables = model.init(jax.random.PRNGKey(0), tok[:1])
+    opt = optax.adamw(1e-3)
+    opt_state = opt.init(variables["params"])
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(variables["params"]))
+
+    @jax.jit
+    def step(params, opt_state, tok, tgt):
+        def loss_fn(p):
+            logits = model.apply({"params": p}, tok)
+            return optax.softmax_cross_entropy_with_integer_labels(logits, tgt).mean()
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    params = variables["params"]
+    for _ in range(3):
+        params, opt_state, loss = step(params, opt_state, tok, tgt)
+    float(loss)
+    t0 = time.perf_counter(); n = 10
+    for _ in range(n):
+        params, opt_state, loss = step(params, opt_state, tok, tgt)
+    float(loss)
+    dt = (time.perf_counter() - t0) / n
+    toks = batch * seq
+    flops = 6 * n_params * toks  # standard decoder train FLOPs
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN")
+    peak = PEAK_FLOPS.get(gen)
+    mfu = f"{flops / dt / peak:.3f}" if peak else "n/a (unknown TPU gen)"
+    print(f"{attention_impl:6s}: step {dt*1e3:7.1f} ms  {toks/dt:9.0f} tok/s  "
+          f"MFU(6ND) {mfu}  params {n_params/1e6:.0f}M")
+
+print("backend:", jax.default_backend(), file=sys.stderr)
+import traceback
+for impl, batch in [("dot", 4), ("flash", 4), ("dot", 8), ("flash", 8)]:
+    try:
+        run(impl, batch=batch)
+    except Exception as e:
+        if "Ran out of memory" in str(e):
+            print(f"{impl:6s} batch {batch}: OOM (hbm exceeded)")
+        else:
+            traceback.print_exc()
+            print(f"{impl:6s} batch {batch}: FAILED ({type(e).__name__})")
