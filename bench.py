@@ -45,6 +45,13 @@ PEAK_FLOPS = {
 # this analytic constant as fallback ("mfu_model").
 RESNET50_TRAIN_FLOPS_PER_IMG = 3 * 2 * 4.09e9
 
+def peak_flops_for_current_gen():
+    """Per-chip dense bf16 peak for the TPU generation the axon tunnel
+    reports, or None when unknown (an assumed denominator would mis-state
+    MFU by up to ~4.7x across generations)."""
+    return PEAK_FLOPS.get(os.environ.get("PALLAS_AXON_TPU_GEN"))
+
+
 PROBE_TIMEOUT_S = 60
 PROBE_RETRIES = 2
 TPU_RUN_TIMEOUT_S = 330

@@ -48,6 +48,9 @@ def run(func):
     @functools.wraps(func)
     def wrapper(state, *args, **kwargs):
         notification_manager.init()
+        # the failure watchdog restarts from this state's last commit if a
+        # peer dies while the main thread is stuck in a dead collective
+        notification_manager.watch_state(state)
         maybe_restore_after_restart(state)
         skip_sync = False
         while True:

@@ -45,6 +45,19 @@ _ASSIGNMENT_ENV = (
 
 _RENDEZVOUS_TIMEOUT = float(os.environ.get("HVD_TPU_ELASTIC_TIMEOUT", "600"))
 
+# How long after a failure=True notification the main thread gets to begin
+# recovery on its own (reach a host-update check or catch the collective
+# error) before the notification thread force-restarts the worker.  Must be
+# well under the coordination-service heartbeat deadline: once peers stop
+# heartbeating, jaxlib's client FATALs the whole process (~25 s observed),
+# which is unrecoverable — whereas an exec-restart preserves training.  The
+# default leaves legitimate >10 s non-collective phases (eval, checkpoint
+# writes) a margin; raise it if such phases run longer, keeping it below
+# the heartbeat deadline.
+_FAILURE_GRACE = float(
+    os.environ.get("HVD_TPU_ELASTIC_FAILURE_GRACE_SECONDS", "10.0")
+)
+
 
 def elastic_enabled() -> bool:
     return os.environ.get(ENV_ELASTIC, "0") in ("1", "true")
@@ -88,6 +101,14 @@ class WorkerNotificationManager:
         self._pending_failure = False
         self._thread: Optional[threading.Thread] = None
         self._sock: Optional[socket.socket] = None
+        self._watched_state = None
+        self._watchdog_armed = False
+
+    def watch_state(self, state) -> None:
+        """Register the state whose last committed snapshot the failure
+        watchdog should carry across a forced exec-restart."""
+        with self._lock:
+            self._watched_state = state
 
     def init(self) -> None:
         if not elastic_enabled() or self._thread is not None:
@@ -111,13 +132,55 @@ class WorkerNotificationManager:
             if msg is None:
                 return
             if msg.get("type") == "hosts_updated":
+                arm = False
                 with self._lock:
                     self._pending_epoch = msg.get("epoch")
                     self._pending_failure = bool(msg.get("failure"))
+                    if self._pending_failure and not self._watchdog_armed:
+                        self._watchdog_armed = arm = True
                 get_logger().info(
                     "elastic: hosts updated (epoch %s, failure=%s)",
                     msg.get("epoch"), msg.get("failure"),
                 )
+                if arm:
+                    threading.Thread(
+                        target=self._failure_watchdog, daemon=True
+                    ).start()
+
+    def _failure_watchdog(self) -> None:
+        """A peer died.  If the main thread is wedged inside a collective
+        that can never complete (the XLA cross-process op blocks until the
+        coordination service FATALs the process), no exception ever reaches
+        the elastic run wrapper.  After a grace period, recover from here:
+        persist the last *committed* state and exec-restart the worker."""
+        import time
+
+        deadline = time.time() + _FAILURE_GRACE
+        while time.time() < deadline:
+            time.sleep(0.1)
+            with self._lock:
+                if self._pending_epoch is None:
+                    # the main thread picked the update up (reset_world
+                    # cleared it) — recovery is proceeding normally
+                    self._watchdog_armed = False
+                    return
+        with self._lock:
+            if self._pending_epoch is None:
+                self._watchdog_armed = False
+                return
+            state = self._watched_state
+        get_logger().warning(
+            "elastic: main thread did not begin recovery within %.1fs of a "
+            "peer failure (likely blocked in a dead collective); forcing "
+            "exec-restart from the last commit", _FAILURE_GRACE,
+        )
+        # the committed snapshot ONLY, never a live state._snapshot(): the
+        # main thread may be mid-batch (inconsistent fields), and a live
+        # snapshot's host materialization could block on the very dead
+        # collective this thread is rescuing it from.  With no commit yet,
+        # restart bare and let post-boot state.sync() re-seed from rank 0.
+        snap = getattr(state, "_saved", None) if state is not None else None
+        _persist_and_exec(snap)
 
     def check_for_updates(self) -> None:
         """Raise HostsUpdatedInterrupt if an update is pending (reference:
@@ -292,11 +355,25 @@ def restart_after_failure(state) -> None:
     equivalent of torchrun-style worker-group restart, and the state file
     + post-boot ``state.sync()`` reproduce the reference's
     restore-then-rebroadcast semantics exactly."""
+    # Deliberately do NOT stand the failure watchdog down here: taking the
+    # live snapshot can itself block forever (a state field may be an
+    # async-dispatched array whose collective involves the dead peer), and
+    # the watchdog exec-restarting from the last commit is the correct
+    # backstop.  A concurrent double-restart is safe: execv is the last
+    # action of either thread and whichever reaches it first wins.
+    snap = state._snapshot() if hasattr(state, "_snapshot") else None
+    get_logger().info("elastic: peer failure — exec-restarting this worker")
+    _persist_and_exec(snap)
+
+
+def _persist_and_exec(snap) -> None:
+    """Write the state snapshot for the next boot and exec-restart in
+    place (same PID).  Safe from any thread: execv replaces the whole
+    process image."""
     import pickle
     import sys
     import tempfile
 
-    snap = state._snapshot() if hasattr(state, "_snapshot") else None
     if snap is not None:
         fd, path = tempfile.mkstemp(prefix="hvd_tpu_elastic_state_")
         with os.fdopen(fd, "wb") as f:
@@ -304,7 +381,6 @@ def restart_after_failure(state) -> None:
         os.environ[ENV_RESTORE] = path
     for k in _ASSIGNMENT_ENV:
         os.environ.pop(k, None)
-    get_logger().info("elastic: peer failure — exec-restarting this worker")
     sys.stdout.flush()
     sys.stderr.flush()
     os.execv(sys.executable, [sys.executable] + sys.argv)

@@ -91,7 +91,14 @@ class _Worker:
 
     @property
     def alive(self) -> bool:
-        return self.exit_code is None and self.proc.poll() is None
+        # exit_code only — NOT a live proc.poll().  Deaths must become
+        # visible through the monitor's detection pass (which blacklists
+        # the slot) before any membership decision sees them; a live poll
+        # here let a just-died worker vanish from _occupied_slots() while
+        # its slot was not yet blacklisted, so the discovery poll
+        # "refilled" the dead slot with a fresh worker (failure=False)
+        # instead of taking the failure-recovery path.
+        return self.exit_code is None
 
 
 class ElasticDriver:
@@ -209,6 +216,39 @@ class ElasticDriver:
                   file=sys.stderr)
         return w
 
+    def _observe_exits(self) -> Tuple[bool, bool]:
+        """Poll every worker process once and book-keep any deaths: record
+        the exit code, drop the notification socket, blacklist the slot on
+        failure, flag job completion on a clean active exit.  This is the
+        ONLY place exits become visible (``_Worker.alive`` deliberately
+        reads the recorded code, not the live process), so every code path
+        that waits on workers must call it — otherwise a death during that
+        wait is invisible (or worse, visible without its blacklist).
+        Caller must hold ``self._cv``.  Returns (any_exit, any_failure)."""
+        log = get_logger()
+        any_exit = any_failure = False
+        for w in list(self._workers.values()):
+            if w.exit_code is None:
+                code = w.proc.poll()
+                if code is not None:
+                    w.exit_code = code
+                    any_exit = True
+                    self._notify_socks.pop(w.worker_id, None)
+                    if code == 0 and not w.leaving:
+                        # a clean exit of an active member means training
+                        # completed: the job is winding down — stop
+                        # spawning into freed slots.  (A 'leaving' worker
+                        # exiting 0 is just a scale-down; elasticity must
+                        # survive it.)
+                        self._completing = True
+                    if code != 0:
+                        log.warning(
+                            "elastic: worker %d (%s:%d) failed with exit "
+                            "code %d", w.worker_id, w.host, w.slot, code)
+                        self._blacklist.add((w.host, w.slot))
+                        any_failure = True
+        return any_exit, any_failure
+
     def _alive_workers(self) -> List[_Worker]:
         return [w for w in self._workers.values() if w.alive]
 
@@ -265,9 +305,10 @@ class ElasticDriver:
         deadline = time.time() + self.timeout
         with self._cv:
             while True:
-                for w in list(self._workers.values()):
-                    w.exit_code = w.proc.poll() if w.exit_code is None \
-                        else w.exit_code
+                # full bookkeeping, not a bare poll: a worker that crashes
+                # during rendezvous must blacklist its slot too, or the
+                # discovery poll refills it into a crash loop
+                self._observe_exits()
                 expected = {w.worker_id for w in self._alive_workers()}
                 have = set(self._pending_rendezvous)
                 if not expected:
@@ -385,30 +426,9 @@ class ElasticDriver:
         last_poll = time.time()
         while True:
             time.sleep(0.1)
-            membership_changed = False
-            had_failure = False
             with self._cv:
-                for w in list(self._workers.values()):
-                    if w.exit_code is None:
-                        code = w.proc.poll()
-                        if code is not None:
-                            w.exit_code = code
-                            self._notify_socks.pop(w.worker_id, None)
-                            if code == 0 and not w.leaving:
-                                # a clean exit of an active member means
-                                # training completed: the job is winding
-                                # down — stop spawning into freed slots.
-                                # (A 'leaving' worker exiting 0 is just a
-                                # scale-down; elasticity must survive it.)
-                                self._completing = True
-                            if code != 0:
-                                log.warning(
-                                    "elastic: worker %d (%s:%d) failed "
-                                    "with exit code %d", w.worker_id,
-                                    w.host, w.slot, code)
-                                self._blacklist.add((w.host, w.slot))
-                                membership_changed = True
-                                had_failure = True
+                _, had_failure = self._observe_exits()
+                membership_changed = had_failure
                 alive = self._alive_workers()
             if not alive and not membership_changed:
                 # job over: success iff every member of the final epoch
@@ -471,6 +491,13 @@ class ElasticDriver:
                         except RuntimeError:
                             continue
                         with self._cv:
+                            # a worker dying during this wait must be
+                            # reaped (and its slot blacklisted) here, or
+                            # the ghost counts toward min_np below; a
+                            # crash also upgrades the pending notification
+                            # to failure=True so survivors take the
+                            # restart-recovery path, not the graceful one
+                            had_failure |= self._observe_exits()[1]
                             desired = set(self._desired_slots(hosts))
                             for h, s in sorted(desired -
                                                self._occupied_slots()):

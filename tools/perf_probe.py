@@ -23,7 +23,7 @@ import optax  # noqa: E402
 import horovod_tpu as hvd  # noqa: E402
 from horovod_tpu.models.resnet import ResNet50  # noqa: E402
 from horovod_tpu import training  # noqa: E402
-from bench import PEAK_FLOPS, RESNET50_TRAIN_FLOPS_PER_IMG  # noqa: E402
+from bench import RESNET50_TRAIN_FLOPS_PER_IMG, peak_flops_for_current_gen  # noqa: E402
 
 
 def run(batch, img_dtype, peak, iters=30, warmup=5):
@@ -75,10 +75,9 @@ def run(batch, img_dtype, peak, iters=30, warmup=5):
 def main():
     hvd.init()
     print("backend:", jax.default_backend(), file=sys.stderr)
-    gen = os.environ.get("PALLAS_AXON_TPU_GEN")
-    peak = PEAK_FLOPS.get(gen)
+    peak = peak_flops_for_current_gen()
     if peak is None:
-        print(f"unknown TPU gen {gen!r}: MFU columns disabled", file=sys.stderr)
+        print("unknown TPU gen: MFU columns disabled", file=sys.stderr)
     run(128, jnp.float32, peak)
     run(128, jnp.bfloat16, peak)
     run(256, jnp.bfloat16, peak)

@@ -6,9 +6,8 @@ import sys, time
 import os
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax, jax.numpy as jnp, numpy as np, optax
-import horovod_tpu as hvd
 from horovod_tpu.models.transformer import Transformer, TransformerConfig
-from bench import PEAK_FLOPS
+from bench import peak_flops_for_current_gen
 
 def run(attention_impl, batch=8, seq=2048):
     cfg = TransformerConfig(
@@ -44,8 +43,7 @@ def run(attention_impl, batch=8, seq=2048):
     dt = (time.perf_counter() - t0) / n
     toks = batch * seq
     flops = 6 * n_params * toks  # standard decoder train FLOPs
-    gen = os.environ.get("PALLAS_AXON_TPU_GEN")
-    peak = PEAK_FLOPS.get(gen)
+    peak = peak_flops_for_current_gen()
     mfu = f"{flops / dt / peak:.3f}" if peak else "n/a (unknown TPU gen)"
     print(f"{attention_impl:6s}: step {dt*1e3:7.1f} ms  {toks/dt:9.0f} tok/s  "
           f"MFU(6ND) {mfu}  params {n_params/1e6:.0f}M")
