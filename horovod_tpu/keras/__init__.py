@@ -1,0 +1,49 @@
+"""horovod_tpu.keras: the Keras 3 framework adapter.
+
+Reference parity: the ``horovod.keras`` surface (horovod/keras/__init__.py
++ horovod/_keras shared impl — SURVEY.md §2.3).  A reference Keras script
+needs only its import changed::
+
+    import horovod_tpu.keras as hvd
+    hvd.init()
+    opt = hvd.DistributedOptimizer(keras.optimizers.SGD(lr))
+    model.compile(optimizer=opt, ...)
+    model.fit(..., callbacks=[
+        hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+        hvd.callbacks.MetricAverageCallback(),
+    ])
+
+Keras 3 is multi-backend: with the tensorflow (or torch) backend the
+collectives bridge through the shared eager engine; with KERAS_BACKEND=jax
+the wrapped optimizer reaches the engine via host callbacks (see
+``horovod_tpu.tensorflow.optimizer``).  For TPU-native compiled training,
+``horovod_tpu.training`` remains the first-class path.
+"""
+
+from __future__ import annotations
+
+# lifecycle + topology (shared with the JAX surface)
+from ..common.basics import (  # noqa: F401
+    init, shutdown, is_initialized, rank, local_rank, size, local_size,
+    cross_rank, cross_size, is_homogeneous, xla_built, nccl_built,
+    mpi_enabled, gloo_built, ccl_built, native_built,
+)
+from ..common.exceptions import (  # noqa: F401
+    HorovodInternalError, HostsUpdatedInterrupt,
+)
+from ..common.process_sets import ProcessSet, global_process_set  # noqa: F401
+from ..ops.reduce_ops import (  # noqa: F401
+    Adasum, Average, Max, Min, Product, ReduceOp, Sum,
+)
+from ..tensorflow.compression import Compression  # noqa: F401
+from ..tensorflow.functions import (  # noqa: F401
+    allgather_object, broadcast_object, broadcast_object_fn,
+    broadcast_model_weights, broadcast_variables,
+)
+from ..tensorflow.mpi_ops import (  # noqa: F401
+    allgather, allreduce, alltoall, barrier, broadcast, grouped_allreduce,
+    join, reducescatter,
+)
+from ..tensorflow.optimizer import DistributedOptimizer  # noqa: F401
+from . import callbacks  # noqa: F401
+from . import elastic  # noqa: F401

@@ -1,0 +1,51 @@
+"""horovod_tpu.tensorflow: the TensorFlow 2 framework adapter.
+
+Reference parity: the ``horovod.tensorflow`` surface
+(horovod/tensorflow/__init__.py, mpi_ops.py + the mpi_ops.cc /
+xla_mpi_ops.cc custom-op bindings, functions.py, compression.py,
+elastic.py — SURVEY.md §2.3).  A reference training script needs only its
+import changed::
+
+    import horovod_tpu.tensorflow as hvd
+    hvd.init()
+    tape = hvd.DistributedGradientTape(tape)
+    hvd.broadcast_variables(model.variables, root_rank=0)
+    hvd.broadcast_variables(opt.variables, root_rank=0)
+
+Design: TF stays the model/autograd frontend; collectives execute through
+the shared negotiated eager engine (CPU tensors bridge via numpy; traced
+``tf.function`` graphs reach it through ``tf.py_function``).  The
+reference's ``xla_mpi_ops.cc`` solved "collectives inside a compiled
+graph" with XLA custom calls — here the whole data plane already *is*
+XLA; compiled TPU training is the JAX surface (``horovod_tpu.training``),
+and this adapter exists for reference-script parity and CPU-hosted TF.
+"""
+
+from __future__ import annotations
+
+# lifecycle + topology (shared with the JAX surface)
+from ..common.basics import (  # noqa: F401
+    init, shutdown, is_initialized, rank, local_rank, size, local_size,
+    cross_rank, cross_size, is_homogeneous, xla_built, nccl_built,
+    mpi_enabled, gloo_built, ccl_built, native_built,
+)
+from ..common.exceptions import (  # noqa: F401
+    HorovodInternalError, HostsUpdatedInterrupt,
+)
+from ..common.process_sets import ProcessSet, global_process_set  # noqa: F401
+from ..ops.reduce_ops import (  # noqa: F401
+    Adasum, Average, Max, Min, Product, ReduceOp, Sum,
+)
+from .compression import Compression  # noqa: F401
+from .functions import (  # noqa: F401
+    allgather_object, broadcast_object, broadcast_object_fn,
+    broadcast_model_weights, broadcast_variables,
+)
+from .mpi_ops import (  # noqa: F401
+    allgather, allreduce, alltoall, barrier, broadcast, grouped_allreduce,
+    join, reducescatter,
+)
+from .optimizer import (  # noqa: F401
+    DistributedGradientTape, DistributedOptimizer,
+)
+from . import elastic  # noqa: F401

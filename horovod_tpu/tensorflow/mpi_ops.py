@@ -1,0 +1,178 @@
+"""TensorFlow tensor collectives over the XLA engine.
+
+Reference parity: horovod/tensorflow/mpi_ops.py + the C++ custom ops it
+fronts (tensorflow/mpi_ops.cc — SURVEY.md §2.3).  The reference registers
+``HorovodAllreduce``-style TF kernels; here a CPU ``tf.Tensor`` bridges to
+numpy (zero-copy in eager mode) and routes through the same eager engine
+the JAX and torch surfaces use, so every rank's TF collective negotiates
+in the one shared background controller.
+
+Graph mode (``tf.function``): the reference's custom ops trace natively;
+this adapter wraps the engine call in ``tf.py_function`` so traced
+programs (e.g. Keras ``model.fit``'s compiled ``train_step``) execute the
+same negotiated collective at run time.  Output shapes are re-asserted
+where statically known (allreduce/broadcast preserve shape).
+
+The TPU compute path for new code remains the JAX API; this adapter
+exists for reference-script parity and CPU-hosted TF training.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import tensorflow as tf
+
+from ..common.process_sets import ProcessSet
+from ..ops import collective_ops as _ops
+from ..ops.reduce_ops import ReduceOp
+
+
+def _is_symbolic(t) -> bool:
+    return isinstance(t, tf.Tensor) and not hasattr(t, "numpy")
+
+
+def _run(engine_fn, tensor, out_dtype=None, preserve_shape=True):
+    """Execute ``engine_fn(np_array) -> np_array`` on a TF tensor, in
+    eager or graph mode."""
+    tensor = tf.convert_to_tensor(tensor)
+    out_dtype = out_dtype or tensor.dtype
+    if not _is_symbolic(tensor):
+        return tf.convert_to_tensor(
+            np.asarray(engine_fn(tensor.numpy())), dtype=out_dtype
+        )
+    out = tf.py_function(
+        lambda a: np.asarray(engine_fn(a.numpy())), [tensor], Tout=out_dtype
+    )
+    if preserve_shape:
+        out.set_shape(tensor.shape)
+    else:
+        out.set_shape([None] + list(tensor.shape)[1:])
+    return out
+
+
+# -- allreduce ---------------------------------------------------------------
+
+
+def allreduce(tensor, average: Optional[bool] = None,
+              name: Optional[str] = None, op: Optional[ReduceOp] = None,
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+              process_set: Optional[ProcessSet] = None):
+    """Reference: horovod/tensorflow/mpi_ops.py allreduce (op defaults to
+    Average, as upstream's ``hvd.allreduce``)."""
+    return _run(
+        lambda a: _ops.allreduce(
+            a, average=average, name=name, op=op,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor, process_set=process_set,
+        ),
+        tensor,
+    )
+
+
+def grouped_allreduce(tensors, average: Optional[bool] = None,
+                      name: Optional[str] = None,
+                      op: Optional[ReduceOp] = None,
+                      prescale_factor: float = 1.0,
+                      postscale_factor: float = 1.0,
+                      process_set: Optional[ProcessSet] = None):
+    """Reference: horovod/tensorflow/mpi_ops.py grouped_allreduce — the
+    group executes atomically (all fuse together or none)."""
+    tensors = [tf.convert_to_tensor(t) for t in tensors]
+    kwargs = dict(
+        average=average, name=name, op=op, prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor, process_set=process_set,
+    )
+    if not any(_is_symbolic(t) for t in tensors):
+        outs = _ops.grouped_allreduce([t.numpy() for t in tensors], **kwargs)
+        return [tf.convert_to_tensor(np.asarray(o), dtype=t.dtype)
+                for o, t in zip(outs, tensors)]
+    douts = [t.dtype for t in tensors]
+
+    def run(*arrays):
+        outs = _ops.grouped_allreduce([a.numpy() for a in arrays], **kwargs)
+        return [np.asarray(o) for o in outs]
+
+    outs = tf.py_function(run, tensors, Tout=douts)
+    for o, t in zip(outs, tensors):
+        o.set_shape(t.shape)
+    return list(outs)
+
+
+# -- allgather / broadcast ---------------------------------------------------
+
+
+def allgather(tensor, name: Optional[str] = None,
+              process_set: Optional[ProcessSet] = None):
+    """Concatenate each rank's tensor along axis 0; first dims may differ
+    per rank (reference: HorovodAllgather's uneven recvcounts)."""
+    return _run(
+        lambda a: _ops.allgather(a, name=name, process_set=process_set),
+        tensor, preserve_shape=False,
+    )
+
+
+def broadcast(tensor, root_rank: int, name: Optional[str] = None,
+              process_set: Optional[ProcessSet] = None):
+    return _run(
+        lambda a: _ops.broadcast(a, root_rank, name=name,
+                                 process_set=process_set),
+        tensor,
+    )
+
+
+# -- alltoall / reducescatter ------------------------------------------------
+
+
+def alltoall(tensor, splits=None, name: Optional[str] = None,
+             process_set: Optional[ProcessSet] = None):
+    """Returns (received, received_splits) like the reference's
+    HorovodAlltoall."""
+    tensor = tf.convert_to_tensor(tensor)
+    have_splits = splits is not None
+    if have_splits:
+        splits = tf.convert_to_tensor(splits)
+
+    def run(a, s=None):
+        received, recv_splits = _ops.alltoall(
+            a.numpy(), splits=None if s is None else np.asarray(s.numpy()),
+            name=name, process_set=process_set,
+        )
+        return np.asarray(received), np.asarray(recv_splits, np.int32)
+
+    symbolic = _is_symbolic(tensor) or (have_splits and _is_symbolic(splits))
+    if not symbolic:
+        received, recv_splits = run(tensor, splits if have_splits else None)
+        return (tf.convert_to_tensor(received, dtype=tensor.dtype),
+                tf.convert_to_tensor(recv_splits, tf.int32))
+
+    inputs = [tensor, splits] if have_splits else [tensor]
+    received, recv_splits = tf.py_function(
+        run, inputs, Tout=[tensor.dtype, tf.int32]
+    )
+    received.set_shape([None] + list(tensor.shape)[1:])
+    recv_splits.set_shape([None])
+    return received, recv_splits
+
+
+def reducescatter(tensor, op: Optional[ReduceOp] = None,
+                  name: Optional[str] = None,
+                  process_set: Optional[ProcessSet] = None):
+    return _run(
+        lambda a: _ops.reducescatter(a, op=op, name=name,
+                                     process_set=process_set),
+        tensor, preserve_shape=False,
+    )
+
+
+# -- control -----------------------------------------------------------------
+
+
+def barrier(process_set: Optional[ProcessSet] = None) -> None:
+    _ops.barrier(process_set=process_set)
+
+
+def join() -> int:
+    """Reference: HorovodJoin — returns the last joining rank."""
+    return _ops.join()

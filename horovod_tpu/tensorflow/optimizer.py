@@ -1,0 +1,310 @@
+"""DistributedGradientTape / DistributedOptimizer for TensorFlow + Keras 3.
+
+Reference parity: horovod/tensorflow/__init__.py (DistributedGradientTape,
+_make_allreduce_grads_fn) and horovod/_keras/__init__.py
+(create_distributed_optimizer) — SURVEY.md §2.3.  The TF2 training idioms
+both reference paths serve:
+
+  tape = hvd.DistributedGradientTape(tape)          # custom loops
+  opt  = hvd.DistributedOptimizer(keras_optimizer)  # model.fit / Keras 3
+
+Keras 3 note: the reference predates Keras 3; its keras wrapper overrode
+``get_gradients``/``apply_gradients`` of the TF-internal optimizer.  Keras
+3 funnels every backend's update through ``Optimizer.apply``, so the
+dynamic subclass here overrides that single point — the same
+subclass-the-instance trick the reference uses (upstream
+create_distributed_optimizer builds ``cls = type(opt.__class__.__name__,
+(opt.__class__,), ...)``).  With KERAS_BACKEND=jax the update runs inside
+``jax.jit``, where the negotiated eager engine is reached through
+``jax.pure_callback`` (experimental; the TPU-native training path remains
+``horovod_tpu.training``/optax).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..common import basics
+from ..common.process_sets import ProcessSet
+from ..ops import collective_ops as _ops
+from ..ops.reduce_ops import Average, ReduceOp, Sum
+from .compression import Compression
+
+
+def _scale_factors(op: Optional[ReduceOp], gradient_predivide_factor: float,
+                   process_set: Optional[ProcessSet]):
+    """Map (op, predivide) onto engine (op, prescale, postscale) the way
+    the reference's _make_allreduce_grads_fn does: dividing by the factor
+    before the sum and by size/factor after is numerically safer than one
+    post-division for fp16 gradients.
+
+    The divisor is the number of summed *contributions* — one per member
+    process (the eager engine reduces per-process host tensors), NOT
+    ``hvd.size()``, which counts chips and over-divides whenever a process
+    drives more than one chip."""
+    if gradient_predivide_factor == 1.0:
+        return op or Average, 1.0, 1.0
+    engine = basics._require_init().engine
+    n = engine._ctx(process_set).n if process_set is not None \
+        else engine.num_contributors
+    return Sum, 1.0 / gradient_predivide_factor, \
+        gradient_predivide_factor / n
+
+
+def _allreduce_np_grads(grads, compression, op, prescale, postscale,
+                        process_set, name_prefix):
+    """Allreduce a list of numpy gradients (None entries pass through)."""
+    outs = []
+    for i, g in enumerate(grads):
+        if g is None:
+            outs.append(None)
+            continue
+        arr = np.asarray(g)
+        # fp16-on-the-wire compression happens in numpy here (the torch/tf
+        # Compressors operate on framework tensors; this path is shared)
+        ctx = None
+        if compression is Compression.fp16 and arr.dtype in (
+                np.float32, np.float64):
+            ctx = arr.dtype
+            arr = arr.astype(np.float16)
+        out = np.asarray(_ops.allreduce(
+            arr, op=op, prescale_factor=prescale,
+            postscale_factor=postscale, process_set=process_set,
+            name=f"{name_prefix}.{i}",
+        ))
+        outs.append(out.astype(ctx) if ctx is not None else out)
+    return outs
+
+
+class _DistributedGradientTape:
+    """Wraps tf.GradientTape; ``gradient()`` returns allreduced grads
+    (reference: horovod/tensorflow/__init__.py _DistributedGradientTape)."""
+
+    def __init__(self, tape, compression, op, gradient_predivide_factor,
+                 process_set, num_groups):
+        self._tape = tape
+        self._compression = compression
+        self._op = op
+        self._predivide = gradient_predivide_factor
+        self._process_set = process_set
+        self._num_groups = num_groups
+
+    def __enter__(self):
+        self._tape.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._tape.__exit__(*exc)
+
+    def __getattr__(self, name):
+        return getattr(self._tape, name)
+
+    def gradient(self, target, sources, output_gradients=None):
+        from . import mpi_ops
+
+        grads = self._tape.gradient(target, sources, output_gradients)
+        op, prescale, postscale = _scale_factors(
+            self._op, self._predivide, self._process_set
+        )
+        flat = list(grads) if isinstance(grads, (list, tuple)) else [grads]
+        live = [(i, g) for i, g in enumerate(flat) if g is not None]
+        if self._num_groups > 0 and len(live) > 1:
+            # split into num_groups chunks, each an atomic grouped op
+            # (reference: num_groups arg of DistributedGradientTape)
+            n = min(self._num_groups, len(live))
+            out_live = []
+            for c in range(n):
+                chunk = live[c::n]
+                tensors = [self._compression.compress(g) for _, g in chunk]
+                reduced = mpi_ops.grouped_allreduce(
+                    [t for t, _ in tensors], op=op, prescale_factor=prescale,
+                    postscale_factor=postscale, process_set=self._process_set,
+                    name=f"DistributedGradientTape.group{c}",
+                )
+                out_live.extend(
+                    (i, self._compression.decompress(r, ctx))
+                    for (i, _), r, (_, ctx) in zip(chunk, reduced, tensors)
+                )
+            for i, g in out_live:
+                flat[i] = g
+        else:
+            for i, g in live:
+                t, ctx = self._compression.compress(g)
+                t = mpi_ops.allreduce(
+                    t, op=op, prescale_factor=prescale,
+                    postscale_factor=postscale, process_set=self._process_set,
+                    name=f"DistributedGradientTape.{i}",
+                )
+                flat[i] = self._compression.decompress(t, ctx)
+        if isinstance(grads, (list, tuple)):
+            return type(grads)(flat)
+        return flat[0]
+
+
+def DistributedGradientTape(gradtape, device_dense: str = "",
+                            device_sparse: str = "",
+                            compression=Compression.none,
+                            op: Optional[ReduceOp] = None,
+                            gradient_predivide_factor: float = 1.0,
+                            num_groups: int = 0,
+                            process_set: Optional[ProcessSet] = None):
+    """Reference: hvd.DistributedGradientTape.  ``device_dense``/
+    ``device_sparse`` are accepted for signature parity; placement is the
+    engine's concern here (the reference used them to pin GPU copies)."""
+    return _DistributedGradientTape(
+        gradtape, compression, op, gradient_predivide_factor, process_set,
+        num_groups,
+    )
+
+
+def DistributedOptimizer(optimizer, name: Optional[str] = None,
+                         device_dense: str = "", device_sparse: str = "",
+                         compression=Compression.none,
+                         backward_passes_per_step: int = 1,
+                         op: Optional[ReduceOp] = None,
+                         gradient_predivide_factor: float = 1.0,
+                         average_aggregated_gradients: bool = True,
+                         process_set: Optional[ProcessSet] = None):
+    """Wrap a Keras 3 optimizer so ``apply`` allreduces gradients first
+    (reference: horovod/_keras/__init__.py create_distributed_optimizer).
+
+    Works with any Keras 3 backend: TF tensors bridge through
+    ``tensorflow.mpi_ops`` (eager or tf.function); JAX tracers reach the
+    engine via ``jax.pure_callback``; anything numpy-convertible takes the
+    direct path.  ``backward_passes_per_step > 1`` aggregates locally for
+    N applies and allreduces once (eager-mode python state; matches the
+    reference's LocalGradientAggregationHelper semantics)."""
+    cls = type(optimizer.__class__.__name__, (optimizer.__class__,), {
+        "apply": _distributed_apply,
+    })
+    optimizer.__class__ = cls
+    optimizer._hvd_compression = compression
+    optimizer._hvd_op = op
+    optimizer._hvd_predivide = gradient_predivide_factor
+    optimizer._hvd_process_set = process_set
+    optimizer._hvd_passes_per_step = int(backward_passes_per_step)
+    optimizer._hvd_average_aggregated = average_aggregated_gradients
+    optimizer._hvd_agg = None
+    optimizer._hvd_agg_count = 0
+    return optimizer
+
+
+def _grad_kind(g):
+    if type(g).__module__.startswith("tensorflow"):
+        return "tf"
+    try:
+        import jax
+
+        if isinstance(g, (jax.Array, jax.core.Tracer)):
+            return "jax"
+    except ImportError:
+        pass
+    return "np"
+
+
+def _distributed_apply(self, grads, trainable_variables=None):
+    op, prescale, postscale = _scale_factors(
+        self._hvd_op, self._hvd_predivide, self._hvd_process_set
+    )
+    n = self._hvd_passes_per_step
+    if n > 1:
+        def _is_traced(g):
+            if g is None:
+                return False
+            if _grad_kind(g) == "tf":
+                return not hasattr(g, "numpy")  # symbolic tf.function value
+            import jax
+
+            return isinstance(g, jax.core.Tracer)
+
+        if any(_is_traced(g) for g in grads):
+            raise RuntimeError(
+                "backward_passes_per_step > 1 aggregates in eager python "
+                "state; compile-free execution is required (e.g. "
+                "model.compile(..., run_eagerly=True))"
+            )
+        grads = [None if g is None else np.asarray(g) for g in grads]
+        if self._hvd_agg is None:
+            self._hvd_agg = [None if g is None else g.copy() for g in grads]
+        else:
+            for a, g in zip(self._hvd_agg, grads):
+                if a is not None and g is not None:
+                    a += g
+        self._hvd_agg_count += 1
+        if self._hvd_agg_count < n:
+            return  # aggregate only; no variable update this pass
+        grads = self._hvd_agg
+        if self._hvd_average_aggregated:
+            grads = [None if g is None else g / n for g in grads]
+        self._hvd_agg = None
+        self._hvd_agg_count = 0
+
+    kinds = {_grad_kind(g) for g in grads if g is not None}
+    if kinds == {"tf"}:
+        from . import mpi_ops
+
+        reduced = []
+        for i, g in enumerate(grads):
+            if g is None:
+                reduced.append(None)
+                continue
+            t, ctx = self._hvd_compression.compress(g)
+            t = mpi_ops.allreduce(
+                t, op=op, prescale_factor=prescale,
+                postscale_factor=postscale,
+                process_set=self._hvd_process_set,
+                name=f"DistributedOptimizer.{i}",
+            )
+            reduced.append(self._hvd_compression.decompress(t, ctx))
+    elif kinds == {"jax"}:
+        reduced = _allreduce_jax_grads(
+            grads, self._hvd_compression, op, prescale, postscale,
+            self._hvd_process_set,
+        )
+    else:
+        reduced = _allreduce_np_grads(
+            grads, self._hvd_compression, op, prescale, postscale,
+            self._hvd_process_set, "DistributedOptimizer",
+        )
+    return super(self.__class__, self).apply(reduced, trainable_variables)
+
+
+def _allreduce_jax_grads(grads, compression, op, prescale, postscale,
+                         process_set):
+    """JAX-backend Keras: the update runs under jit, so reach the eager
+    negotiated engine through a host callback.  Concrete (eager) arrays
+    take the direct path.  Compression happens numpy-side inside the
+    callback (fp16 on the wire, original dtype back out), so the traced
+    result shape/dtype is unchanged."""
+    import jax
+    import jax.numpy as jnp
+    from jax.core import Tracer
+
+    def host(i, a):
+        arr = np.asarray(a)
+        ctx = None
+        if compression is Compression.fp16 and arr.dtype in (
+                np.float32, np.float64):
+            ctx = arr.dtype
+            arr = arr.astype(np.float16)
+        out = np.asarray(_ops.allreduce(
+            arr, op=op, prescale_factor=prescale,
+            postscale_factor=postscale, process_set=process_set,
+            name=f"DistributedOptimizer.{i}",
+        ))
+        return out.astype(ctx) if ctx is not None else out
+
+    reduced = []
+    for i, g in enumerate(grads):
+        if g is None:
+            reduced.append(None)
+        elif isinstance(g, Tracer):
+            reduced.append(jax.pure_callback(
+                lambda a, i=i: host(i, a),
+                jax.ShapeDtypeStruct(g.shape, g.dtype), g,
+            ))
+        else:
+            reduced.append(jnp.asarray(host(i, g)))
+    return reduced

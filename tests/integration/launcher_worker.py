@@ -102,6 +102,25 @@ def main():
         )
         off += p + 1
 
+    # fused grouped allgather: mixed dtypes + uneven dim0s in ONE dim0
+    # exchange + one uneven allgather per dtype bucket
+    me_f = float(hvd.cross_rank())
+    group = [
+        jnp.full((hvd.cross_rank() + 1, 2), me_f),          # uneven f32
+        jnp.asarray([hvd.cross_rank()], jnp.int32),          # even i32
+        jnp.full((3,), 10.0 + me_f),                         # even f32
+    ]
+    g0, g1, g2 = hvd.grouped_allgather(group, name="grp_ag")
+    np.testing.assert_allclose(
+        np.asarray(g0),
+        np.concatenate([np.full((p + 1, 2), float(p)) for p in range(nproc)]),
+    )
+    np.testing.assert_array_equal(np.asarray(g1), np.arange(nproc))
+    np.testing.assert_allclose(
+        np.asarray(g2),
+        np.concatenate([np.full(3, 10.0 + p) for p in range(nproc)]),
+    )
+
     # alltoall with explicit uneven splits: rank r sends c+1 rows tagged
     # 100*r + c to peer c (reference: MPIAlltoall splits negotiation)
     me = hvd.cross_rank()

@@ -1,0 +1,102 @@
+"""Per-rank TensorFlow-adapter worker for launcher integration tests.
+
+Reference analog: test/parallel/test_tensorflow.py run under
+``horovodrun -np 2`` (SURVEY.md §4) — the same script executes on every
+rank; collective results are asserted against locally computed
+expectations.  Exercises the tf.Tensor bridge over the REAL multi-process
+negotiated engine, plus DistributedGradientTape gradient averaging and
+Keras optimizer weight consistency across ranks.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+
+import numpy as np  # noqa: E402
+import tensorflow as tf  # noqa: E402
+
+import horovod_tpu.tensorflow as hvd  # noqa: E402
+
+
+def main():
+    hvd.init()
+    nproc = hvd.cross_size()
+    assert nproc == int(sys.argv[1]), (nproc, sys.argv)
+    me = hvd.cross_rank()
+
+    # average of per-process values
+    out = hvd.allreduce(tf.constant([float(me)]))
+    np.testing.assert_allclose(out.numpy(), [np.mean(np.arange(nproc))],
+                               rtol=1e-6)
+
+    # sum with prescale, int dtype
+    out = hvd.allreduce(tf.constant([1, 2], tf.int64), op=hvd.Sum,
+                        name="tf_int_sum")
+    np.testing.assert_array_equal(out.numpy(), [nproc, 2 * nproc])
+
+    # allreduce inside tf.function (py_function bridge under tracing)
+    @tf.function
+    def compiled(x):
+        return hvd.allreduce(x, op=hvd.Sum, name="tf_graph_sum")
+
+    out = compiled(tf.constant([float(me + 1)]))
+    np.testing.assert_allclose(out.numpy(), [nproc * (nproc + 1) / 2])
+
+    # uneven allgather: rank r contributes r+1 rows
+    rows = tf.fill((me + 1, 2), float(me))
+    out = hvd.allgather(rows, name="tf_uneven_ag")
+    expected = np.concatenate(
+        [np.full((r + 1, 2), float(r)) for r in range(nproc)]
+    )
+    np.testing.assert_allclose(out.numpy(), expected)
+
+    # broadcast_variables: non-root starts different, ends with root's
+    v = tf.Variable([float(me + 1), -float(me)])
+    hvd.broadcast_variables([v], root_rank=0)
+    np.testing.assert_allclose(v.numpy(), [1.0, 0.0])
+
+    # DistributedGradientTape: per-rank losses, averaged gradients
+    w = tf.Variable([2.0])
+    with hvd.DistributedGradientTape(tf.GradientTape()) as tape:
+        loss = w[0] * float(me + 1)  # d/dw = me+1
+    g = tape.gradient(loss, [w])[0]
+    np.testing.assert_allclose(
+        g.numpy(), [np.mean(np.arange(1, nproc + 1))], rtol=1e-6
+    )
+
+    # Keras DistributedOptimizer: ranks start identical, see different
+    # grads, and must stay in lockstep after the averaged update
+    import keras
+
+    keras.utils.set_random_seed(7)  # identical init on every rank
+    model = keras.Sequential([keras.Input(shape=(3,)),
+                              keras.layers.Dense(2)])
+    opt = hvd.DistributedOptimizer(keras.optimizers.SGD(0.1))
+    x = np.full((4, 3), float(me + 1), np.float32)
+    y = np.zeros((4, 2), np.float32)
+    with tf.GradientTape() as tape:
+        pred = model(x, training=True)
+        loss = tf.reduce_mean((pred - y) ** 2)
+    grads = tape.gradient(loss, model.trainable_variables)
+    opt.apply(grads, model.trainable_variables)
+    digest = hvd.allgather_object(
+        [np.asarray(w).sum() for w in model.get_weights()]
+    )
+    for other in digest[1:]:
+        np.testing.assert_allclose(digest[0], other, rtol=1e-5)
+
+    # metric averaging
+    from horovod_tpu.keras.callbacks import MetricAverageCallback
+
+    logs = {"loss": float(me)}
+    MetricAverageCallback().on_epoch_end(0, logs)
+    np.testing.assert_allclose(logs["loss"], np.mean(np.arange(nproc)))
+
+    hvd.barrier()
+    print(f"TF_WORKER_OK rank={hvd.rank()} nproc={nproc}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -277,16 +277,23 @@ def test_elastic_fault_recovery(tmp_path):
         env=_elastic_env(), cwd=REPO,
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
     )
-    # wait until both workers are training, then kill rank 1's process
+    # wait until both workers are demonstrably TRAINING in the 2-world
+    # (not merely initialized: under load, compile time can eat a fixed
+    # sleep and the kill would land before any world-2 batch, voiding the
+    # scenario this test exists for), then kill rank 1's process
     victim_pid = None
-    deadline = time.time() + 60
+    deadline = time.time() + 120
     while time.time() < deadline and victim_pid is None:
         time.sleep(1.0)
-        for e in _read_logs(logdir):
+        events = _read_logs(logdir)
+        if not any(e["event"] == "batch" and e["world"] == 2
+                   for e in events):
+            continue
+        for e in events:
             if e["event"] == "init" and e["rank"] == 1:
                 victim_pid = e["pid"]
-    assert victim_pid, "rank 1 never initialized"
-    time.sleep(4)  # let it get into the batch loop
+    assert victim_pid, "rank 1 never trained in the 2-world"
+    time.sleep(1)
     os.kill(victim_pid, signal.SIGKILL)
     try:
         out, err = proc.communicate(timeout=240)

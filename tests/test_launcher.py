@@ -108,3 +108,23 @@ def test_tpurun_multiprocess_native_controller(np_):
     assert res.returncode == 0, f"stdout:\n{res.stdout}\nstderr:\n{res.stderr}"
     assert res.stdout.count("WORKER_OK") == np_
     assert "native=True" in res.stdout
+
+
+@pytest.mark.integration
+def test_tpurun_tensorflow_adapter():
+    """TF/Keras adapter under 2 real processes: tf.Tensor bridge, graph
+    mode, DistributedGradientTape averaging, Keras optimizer lockstep
+    (reference analog: test/parallel/test_tensorflow.py under
+    horovodrun -np 2)."""
+    tf_worker = os.path.join(REPO, "tests", "integration", "tf_worker.py")
+    env = os.environ.copy()
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)
+    cmd = [sys.executable, "-m", "horovod_tpu.runner", "-np", "2", "--",
+           sys.executable, tf_worker, "2"]
+    res = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                         timeout=420, cwd=REPO)
+    assert res.returncode == 0, f"stdout:\n{res.stdout}\nstderr:\n{res.stderr[-4000:]}"
+    assert res.stdout.count("TF_WORKER_OK") == 2

@@ -1,0 +1,163 @@
+"""TensorFlow adapter tests.
+
+Reference analog: test/parallel/test_tensorflow.py (SURVEY.md §4) —
+collectives on tf tensors in eager and tf.function (graph) modes,
+DistributedGradientTape, variable broadcast, compression, elastic state.
+Single-process world here (per-rank semantics are covered by the launcher
+integration tests); these verify the adapter's bridging and wrappers.
+"""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+import horovod_tpu.tensorflow as hvd  # noqa: E402
+
+
+def test_allreduce_eager_roundtrip():
+    t = tf.reshape(tf.range(6, dtype=tf.float32), (2, 3))
+    out = hvd.allreduce(t)
+    assert isinstance(out, tf.Tensor)
+    assert out.dtype == t.dtype
+    np.testing.assert_allclose(out.numpy(), t.numpy())  # world of 1
+
+
+def test_allreduce_int_dtype_preserved():
+    t = tf.range(5, dtype=tf.int64)
+    out = hvd.allreduce(t, op=hvd.Sum)
+    assert out.dtype == tf.int64
+    np.testing.assert_array_equal(out.numpy(), t.numpy())
+
+
+def test_allreduce_prescale():
+    out = hvd.allreduce(tf.ones(3), op=hvd.Sum, prescale_factor=2.0)
+    np.testing.assert_allclose(out.numpy(), np.full((3,), 2.0))
+
+
+def test_allreduce_inside_tf_function():
+    @tf.function
+    def f(x):
+        return hvd.allreduce(x, op=hvd.Sum, name="graph_allreduce")
+
+    x = tf.constant([1.0, 2.0, 3.0])
+    out = f(x)
+    assert out.shape == x.shape  # shape re-asserted through py_function
+    np.testing.assert_allclose(out.numpy(), x.numpy())
+
+
+def test_grouped_allreduce_eager_and_graph():
+    ts = [tf.ones(2), tf.fill((3,), 2.0)]
+    outs = hvd.grouped_allreduce(ts)
+    assert len(outs) == 2
+    np.testing.assert_allclose(outs[1].numpy(), np.full((3,), 2.0))
+
+    @tf.function
+    def f(a, b):
+        return hvd.grouped_allreduce([a, b], name="graph_grouped")
+
+    outs = f(*ts)
+    np.testing.assert_allclose(outs[0].numpy(), np.ones(2))
+
+
+def test_allgather_broadcast_alltoall():
+    t = tf.range(4, dtype=tf.float32)
+    np.testing.assert_allclose(hvd.allgather(t).numpy(), t.numpy())
+    np.testing.assert_allclose(hvd.broadcast(t, root_rank=0).numpy(),
+                               t.numpy())
+    received, splits = hvd.alltoall(t)
+    np.testing.assert_allclose(received.numpy(), t.numpy())
+    assert int(tf.reduce_sum(splits)) == 4
+
+
+def test_reducescatter_world1():
+    t = tf.reshape(tf.range(8, dtype=tf.float32), (4, 2))
+    out = hvd.reducescatter(t, op=hvd.Sum)
+    np.testing.assert_allclose(out.numpy(), t.numpy())
+
+
+def test_broadcast_variables():
+    vs = [tf.Variable([1.0, 2.0]), tf.Variable(3.0)]
+    hvd.broadcast_variables(vs, root_rank=0)
+    np.testing.assert_allclose(vs[0].numpy(), [1.0, 2.0])
+    np.testing.assert_allclose(vs[1].numpy(), 3.0)
+
+
+def test_broadcast_and_allgather_object():
+    assert hvd.broadcast_object({"a": 1}, root_rank=0) == {"a": 1}
+    assert hvd.allgather_object(("x", 2)) == [("x", 2)]
+
+
+def test_distributed_gradient_tape_matches_local():
+    v = tf.Variable([1.0, 2.0, 3.0])
+    with tf.GradientTape() as plain:
+        loss = tf.reduce_sum(v * v)
+    expected = plain.gradient(loss, [v])[0]
+
+    with hvd.DistributedGradientTape(tf.GradientTape()) as tape:
+        loss = tf.reduce_sum(v * v)
+    got = tape.gradient(loss, [v])[0]
+    np.testing.assert_allclose(got.numpy(), expected.numpy())
+
+
+def test_distributed_gradient_tape_fp16_compression():
+    v = tf.Variable([1.0, 2.0])
+    with hvd.DistributedGradientTape(
+            tf.GradientTape(), compression=hvd.Compression.fp16) as tape:
+        loss = tf.reduce_sum(v * 3.0)
+    g = tape.gradient(loss, [v])[0]
+    assert g.dtype == tf.float32  # decompressed back
+    np.testing.assert_allclose(g.numpy(), [3.0, 3.0])
+
+
+def test_distributed_gradient_tape_num_groups():
+    vs = [tf.Variable(tf.ones((2,))), tf.Variable(tf.ones((3,))),
+          tf.Variable(tf.ones((4,)))]
+    with hvd.DistributedGradientTape(
+            tf.GradientTape(), num_groups=2) as tape:
+        loss = tf.add_n([tf.reduce_sum(v) * (i + 1)
+                         for i, v in enumerate(vs)])
+    grads = tape.gradient(loss, vs)
+    for i, (g, v) in enumerate(zip(grads, vs)):
+        np.testing.assert_allclose(g.numpy(), np.full(v.shape, i + 1.0))
+
+
+def test_distributed_gradient_tape_in_tf_function():
+    v = tf.Variable([2.0, 4.0])
+
+    @tf.function
+    def step():
+        with hvd.DistributedGradientTape(tf.GradientTape()) as tape:
+            loss = tf.reduce_sum(v * v)
+        return tape.gradient(loss, [v])[0]
+
+    np.testing.assert_allclose(step().numpy(), [4.0, 8.0])
+
+
+def test_gradient_predivide_factor_world1():
+    v = tf.Variable([3.0])
+    with hvd.DistributedGradientTape(
+            tf.GradientTape(), gradient_predivide_factor=2.0) as tape:
+        loss = tf.reduce_sum(v * 2.0)
+    g = tape.gradient(loss, [v])[0]
+    # world of 1: pre 1/2 then post 2/1 is identity
+    np.testing.assert_allclose(g.numpy(), [2.0])
+
+
+def test_tensorflow_keras_state_roundtrip():
+    keras = pytest.importorskip("keras")
+    model = keras.Sequential([keras.layers.Dense(2, input_shape=(3,))])
+    state = hvd.elastic.TensorFlowKerasState(model=model, epoch=0)
+    w0 = [np.array(w) for w in model.get_weights()]
+    state.commit()
+    model.set_weights([w * 0 + 7.0 for w in w0])
+    state.epoch = 5
+    state.restore()
+    for got, want in zip(model.get_weights(), w0):
+        np.testing.assert_allclose(got, want)
+    assert state.epoch == 0
+
+
+def test_join_and_barrier():
+    hvd.barrier()
+    assert hvd.join() == hvd.rank()
