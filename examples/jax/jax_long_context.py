@@ -43,11 +43,21 @@ def main():
     ring = jax.jit(jax.shard_map(
         lambda a, b_, c: ring_attention(a, b_, c, axis_name=axis),
         mesh=mesh, **specs))
+    # flash-block ring: the TPU path (pallas kernels; interpret-mode and
+    # slow on CPU, so the demo uses it only on real chips)
+    ring_flash = jax.jit(jax.shard_map(
+        lambda a, b_, c: ring_attention(a, b_, c, axis_name=axis,
+                                        impl="flash"),
+        mesh=mesh, **specs))
     ulysses = jax.jit(jax.shard_map(
         lambda a, b_, c: ulysses_attention(a, b_, c, axis_name=axis),
         mesh=mesh, **specs))
 
-    for name, fn in [("ring", ring), ("ulysses", ulysses)]:
+    variants = [("ring", ring), ("ulysses", ulysses)]
+    if jax.default_backend() == "tpu":
+        variants.insert(1, ("ring_flash", ring_flash))
+
+    for name, fn in variants:
         out = jax.block_until_ready(fn(q, k, v))  # compile + run
         t0 = time.perf_counter()
         for _ in range(3):

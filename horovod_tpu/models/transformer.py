@@ -12,7 +12,10 @@ same role, designed so sequence parallelism can shard the context:
     (parallel/ring_attention.py): the sequence dimension is sharded and
     KV blocks rotate via ``ppermute``, enabling contexts far beyond one
     chip's HBM.  The reference has no analog (SURVEY.md §5.7) — it only
-    ships the alltoall/allgather primitives such schemes build on.
+    ships the alltoall/allgather primitives such schemes build on;
+  * ``attention_impl='ring_flash'`` — same ring schedule with each block
+    computed by the pallas flash kernels (no (S/n)² logits in HBM even
+    within a block).
 
 bfloat16 activations, float32 params; RoPE positions; pre-norm blocks.
 """
@@ -90,10 +93,14 @@ class Attention(nn.Module):
         v = dense(features=(cfg.num_heads, cfg.head_dim), name="v")(x)
         q = rope(q, positions)
         k = rope(k, positions)
-        if cfg.attention_impl == "ring":
+        if cfg.attention_impl in ("ring", "ring_flash"):
             from ..parallel.ring_attention import ring_attention
 
-            out = ring_attention(q, k, v, axis_name=cfg.seq_axis_name)
+            out = ring_attention(
+                q, k, v, axis_name=cfg.seq_axis_name,
+                impl="flash" if cfg.attention_impl == "ring_flash"
+                else "dense",
+            )
         elif cfg.attention_impl == "flash":
             from ..ops.flash_attention import flash_attention
 
@@ -144,7 +151,8 @@ class Transformer(nn.Module):
         cfg = self.cfg
         if positions is None:
             local = jnp.arange(tokens.shape[1])
-            if cfg.attention_impl == "ring" and cfg.seq_axis_name:
+            if cfg.attention_impl in ("ring", "ring_flash") and \
+                    cfg.seq_axis_name:
                 # sequence is sharded over the axis: global position =
                 # shard_index * S_local + local offset (RoPE must match
                 # the global causal offsets ring_attention masks with)

@@ -275,36 +275,23 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _backward_impl(q, k, v, out, lse, g, causal, block_q, block_k,
-                   interpret):
-    b, s, h, d = q.shape
-    orig_s = s
-    block_q, block_k = _clamp_blocks(s, block_q, block_k)
-    # delta = rowsum(dO * O) per (bh, row): O(S) memory, plain jnp
-    delta = jnp.sum(
-        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
-    )  # (B, S, H)
-    delta = delta.transpose(0, 2, 1).reshape(b * h, s, 1)
-    qp = _pad_to(q, block_q, axis=1)
-    kp = _pad_to(k, block_k, axis=1)
-    vp = _pad_to(v, block_k, axis=1)
-    gp = _pad_to(g, block_q, axis=1)
-    s_q, s_k = qp.shape[1], kp.shape[1]
-    qf, kf, vf, gf = (
-        _fold(qp, b, h, d), _fold(kp, b, h, d), _fold(vp, b, h, d),
-        _fold(gp, b, h, d),
-    )
-    # lse comes from the forward already folded and padded to this same
-    # s_q (identical block clamp on identical shapes)
-    lse_f = lse
-    delta_f = _pad_to(delta, block_q, axis=1)  # (BH, s_q, 1)
+def _backward_folded(qf, kf, vf, gf, lse_f, delta_f, *, orig_s, causal,
+                     block_q, block_k, interpret):
+    """Backward kernels over already folded+padded operands — the ring
+    calls this directly so the fold/pad of the step-invariant q/g/lse/
+    delta happens once, not once per ring step.  Shapes: qf/gf
+    (BH, s_q, d), kf/vf (BH, s_k, d), lse_f/delta_f (BH, s_q, 1).
+    Returns folded (dq, dk, dv)."""
+    bh, s_q, d = qf.shape
+    s_k = kf.shape[1]
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     kw = dict(sm_scale=1.0 / (d ** 0.5), causal=causal, block_q=block_q,
               block_k=block_k, seq_len=orig_s)
+    b_h = bh  # grid leading dim
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, **kw),
-        grid=(b * h, s_q // block_q),
+        grid=(b_h, s_q // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
             pl.BlockSpec((1, s_k, d), lambda bh, qi: (bh, 0, 0)),
@@ -314,12 +301,12 @@ def _backward_impl(q, k, v, out, lse, g, causal, block_q, block_k,
             pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b_h, s_q, d), qf.dtype),
         interpret=interpret,
     )(qf, kf, vf, gf, lse_f, delta_f)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, **kw),
-        grid=(b * h, s_k // block_k),
+        grid=(b_h, s_k // block_k),
         in_specs=[
             pl.BlockSpec((1, s_q, d), lambda bh, ki: (bh, 0, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
@@ -333,15 +320,87 @@ def _backward_impl(q, k, v, out, lse, g, causal, block_q, block_k,
             pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, s_k, d), k.dtype),
-            jax.ShapeDtypeStruct((b * h, s_k, d), v.dtype),
+            jax.ShapeDtypeStruct((b_h, s_k, d), kf.dtype),
+            jax.ShapeDtypeStruct((b_h, s_k, d), vf.dtype),
         ],
         interpret=interpret,
     )(qf, kf, vf, gf, lse_f, delta_f)
+    return dq, dk, dv
+
+
+def _fold_bwd_invariants(q, out, lse, g, block_q):
+    """Fold+pad the step-invariant backward operands (q, g, lse, and
+    delta = rowsum(dO·O)) once; shared by self-attention backward and the
+    ring (which reuses them across every ring step)."""
+    b, s, h, d = q.shape
+    delta = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )  # (B, S, H)
+    delta = delta.transpose(0, 2, 1).reshape(b * h, s, 1)
+    qf = _fold(_pad_to(q, block_q, axis=1), b, h, d)
+    gf = _fold(_pad_to(g, block_q, axis=1), b, h, d)
+    delta_f = _pad_to(delta, block_q, axis=1)
+    lse_f = _pad_to(lse, block_q, axis=1)
+    return qf, gf, lse_f, delta_f
+
+
+def _backward_impl(q, k, v, out, lse, g, causal, block_q, block_k,
+                   interpret):
+    b, s, h, d = q.shape
+    orig_s = s
+    block_q, block_k = _clamp_blocks(s, block_q, block_k)
+    # lse arrives from the forward already folded and padded to the same
+    # s_q (identical block clamp on identical shapes) — _fold_bwd_
+    # invariants' pad is then a no-op on it
+    qf, gf, lse_f, delta_f = _fold_bwd_invariants(q, out, lse, g, block_q)
+    kf = _fold(_pad_to(k, block_k, axis=1), b, h, d)
+    vf = _fold(_pad_to(v, block_k, axis=1), b, h, d)
+    s_q, s_k = qf.shape[1], kf.shape[1]
+    dq, dk, dv = _backward_folded(
+        qf, kf, vf, gf, lse_f, delta_f, orig_s=orig_s, causal=causal,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+    )
     dq = _unfold(dq, b, h, s_q, d)[:, :orig_s]
     dk = _unfold(dk, b, h, s_k, d)[:, :orig_s]
     dv = _unfold(dv, b, h, s_k, d)[:, :orig_s]
     return dq, dk, dv
+
+
+# -- block-level entry points (ring attention building blocks) --------------
+#
+# Ring attention combines per-KV-block partial attentions across mesh
+# steps, so it needs (a) the normalized block output TOGETHER with its
+# logsumexp (to rescale when merging blocks) and (b) block backward passes
+# driven by the GLOBAL lse/out (FlashAttention-2 decomposes exactly this
+# way: each (Q block, KV block) pair's dq/dk/dv depends only on the final
+# per-row logsumexp and delta).
+
+
+def flash_block_forward(q, k, v, causal, block_q=256, block_k=256,
+                        interpret=None):
+    """Returns (out, lse) with out (B,S,H,D) normalized within this KV
+    block and lse (B,S,H) float32 = log-sum-exp of this block's logits."""
+    b, s, h, d = q.shape
+    out, lse_f = _forward_impl(
+        q, k, v, causal, block_q, block_k, interpret, with_lse=True
+    )
+    lse = lse_f[:, :, 0].reshape(b, h, -1)[:, :, :s].transpose(0, 2, 1)
+    return out, lse
+
+
+def flash_block_backward(q, k, v, out, lse, g, causal, block_q=256,
+                         block_k=256, interpret=None):
+    """Per-block backward against the GLOBAL (out, lse): returns this
+    block's (dq, dk, dv) contributions.  lse is (B,S,H) float32 as
+    produced by the ring combine; out/g are the final output/cotangent."""
+    b, s, h, d = q.shape
+    bq, _ = _clamp_blocks(s, block_q, block_k)
+    lse_f = _pad_to(
+        lse.transpose(0, 2, 1).reshape(b * h, s, 1), bq, axis=1
+    )
+    return _backward_impl(
+        q, k, v, out, lse_f, g, causal, block_q, block_k, interpret
+    )
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))

@@ -17,6 +17,7 @@ matmuls in the compute dtype; softmax statistics stay in float32.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import jax
@@ -59,6 +60,7 @@ def ring_attention(
     k: jax.Array,
     v: jax.Array,
     axis_name: Optional[str] = None,
+    impl: str = "dense",
 ) -> jax.Array:
     """Exact causal attention with K/V rotating around the mesh axis.
 
@@ -68,9 +70,19 @@ def ring_attention(
       axis_name: mesh axis the sequence is sharded over (must be bound,
         i.e. called inside shard_map).  ``None`` falls back to the world
         axis.
+      impl: ``"dense"`` computes each K/V block with XLA einsums
+        (materializes (S/n)² logits per step); ``"flash"`` runs each block
+        through the pallas flash kernels (``ops.flash_attention``) so NO
+        logits tile ever hits HBM — per-chip attention memory is O(S/n)
+        even inside a block, which is what lets block sizes grow with
+        long contexts.
     Returns:
       (B, S_local, H, D) attention output for the local Q shard.
     """
+    if impl == "flash":
+        return ring_flash_attention(q, k, v, axis_name)
+    if impl != "dense":
+        raise ValueError(f"unknown ring attention impl {impl!r}")
     axis = axis_name or WORLD_AXIS
     n = jax.lax.axis_size(axis)
     idx = jax.lax.axis_index(axis)
@@ -98,3 +110,143 @@ def ring_attention(
     # causal rows always see at least the diagonal, so l > 0 everywhere
     out = o / l[..., None]
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+
+# -- flash-block ring attention ---------------------------------------------
+#
+# Same ring schedule, but every (Q shard, K/V block) pair runs through the
+# pallas flash kernels: VMEM-resident online softmax inside the block, so
+# not even the (S/n x S/n) per-step logits tile is materialized in HBM.
+# Partial block outputs merge by their logsumexps (exact).  Backward
+# re-rotates K/V and uses FlashAttention-2's decomposition: with the
+# final (out, lse) fixed, each block's (dq, dk, dv) contribution is
+# independent, and the dk/dv accumulators travel around the ring WITH
+# their K/V block, arriving home after a full revolution.
+
+
+def _ring_flash_fwd(q, k, v, axis, block_q, block_k):
+    from ..ops.flash_attention import flash_block_forward
+
+    n = jax.lax.axis_size(axis)
+    idx = jax.lax.axis_index(axis)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    o0, lse0 = flash_block_forward(
+        q, k, v, causal=True, block_q=block_q, block_k=block_k
+    )
+
+    def step(t, carry):
+        o, lse, kk, vv = carry
+        kk = jax.lax.ppermute(kk, axis, perm)
+        vv = jax.lax.ppermute(vv, axis, perm)
+        src = (idx - t) % n  # whose K/V block this chip now holds
+        past = src < idx  # strictly-past blocks attend fully; future: none
+        o_t, lse_t = flash_block_forward(
+            q, kk, vv, causal=False, block_q=block_q, block_k=block_k
+        )
+        lse_t = jnp.where(past, lse_t, _NEG_INF)
+        new_lse = jnp.logaddexp(lse, lse_t)
+        a = jnp.exp(lse - new_lse)[..., None]
+        c = jnp.exp(lse_t - new_lse)[..., None]
+        o = o * a + o_t.astype(jnp.float32) * c
+        return o, new_lse, kk, vv
+
+    o, lse, _, _ = jax.lax.fori_loop(
+        1, n, step, (o0.astype(jnp.float32), lse0, k, v)
+    )
+    return o.astype(q.dtype), lse
+
+
+def _ring_flash_bwd_impl(q, k, v, out, lse, g, axis, block_q, block_k):
+    from ..ops import flash_attention as fa
+
+    n = jax.lax.axis_size(axis)
+    idx = jax.lax.axis_index(axis)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    b, s, h, d = q.shape
+
+    # fold/pad the step-invariant operands (q, g, lse, delta) ONCE; only
+    # the folded K/V (and their gradient accumulators) travel the ring
+    bq, bk = fa._clamp_blocks(s, block_q, block_k)
+    lse_col = lse.transpose(0, 2, 1).reshape(b * h, s, 1)
+    qf, gf, lse_f, delta_f = fa._fold_bwd_invariants(q, out, lse_col, g, bq)
+    kf = fa._fold(fa._pad_to(k, bk, axis=1), b, h, d)
+    vf = fa._fold(fa._pad_to(v, bk, axis=1), b, h, d)
+    s_q, s_k = qf.shape[1], kf.shape[1]
+
+    def block_bwd(kf_, vf_, causal):
+        return fa._backward_folded(
+            qf, kf_, vf_, gf, lse_f, delta_f, orig_s=s, causal=causal,
+            block_q=bq, block_k=bk, interpret=None,
+        )
+
+    dq0, dk0, dv0 = block_bwd(kf, vf, True)
+
+    def step(t, carry):
+        dq, dk_acc, dv_acc, kk, vv = carry
+        kk = jax.lax.ppermute(kk, axis, perm)
+        vv = jax.lax.ppermute(vv, axis, perm)
+        dk_acc = jax.lax.ppermute(dk_acc, axis, perm)
+        dv_acc = jax.lax.ppermute(dv_acc, axis, perm)
+        src = (idx - t) % n
+        past = src < idx
+        dq_t, dk_t, dv_t = block_bwd(kk, vv, False)
+        dq = dq + jnp.where(past, dq_t.astype(jnp.float32), 0.0)
+        dk_acc = dk_acc + jnp.where(past, dk_t.astype(jnp.float32), 0.0)
+        dv_acc = dv_acc + jnp.where(past, dv_t.astype(jnp.float32), 0.0)
+        return dq, dk_acc, dv_acc, kk, vv
+
+    dq, dk_acc, dv_acc, _, _ = jax.lax.fori_loop(
+        1, n, step,
+        (dq0.astype(jnp.float32), dk0.astype(jnp.float32),
+         dv0.astype(jnp.float32), kf, vf),
+    )
+    # accumulators have rotated n-1 steps with their K/V block; one more
+    # hop returns each block's gradient to its home chip
+    dk_acc = jax.lax.ppermute(dk_acc, axis, perm)
+    dv_acc = jax.lax.ppermute(dv_acc, axis, perm)
+    dq = fa._unfold(dq, b, h, s_q, d)[:, :s]
+    dk = fa._unfold(dk_acc, b, h, s_k, d)[:, :s]
+    dv = fa._unfold(dv_acc, b, h, s_k, d)[:, :s]
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_flash(q, k, v, axis, block_q, block_k):
+    out, _ = _ring_flash_fwd(q, k, v, axis, block_q, block_k)
+    return out
+
+
+def _ring_flash_fwd_vjp(q, k, v, axis, block_q, block_k):
+    out, lse = _ring_flash_fwd(q, k, v, axis, block_q, block_k)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd_vjp(axis, block_q, block_k, residuals, g):
+    q, k, v, out, lse = residuals
+    return _ring_flash_bwd_impl(
+        q, k, v, out, lse, g, axis, block_q, block_k
+    )
+
+
+_ring_flash.defvjp(_ring_flash_fwd_vjp, _ring_flash_bwd_vjp)
+
+
+def ring_flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: Optional[str] = None,
+    block_q: int = 256,
+    block_k: int = 256,
+) -> jax.Array:
+    """Ring attention whose per-block compute is the pallas flash kernel
+    (see module docstring).  Differentiable; numerics match
+    ``ring_attention(..., impl="dense")`` and the single-chip oracle."""
+    axis = axis_name or WORLD_AXIS
+    if jax.lax.axis_size(axis) == 1:
+        from ..ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=True, block_q=block_q,
+                               block_k=block_k)
+    return _ring_flash(q, k, v, axis, block_q, block_k)

@@ -48,4 +48,5 @@ from .mpi_ops import (  # noqa: F401
 from .optimizer import (  # noqa: F401
     DistributedGradientTape, DistributedOptimizer,
 )
+from .sync_batch_norm import SyncBatchNormalization  # noqa: F401
 from . import elastic  # noqa: F401

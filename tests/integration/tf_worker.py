@@ -86,6 +86,23 @@ def main():
     for other in digest[1:]:
         np.testing.assert_allclose(digest[0], other, rtol=1e-5)
 
+    # SyncBatchNorm: stats over the GLOBAL batch.  Rank r feeds constant
+    # (r+1); global mean over ranks' equal-sized batches = mean(1..nproc)
+    sbn = hvd.SyncBatchNormalization(axis=-1, epsilon=0.0, center=False,
+                                     scale=False, momentum=0.0)
+    xb = np.full((2, 3, 1), float(me + 1), np.float32)
+    out = sbn(xb, training=True)
+    g_mean = np.mean(np.arange(1, nproc + 1))
+    g_var = np.mean((np.arange(1, nproc + 1) - g_mean) ** 2)
+    np.testing.assert_allclose(
+        np.asarray(out),
+        (xb - g_mean) / np.sqrt(g_var) if nproc > 1 else np.zeros_like(xb),
+        rtol=1e-4, atol=1e-4,
+    )
+    np.testing.assert_allclose(
+        np.asarray(sbn.moving_mean), [g_mean], rtol=1e-5
+    )
+
     # metric averaging
     from horovod_tpu.keras.callbacks import MetricAverageCallback
 
