@@ -388,21 +388,6 @@ def flash_block_forward(q, k, v, causal, block_q=256, block_k=256,
     return out, lse
 
 
-def flash_block_backward(q, k, v, out, lse, g, causal, block_q=256,
-                         block_k=256, interpret=None):
-    """Per-block backward against the GLOBAL (out, lse): returns this
-    block's (dq, dk, dv) contributions.  lse is (B,S,H) float32 as
-    produced by the ring combine; out/g are the final output/cotangent."""
-    b, s, h, d = q.shape
-    bq, _ = _clamp_blocks(s, block_q, block_k)
-    lse_f = _pad_to(
-        lse.transpose(0, 2, 1).reshape(b * h, s, 1), bq, axis=1
-    )
-    return _backward_impl(
-        q, k, v, out, lse_f, g, causal, block_q, block_k, interpret
-    )
-
-
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash(q, k, v, causal, block_q, block_k, interpret):
     return _forward_impl(q, k, v, causal, block_q, block_k, interpret)

@@ -9,9 +9,11 @@ no pyspark, so the integration is scoped to:
     Spark tasks, each joined into the framework's world; without pyspark
     it raises ImportError with guidance (use ``horovod_tpu.ray
     .RayExecutor`` or ``tpurun`` for the same contract locally).
-  * Estimators: :mod:`horovod_tpu.spark.keras` (``KerasEstimator`` — the
-    flax analog) and :mod:`horovod_tpu.spark.torch` (``TorchEstimator``)
-    implement the reference's fit(df) -> Transformer contract over a
+  * Estimators: :mod:`horovod_tpu.spark.keras` (``KerasEstimator`` — a
+    real Keras 3 estimator trained through the Keras adapter;
+    ``FlaxEstimator`` for flax modules) and
+    :mod:`horovod_tpu.spark.torch` (``TorchEstimator``) implement the
+    reference's fit(df) -> Transformer contract over a
     :mod:`~horovod_tpu.spark.store` Store, training across launcher-
     managed subprocess workers (the Spark-barrier transport being
     pyspark-gated in this image).
@@ -23,7 +25,8 @@ import socket
 from typing import Any, Callable, List, Optional
 
 from .estimator import (  # noqa: F401
-    FlaxEstimator, FlaxModel, TorchEstimator, TorchModel,
+    FlaxEstimator, FlaxModel, KerasEstimator, KerasModel, TorchEstimator,
+    TorchModel,
 )
 from .store import (  # noqa: F401
     GCSStore, HDFSStore, LocalStore, S3Store, Store,

@@ -239,6 +239,88 @@ def _train_torch(spec, store, rank):
         _write_history(store, spec, history)
 
 
+def _train_keras(spec, store, rank):
+    os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+    import keras
+
+    import horovod_tpu.keras as hvd_keras
+
+    extra = spec["extra"]
+    # honor the estimator's seed like the flax/torch workers do: keras
+    # fit(shuffle=True) and any deferred-build init draw from the global
+    # RNGs this seeds
+    keras.utils.set_random_seed(spec["seed"])
+    model = keras.models.model_from_json(extra["model_json"])
+    shard = _load_shard(store, spec, rank)
+    feats = [np.asarray(shard[c], np.float32)
+             for c in spec["feature_cols"]]
+    x = feats[0] if len(feats) == 1 else feats
+    y = np.asarray(shard[spec["label_cols"][0]])
+
+    # identical start on every rank: the estimator's initial weights ride
+    # the spec (reference: the estimator broadcasts the driver's model).
+    # A deferred-build driver model ships no weights — then build against
+    # the data and broadcast rank 0's init (per-process random inits
+    # would silently train against divergent parameters)
+    if extra["weights"]:
+        model.set_weights([np.asarray(w) for w in extra["weights"]])
+    else:
+        model(feats[0][:1] if len(feats) == 1
+              else [f[:1] for f in feats])  # build
+        hvd_keras.broadcast_model_weights(model, root_rank=0)
+    # capture the BUILT architecture before compile() attaches the
+    # DistributedOptimizer (whose dynamic subclass can't deserialize
+    # elsewhere): a deferred-build driver config could not rebuild with
+    # trained weights on the transformer side
+    built_json = model.to_json()
+    opt = extra["optimizer"]
+    if isinstance(opt, dict):
+        opt = keras.optimizers.deserialize(opt)
+    else:
+        opt = keras.optimizers.get(opt)
+    model.compile(
+        optimizer=hvd_keras.DistributedOptimizer(opt), loss=extra["loss"]
+    )
+
+    # per-epoch validation on rank 0 only (evaluate issues no collectives,
+    # so the asymmetry cannot desynchronize the ranks)
+    val_losses = []
+    callbacks = []
+    if hvd_keras.cross_rank() == 0:
+        val = _load_val(store, spec)
+        if val is not None:
+            vfeats = [np.asarray(val[c], np.float32)
+                      for c in spec["feature_cols"]]
+            vx = vfeats[0] if len(vfeats) == 1 else vfeats
+            vy = np.asarray(val[spec["label_cols"][0]])
+
+            class _ValCallback(keras.callbacks.Callback):
+                def on_epoch_end(cb_self, epoch, logs=None):
+                    val_losses.append(
+                        float(cb_self.model.evaluate(vx, vy, verbose=0))
+                    )
+
+            callbacks.append(_ValCallback())
+
+    hist = model.fit(
+        x, y, batch_size=spec["batch_size"], epochs=spec["epochs"],
+        shuffle=True, verbose=spec["verbose"], callbacks=callbacks,
+    )
+
+    if hvd_keras.cross_rank() == 0:
+        history = {"loss": [float(v) for v in hist.history.get("loss", [])],
+                   "val_loss": val_losses}
+        store.write_bytes(
+            os.path.join(store.get_checkpoint_path(spec["run_id"]),
+                         "model.bin"),
+            pickle.dumps({
+                "config": built_json,
+                "weights": [np.asarray(w) for w in model.get_weights()],
+            }),
+        )
+        _write_history(store, spec, history)
+
+
 def main() -> int:
     payload_path = sys.argv[1]
     with open(payload_path, "rb") as f:
@@ -253,6 +335,8 @@ def main() -> int:
         _train_flax(spec, store, rank)
     elif spec["kind"] == "torch":
         _train_torch(spec, store, rank)
+    elif spec["kind"] == "keras":
+        _train_keras(spec, store, rank)
     else:
         raise ValueError(f"unknown estimator kind {spec['kind']!r}")
     hvd.barrier()  # rank 0's checkpoint write completes before exit

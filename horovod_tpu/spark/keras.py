@@ -1,13 +1,12 @@
 """Reference-name surface: ``horovod.spark.keras`` (SURVEY.md §2.4).
 
-Keras itself is TF-bound and absent from this stack; flax is the
-high-level model library here, so ``KerasEstimator`` is the
-:class:`~horovod_tpu.spark.estimator.FlaxEstimator` under the reference's
-import path — same fit(df) -> Transformer contract and Store layout
-(documented divergence, like callbacks.py re-expressing the Keras
-callbacks for optax/flax)."""
+``KerasEstimator``/``KerasModel`` train a REAL Keras 3 model across the
+estimator worker fleet (architecture travels as JSON + numpy weights;
+workers wrap the optimizer in the Keras adapter's DistributedOptimizer).
+The earlier flax stand-in remains available for flax modules.
+"""
 
-from .estimator import FlaxEstimator as KerasEstimator  # noqa: F401
-from .estimator import FlaxModel as KerasModel  # noqa: F401
+from .estimator import FlaxEstimator, FlaxModel  # noqa: F401
+from .estimator import KerasEstimator, KerasModel  # noqa: F401
 
-__all__ = ["KerasEstimator", "KerasModel"]
+__all__ = ["KerasEstimator", "KerasModel", "FlaxEstimator", "FlaxModel"]

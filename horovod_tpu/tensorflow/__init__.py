@@ -45,6 +45,7 @@ from .mpi_ops import (  # noqa: F401
     allgather, allreduce, alltoall, barrier, broadcast, grouped_allreduce,
     join, reducescatter,
 )
+from .gradient_aggregation import LocalGradientAggregationHelper  # noqa: F401
 from .optimizer import (  # noqa: F401
     DistributedGradientTape, DistributedOptimizer,
 )

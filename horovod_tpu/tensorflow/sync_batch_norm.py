@@ -37,6 +37,16 @@ def _allreduce_sum(x, name, process_set):
     HorovodAllreduceOp (every rank backprops its local loss; summing the
     cotangents yields the global-loss gradient)."""
     kind = _grad_kind(x)
+    if type(x).__module__.startswith("torch"):
+        # no registered gradient on the numpy fallback: np.asarray on a
+        # grad-requiring torch tensor raises, and a detached constant
+        # would silently zero d(loss)/d(stats)
+        raise NotImplementedError(
+            "SyncBatchNormalization supports the tensorflow and jax Keras "
+            "backends; the torch backend's stats allreduce has no "
+            "gradient path (use horovod_tpu.torch.SyncBatchNorm for "
+            "torch models)"
+        )
     if kind == "tf":
         import tensorflow as tf
 

@@ -309,3 +309,36 @@ def test_ring_flash_attention_gradients_match_dense():
         np.testing.assert_allclose(
             np.asarray(g_got), np.asarray(g_want), rtol=1e-3, atol=1e-4
         )
+
+
+def test_transformer_remat_matches_no_remat():
+    """cfg.remat trades FLOPs for memory; numerics must be identical."""
+    import optax
+    from horovod_tpu.models.transformer import gpt_tiny
+
+    tok = jnp.asarray(
+        np.random.RandomState(0).randint(0, 256, size=(2, 32))
+    )
+    grads = {}
+    for remat in (False, True):
+        cfg = gpt_tiny(dtype=jnp.float32, remat=remat)
+        model = Transformer(cfg)
+        params = model.init(jax.random.PRNGKey(0), tok)
+
+        def loss_fn(p):
+            logits = model.apply(p, tok)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], tok[:, 1:]
+            ).mean()
+
+        loss, g = jax.value_and_grad(loss_fn)(params)
+        grads[remat] = (loss, g)
+    np.testing.assert_allclose(
+        float(grads[False][0]), float(grads[True][0]), rtol=1e-6
+    )
+    flat_a = jax.tree_util.tree_leaves(grads[False][1])
+    flat_b = jax.tree_util.tree_leaves(grads[True][1])
+    for a, b in zip(flat_a, flat_b):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        )

@@ -255,3 +255,39 @@ def test_sync_bn_allreduce_helper_has_gradient():
     np.testing.assert_allclose(np.asarray(g), [1.0, 10.0, 100.0])
     g = jax.grad(lambda t: jax.jit(f)(t))(jnp.asarray([1.0, 2.0, 3.0]))
     np.testing.assert_allclose(np.asarray(g), [1.0, 10.0, 100.0])
+
+
+def test_local_gradient_aggregation_in_tf_function():
+    """Graph-mode backward_passes_per_step: updates land only every Nth
+    pass, with the aggregate averaged over the window (reference:
+    LocalGradientAggregationHelper)."""
+    from horovod_tpu.tensorflow.gradient_aggregation import (
+        LocalGradientAggregationHelper,
+    )
+
+    v = tf.Variable([10.0])
+    opt = tf.keras.optimizers.SGD(1.0)
+    agg = LocalGradientAggregationHelper(
+        backward_passes_per_step=2,
+        allreduce_func=lambda gs: [
+            hvd.allreduce(g, op=hvd.Average, name=f"agg_test.{i}")
+            for i, g in enumerate(gs)
+        ],
+    )
+
+    @tf.function
+    def step(grad_value):
+        grads = [tf.constant([grad_value])]
+        grads = agg.compute_gradients(grads)
+        agg.apply_gradients(
+            lambda: opt.apply_gradients(zip(grads, [v]))
+        )
+
+    step(1.0)  # pass 1: accumulate only
+    np.testing.assert_allclose(v.numpy(), [10.0])
+    step(3.0)  # pass 2: flush -> mean(1, 3) = 2.0, lr 1.0
+    np.testing.assert_allclose(v.numpy(), [8.0])
+    step(5.0)  # next window
+    np.testing.assert_allclose(v.numpy(), [8.0])
+    step(7.0)  # flush -> mean(5, 7) = 6.0
+    np.testing.assert_allclose(v.numpy(), [2.0])
