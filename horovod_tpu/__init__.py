@@ -40,6 +40,8 @@ from .common.basics import (
     gloo_built,
     ccl_built,
     native_built,
+    start_timeline,
+    stop_timeline,
 )
 from .common.exceptions import (
     HorovodInternalError,

@@ -201,6 +201,48 @@ def is_initialized() -> bool:
     return _state.initialized
 
 
+def start_timeline(file_path: str, mark_cycles: bool = True) -> None:
+    """Begin writing the Chrome-trace timeline at runtime (reference:
+    hvd.start_timeline / horovod_start_timeline in operations.cc) — the
+    programmatic alternative to setting ``HVD_TPU_TIMELINE`` before init.
+
+    ``mark_cycles`` is accepted for signature parity; cycle markers are
+    always emitted while the timeline is active (the native writer's
+    MarkCycle)."""
+    st = _require_init()
+    with st.lock:
+        if st.controller is not None and st.controller.is_native:
+            if not st.controller.start_timeline(file_path):
+                raise ValueError(
+                    "timeline already active (stop_timeline() first) or "
+                    f"cannot open {file_path!r}"
+                )
+            return
+        if st.timeline is not None:
+            raise ValueError(
+                "timeline already active (stop_timeline() first)"
+            )
+        from ..utils.timeline import Timeline
+
+        try:
+            st.timeline = Timeline(file_path, rank=st.topology.rank)
+        except OSError as e:
+            # same error contract as the native path
+            raise ValueError(f"cannot open {file_path!r}: {e}") from e
+
+
+def stop_timeline() -> None:
+    """Close the runtime timeline (reference: hvd.stop_timeline)."""
+    st = _require_init()
+    with st.lock:
+        if st.controller is not None and st.controller.is_native:
+            st.controller.stop_timeline()
+            return
+        if st.timeline is not None:
+            st.timeline.close()
+            st.timeline = None
+
+
 def _require_init() -> _GlobalState:
     if not _state.initialized:
         raise NotInitializedError()

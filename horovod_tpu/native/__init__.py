@@ -103,7 +103,10 @@ def load_controller(topology: Topology, config: Config):
             from .controller import NativeController  # deferred: needs lib
 
             return NativeController(path, topology, config)
-        except OSError as e:
+        except (OSError, AttributeError) as e:
+            # AttributeError: a stale prebuilt .so missing newly added C
+            # symbols (ctypes raises it at the restype/argtypes
+            # declarations) — degrade like any other load failure
             get_logger().warning("native core failed to load (%s); using "
                                  "python fallback controller", e)
     return PyFallbackController(topology, config)

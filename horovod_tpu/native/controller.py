@@ -181,6 +181,9 @@ class NativeController:
         lib.hvdtpu_timeline_activity.argtypes = [
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
         ]
+        lib.hvdtpu_start_timeline.restype = ctypes.c_int
+        lib.hvdtpu_start_timeline.argtypes = [ctypes.c_char_p]
+        lib.hvdtpu_stop_timeline.restype = ctypes.c_int
 
     # -- wiring -------------------------------------------------------------
 
@@ -248,6 +251,18 @@ class NativeController:
         self._lib.hvdtpu_timeline_activity(
             tensor.encode(), activity.encode(), 1 if begin else 0
         )
+
+    def start_timeline(self, path: str) -> bool:
+        """Begin tracing to ``path`` at runtime (reference:
+        horovod_start_timeline)."""
+        ok = self._lib.hvdtpu_start_timeline(path.encode()) == 0
+        if ok:
+            self._timeline_active = True
+        return ok
+
+    def stop_timeline(self) -> bool:
+        self._timeline_active = False
+        return self._lib.hvdtpu_stop_timeline() == 0
 
     # -- submission ---------------------------------------------------------
 

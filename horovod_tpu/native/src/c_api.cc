@@ -120,8 +120,12 @@ int hvdtpu_init(int rank, int size, const char* coord_host, int coord_port,
       cache_capacity >= 0 ? cache_capacity : 1024);
   s->stall = std::make_unique<hvdtpu::StallInspector>(stall_warn_sec,
                                                       stall_shutdown_sec);
+  // always constructed (stable pointer for the controller); inactive
+  // until Open — env-configured path opens now, hvdtpu_start_timeline
+  // can open one later (reference: horovod_start_timeline)
+  s->timeline = std::make_unique<hvdtpu::Timeline>(rank);
   if (timeline_path && timeline_path[0])
-    s->timeline = std::make_unique<hvdtpu::Timeline>(timeline_path, rank);
+    s->timeline->Open(timeline_path);
   s->params = std::make_unique<hvdtpu::ParameterManager>(
       fusion_threshold, cycle_time_ms,
       autotune_log ? autotune_log : "");
@@ -328,6 +332,22 @@ void hvdtpu_timeline_activity(const char* tensor, const char* activity,
     s->timeline->ActivityStart(tensor, activity);
   else
     s->timeline->ActivityEnd(tensor, activity);
+}
+
+// Runtime timeline control (reference: horovod_start_timeline /
+// horovod_stop_timeline in operations.cc).  Returns 0 on success, 1 when
+// already active / not initialized / unopenable.
+int hvdtpu_start_timeline(const char* path) {
+  auto* s = hvdtpu::g();
+  if (!s->initialized.load() || !s->timeline || !path || !path[0]) return 1;
+  return s->timeline->Open(path) ? 0 : 1;
+}
+
+int hvdtpu_stop_timeline() {
+  auto* s = hvdtpu::g();
+  if (!s->initialized.load() || !s->timeline) return 1;
+  s->timeline->Close();
+  return 0;
 }
 
 }  // extern "C"

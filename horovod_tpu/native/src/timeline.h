@@ -22,20 +22,41 @@ namespace hvdtpu {
 
 class Timeline {
  public:
-  Timeline(const std::string& path, int rank)
-      : rank_(rank), t0_(std::chrono::steady_clock::now()) {
-    file_ = std::fopen(path.c_str(), "w");
-    if (!file_) return;
+  // Inactive until Open()ed — constructed unconditionally so callers can
+  // hold a stable pointer while tracing starts/stops at runtime
+  // (reference: horovod_start_timeline / horovod_stop_timeline).
+  explicit Timeline(int rank)
+      : rank_(rank), t0_(std::chrono::steady_clock::now()) {}
+
+  Timeline(const std::string& path, int rank) : Timeline(rank) {
+    Open(path);
+  }
+
+  ~Timeline() { Close(); }
+
+  bool active() const { return active_.load(std::memory_order_relaxed); }
+
+  // Start writing to ``path``.  False if already active or unopenable.
+  bool Open(const std::string& path) {
+    std::lock_guard<std::mutex> open_lk(open_mu_);
+    if (active()) return false;
+    std::FILE* f = std::fopen(path.c_str(), "w");
+    if (!f) return false;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      file_ = f;
+      first_ = true;
+      closing_ = false;
+      queue_.clear();  // events raced in while inactive are stale
+    }
     std::fputs("[\n", file_);
     Emit("{\"name\":\"process_name\",\"ph\":\"M\",\"pid\":" +
          std::to_string(rank_) + ",\"args\":{\"name\":\"hvd_tpu rank " +
          std::to_string(rank_) + "\"}}");
     writer_ = std::thread([this] { Drain(); });
+    active_.store(true, std::memory_order_release);
+    return true;
   }
-
-  ~Timeline() { Close(); }
-
-  bool active() const { return file_ != nullptr; }
 
   void ActivityStart(const std::string& tensor, const std::string& activity) {
     Event("B", tensor, activity);
@@ -44,13 +65,17 @@ class Timeline {
     Event("E", tensor, activity);
   }
   void MarkCycle() {
-    if (!file_) return;
+    if (!active()) return;
     Emit("{\"name\":\"CYCLE\",\"cat\":\"hvd_tpu\",\"ph\":\"i\",\"s\":\"g\","
          "\"pid\":" + std::to_string(rank_) + ",\"ts\":" + NowUs() + "}");
   }
 
   void Close() {
+    std::lock_guard<std::mutex> open_lk(open_mu_);
     if (!file_) return;
+    // stop accepting events first; in-flight Emits before this point are
+    // drained by the writer before it exits
+    active_.store(false, std::memory_order_release);
     {
       std::lock_guard<std::mutex> lk(mu_);
       closing_ = true;
@@ -59,6 +84,7 @@ class Timeline {
     if (writer_.joinable()) writer_.join();
     std::fputs("\n]\n", file_);
     std::fclose(file_);
+    std::lock_guard<std::mutex> lk(mu_);
     file_ = nullptr;
   }
 
@@ -97,7 +123,7 @@ class Timeline {
 
   void Event(const char* ph, const std::string& tensor,
              const std::string& activity) {
-    if (!file_) return;
+    if (!active()) return;
     // tid: stable per-tensor row, like the reference's per-tensor lanes
     auto tid = std::hash<std::string>{}(tensor) % 2147483647;
     Emit("{\"name\":\"" + JsonEscape(activity) +
@@ -135,7 +161,9 @@ class Timeline {
   std::FILE* file_ = nullptr;
   bool first_ = true;
   bool closing_ = false;
+  std::atomic<bool> active_{false};
   std::mutex mu_;
+  std::mutex open_mu_;  // serializes Open/Close against each other
   std::condition_variable cv_;
   std::deque<std::string> queue_;
   std::thread writer_;

@@ -18,6 +18,7 @@ grouped_allreduce + the FusionBufferManager.
 
 from __future__ import annotations
 
+import contextlib
 from typing import Any, List, Optional, Sequence, Tuple
 
 import jax
@@ -142,6 +143,22 @@ def _native_submit(tree, op_type, name, builder_extra=None, **enqueue_kw):
     return Handle(futures=futures, builder=builder)
 
 
+
+@contextlib.contextmanager
+def _span(name: Optional[str], opname: str):
+    """Record an XLA_COMM span in the python-fallback timeline (the native
+    core writes its own from the C++ controller); no-op when inactive."""
+    tl = basics._state.timeline
+    label = name or opname
+    if tl is not None:
+        tl.start(label, "XLA_COMM")
+    try:
+        yield
+    finally:
+        if tl is not None:
+            tl.end(label, "XLA_COMM")
+
+
 def _normalize_op(op: Optional[ReduceOp], average: Optional[bool]) -> ReduceOp:
     """Mirror the reference's average/op argument reconciliation
     (horovod/torch/mpi_ops.py handle_average_backwards_compatibility)."""
@@ -207,12 +224,13 @@ def allreduce_async(
             prescale=prescale_factor, postscale=postscale_factor,
         )
     eng = _engine()
-    result = _fused_map(
-        tensor,
-        lambda buf: eng.allreduce(
-            buf, rop, prescale_factor, postscale_factor, process_set
-        ),
-    )
+    with _span(name, "allreduce"):
+        result = _fused_map(
+            tensor,
+            lambda buf: eng.allreduce(
+                buf, rop, prescale_factor, postscale_factor, process_set
+            ),
+        )
     return Handle(result)
 
 
@@ -288,9 +306,10 @@ def allgather_async(
             ),
         )
     eng = _engine()
-    result = jax.tree_util.tree_map(
-        lambda x: eng.allgather(jnp.asarray(x), process_set), tensor
-    )
+    with _span(name, "allgather"):
+        result = jax.tree_util.tree_map(
+            lambda x: eng.allgather(jnp.asarray(x), process_set), tensor
+        )
     return Handle(result)
 
 
@@ -381,9 +400,10 @@ def broadcast_async(
             ),
         )
     eng = _engine()
-    result = _fused_map(
-        tensor, lambda buf: eng.broadcast(buf, root_rank, process_set)
-    )
+    with _span(name, "broadcast"):
+        result = _fused_map(
+            tensor, lambda buf: eng.broadcast(buf, root_rank, process_set)
+        )
     return Handle(result)
 
 
@@ -420,7 +440,10 @@ def alltoall_async(
             extra=splits,
         )
     eng = _engine()
-    return Handle(eng.alltoall(jnp.asarray(tensor), splits, process_set))
+    with _span(name, "alltoall"):
+        return Handle(
+            eng.alltoall(jnp.asarray(tensor), splits, process_set)
+        )
 
 
 # -- reducescatter -----------------------------------------------------------
@@ -453,9 +476,11 @@ def reducescatter_async(
             ),
         )
     eng = _engine()
-    result = jax.tree_util.tree_map(
-        lambda x: eng.reducescatter(jnp.asarray(x), op, process_set), tensor
-    )
+    with _span(name, "reducescatter"):
+        result = jax.tree_util.tree_map(
+            lambda x: eng.reducescatter(jnp.asarray(x), op, process_set),
+            tensor,
+        )
     return Handle(result)
 
 

@@ -28,6 +28,7 @@ from ..common.basics import (  # noqa: F401
     init, shutdown, is_initialized, rank, local_rank, size, local_size,
     cross_rank, cross_size, is_homogeneous, xla_built, nccl_built,
     mpi_enabled, gloo_built, ccl_built, native_built,
+    start_timeline, stop_timeline,
 )
 from ..common.exceptions import (  # noqa: F401
     HorovodInternalError, HostsUpdatedInterrupt,

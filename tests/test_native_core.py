@@ -234,3 +234,61 @@ def test_native_timeline_writes_chrome_trace(tmp_path):
         for e in events if e.get("ph") in ("B", "E")
     }
     assert any(t and t.startswith("traced_tensor") for t in tensors)
+
+
+def test_runtime_start_stop_timeline(tmp_path):
+    """hvd.start_timeline/stop_timeline at RUNTIME — no env, no restart
+    (reference: horovod_start_timeline/horovod_stop_timeline)."""
+    path = str(tmp_path / "runtime_timeline.json")
+    hvd.allreduce(jnp.ones((8,)), name="before_timeline")  # not traced
+    hvd.start_timeline(path)
+    try:
+        with pytest.raises(ValueError):
+            hvd.start_timeline(str(tmp_path / "other.json"))  # already on
+        hvd.allreduce(jnp.ones((32,)), name="runtime_traced")
+    finally:
+        hvd.stop_timeline()
+    hvd.allreduce(jnp.ones((8,)), name="after_timeline")  # not traced
+    with open(path) as f:
+        events = json.load(f)
+    tensors = {
+        e.get("args", {}).get("tensor")
+        for e in events if e.get("ph") in ("B", "E")
+    }
+    assert any(t and t.startswith("runtime_traced") for t in tensors)
+    assert not any(t and t.startswith("after_timeline") for t in tensors)
+    # a second start/stop round works (fresh file, fresh writer thread)
+    path2 = str(tmp_path / "runtime_timeline2.json")
+    hvd.start_timeline(path2)
+    hvd.allreduce(jnp.ones((16,)), name="second_round")
+    hvd.stop_timeline()
+    with open(path2) as f:
+        events = json.load(f)
+    assert any(
+        e.get("args", {}).get("tensor", "").startswith("second_round")
+        for e in events if e.get("ph") in ("B", "E")
+    )
+
+
+def test_runtime_timeline_python_fallback(tmp_path, monkeypatch):
+    """start_timeline on the python-fallback controller records the eager
+    engine's spans through utils.timeline (the native core otherwise owns
+    the file)."""
+    import horovod_tpu.common.basics as basics
+
+    path = str(tmp_path / "fallback_timeline.json")
+    ctrl = basics._state.controller
+    monkeypatch.setattr(type(ctrl), "is_native", False)
+    hvd.start_timeline(path)
+    try:
+        hvd.allreduce(jnp.ones((8,)), name="fallback_traced")
+    finally:
+        hvd.stop_timeline()
+        monkeypatch.undo()
+    with open(path) as f:
+        events = json.load(f)
+    tensors = {
+        e.get("args", {}).get("tensor")
+        for e in events if e.get("ph") in ("B", "E")
+    }
+    assert "fallback_traced" in tensors
