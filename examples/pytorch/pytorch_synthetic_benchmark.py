@@ -1,0 +1,92 @@
+"""PyTorch synthetic benchmark through the torch adapter.
+
+Reference analog: examples/pytorch/pytorch_synthetic_benchmark.py — THE
+script the reference's docs point at for img/sec measurements (and the
+source of BASELINE.md's ~330 img/s V100 figure).  Same protocol: a conv
+net on synthetic batches, warmup then timed iterations, per-worker and
+total throughput printed by rank 0.
+
+The torch adapter is a CPU bridge (TPU compute is the JAX surface), so
+absolute numbers here measure the adapter path, not the chip — bench.py
+is the TPU-native headline.
+
+Run:  tpurun -np 2 python examples/pytorch/pytorch_synthetic_benchmark.py
+"""
+
+import argparse
+import time
+
+import numpy as np
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+
+
+class SmallConvNet(nn.Module):
+    def __init__(self, num_classes=100):
+        super().__init__()
+        self.c1 = nn.Conv2d(3, 32, 3, stride=2)
+        self.c2 = nn.Conv2d(32, 64, 3, stride=2)
+        self.fc = nn.Linear(64, num_classes)
+
+    def forward(self, x):
+        x = F.relu(self.c1(x))
+        x = F.relu(self.c2(x))
+        x = x.mean(dim=(2, 3))
+        return self.fc(x)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--image-size", type=int, default=64)
+    parser.add_argument("--num-iters", type=int, default=10)
+    parser.add_argument("--num-warmup", type=int, default=3)
+    args = parser.parse_args()
+
+    hvd.init()
+    torch.manual_seed(0)
+    model = SmallConvNet()
+    optimizer = torch.optim.SGD(model.parameters(), lr=0.01)
+
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters()
+    )
+
+    rng = np.random.RandomState(hvd.cross_rank())
+    data = torch.as_tensor(rng.rand(
+        args.batch_size, 3, args.image_size, args.image_size
+    ).astype(np.float32))
+    target = torch.as_tensor(
+        rng.randint(0, 100, size=(args.batch_size,))
+    )
+
+    def step():
+        optimizer.zero_grad()
+        loss = F.cross_entropy(model(data), target)
+        loss.backward()
+        optimizer.step()
+
+    for _ in range(args.num_warmup):
+        step()
+    t0 = time.perf_counter()
+    for _ in range(args.num_iters):
+        step()
+    dt = time.perf_counter() - t0
+
+    img_sec = args.batch_size * args.num_iters / dt
+    total = hvd.allreduce(
+        torch.tensor([img_sec]), op=hvd.Sum, name="img_sec_total"
+    )
+    if hvd.rank() == 0:
+        print(f"Img/sec per worker: {img_sec:.1f}")
+        print(f"Total img/sec on {hvd.cross_size()} worker(s): "
+              f"{float(total[0]):.1f}")
+
+
+if __name__ == "__main__":
+    main()
