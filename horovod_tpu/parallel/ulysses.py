@@ -49,6 +49,7 @@ def ulysses_attention(
     v: jax.Array,
     axis_name: Optional[str] = None,
     attn_fn: Optional[Callable] = None,
+    impl: str = "dense",
 ) -> jax.Array:
     """Exact attention over a sequence-sharded axis via two all-to-alls.
 
@@ -58,17 +59,27 @@ def ulysses_attention(
       axis_name: mesh axis the sequence is sharded over (bound inside
         shard_map); defaults to the world axis.
       attn_fn: local attention callable ``(q, k, v) -> out`` on
-        full-sequence, head-sharded tensors; defaults to exact causal
-        attention.
+        full-sequence, head-sharded tensors; overrides ``impl``.
+      impl: with no ``attn_fn``, ``"dense"`` uses exact causal attention
+        and ``"flash"`` the pallas flash kernel (the local attention runs
+        over the FULL sequence with H/n heads, so flash's no-(S×S)-in-HBM
+        property matters even more here than per ring block).
     Returns:
       (B, S_local, H, D) output, sequence-sharded like the input.
     """
     axis = axis_name or WORLD_AXIS
     n = jax.lax.axis_size(axis)
     if attn_fn is None:
-        from ..models.transformer import causal_dot_attention
+        if impl == "flash":
+            from ..ops.flash_attention import flash_attention
 
-        attn_fn = causal_dot_attention
+            attn_fn = flash_attention
+        elif impl == "dense":
+            from ..models.transformer import causal_dot_attention
+
+            attn_fn = causal_dot_attention
+        else:
+            raise ValueError(f"unknown ulysses attention impl {impl!r}")
     if n == 1:
         return attn_fn(q, k, v)
     h = q.shape[2]
