@@ -18,16 +18,23 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "integration", "launcher_worker.py")
 
 
-def _run_tpurun(np_, extra=None, timeout=180):
+def _run_tpurun(np_, extra=None, timeout=180, target=None,
+                target_args=None):
+    """Launch ``tpurun -np N`` on a per-rank script with the suite's
+    standard child environment (CPU backend, repo on PYTHONPATH, one
+    device per process).  Defaults to the collective-asserting WORKER."""
     env = os.environ.copy()
     env["PALLAS_AXON_POOL_IPS"] = ""  # force CPU in children
     env["JAX_PLATFORMS"] = "cpu"
+    env["TF_CPP_MIN_LOG_LEVEL"] = "3"
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env.pop("XLA_FLAGS", None)  # one CPU device per process
+    if target is None:
+        target, target_args = WORKER, [str(np_)]
     cmd = [
         sys.executable, "-m", "horovod_tpu.runner",
         "-np", str(np_), *(extra or []), "--",
-        sys.executable, WORKER, str(np_),
+        sys.executable, target, *(target_args or []),
     ]
     return subprocess.run(
         cmd, env=env, capture_output=True, text=True, timeout=timeout,
@@ -117,14 +124,19 @@ def test_tpurun_tensorflow_adapter():
     (reference analog: test/parallel/test_tensorflow.py under
     horovodrun -np 2)."""
     tf_worker = os.path.join(REPO, "tests", "integration", "tf_worker.py")
-    env = os.environ.copy()
-    env["PALLAS_AXON_POOL_IPS"] = ""
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    env.pop("XLA_FLAGS", None)
-    cmd = [sys.executable, "-m", "horovod_tpu.runner", "-np", "2", "--",
-           sys.executable, tf_worker, "2"]
-    res = subprocess.run(cmd, env=env, capture_output=True, text=True,
-                         timeout=420, cwd=REPO)
+    res = _run_tpurun(2, timeout=420, target=tf_worker, target_args=["2"])
     assert res.returncode == 0, f"stdout:\n{res.stdout}\nstderr:\n{res.stderr[-4000:]}"
     assert res.stdout.count("TF_WORKER_OK") == 2
+
+
+@pytest.mark.integration
+def test_tpurun_keras_mnist_example():
+    """The Keras example trains to high accuracy under 2 real processes —
+    pins the full model.fit + DistributedOptimizer + callbacks path
+    (reference analog: test/integration style end-to-end runs)."""
+    example = os.path.join(REPO, "examples", "tensorflow2",
+                           "tensorflow2_keras_mnist.py")
+    res = _run_tpurun(2, timeout=420, target=example,
+                      target_args=["--epochs", "1"])
+    assert res.returncode == 0, f"stderr:\n{res.stderr[-3000:]}"
+    assert "final accuracy" in res.stdout  # rank-0 assertion ran
