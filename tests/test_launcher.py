@@ -30,6 +30,7 @@ def _run_tpurun(np_, extra=None, timeout=180, target=None,
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env.pop("XLA_FLAGS", None)  # one CPU device per process
     if target is None:
+        assert target_args is None, "target_args requires an explicit target"
         target, target_args = WORKER, [str(np_)]
     cmd = [
         sys.executable, "-m", "horovod_tpu.runner",
@@ -138,5 +139,20 @@ def test_tpurun_keras_mnist_example():
                            "tensorflow2_keras_mnist.py")
     res = _run_tpurun(2, timeout=420, target=example,
                       target_args=["--epochs", "1"])
-    assert res.returncode == 0, f"stderr:\n{res.stderr[-3000:]}"
-    assert "final accuracy" in res.stdout  # rank-0 assertion ran
+    assert res.returncode == 0, \
+        f"stdout:\n{res.stdout[-2000:]}\nstderr:\n{res.stderr[-3000:]}"
+    # rank-0 accuracy assertion ran inside the child
+    assert "final accuracy" in res.stdout, res.stdout[-2000:]
+
+
+@pytest.mark.integration
+def test_tpurun_pytorch_synthetic_example():
+    """The torch synthetic benchmark example runs under 2 real processes
+    (grad-hook DistributedOptimizer + state broadcasts end to end)."""
+    example = os.path.join(REPO, "examples", "pytorch",
+                           "pytorch_synthetic_benchmark.py")
+    res = _run_tpurun(2, timeout=420, target=example,
+                      target_args=["--num-iters", "3", "--num-warmup", "1"])
+    assert res.returncode == 0, \
+        f"stdout:\n{res.stdout[-2000:]}\nstderr:\n{res.stderr[-3000:]}"
+    assert "Total img/sec on 2 worker(s)" in res.stdout, res.stdout[-2000:]
