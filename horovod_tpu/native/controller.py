@@ -184,6 +184,12 @@ class NativeController:
         lib.hvdtpu_start_timeline.restype = ctypes.c_int
         lib.hvdtpu_start_timeline.argtypes = [ctypes.c_char_p]
         lib.hvdtpu_stop_timeline.restype = ctypes.c_int
+        lib.hvdtpu_pack.restype = None
+        lib.hvdtpu_pack.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_longlong), ctypes.c_int,
+            ctypes.c_void_p, ctypes.c_longlong,
+        ]
 
     # -- wiring -------------------------------------------------------------
 
@@ -504,16 +510,30 @@ class NativeController:
             # padding it would only waste up to 2x transfer/ICI bytes.
             from ..ops.adasum import _next_pow2
 
-            arrays = [np.asarray(e.payload) for e in entries]
-            sizes = [int(a.size) for a in arrays]
-            shapes = [a.shape for a in arrays]
+            raw = [np.asarray(e.payload) for e in entries]
+            sizes = [int(a.size) for a in raw]
+            # shapes from the originals: ascontiguousarray promotes 0-d
+            # scalars to 1-d, which would corrupt the unpack reshape
+            shapes = [a.shape for a in raw]
+            arrays = [np.ascontiguousarray(a) for a in raw]
             total = sum(sizes)
             padded = _next_pow2(total) if len(arrays) > 1 else total
-            buf = np.zeros((padded,), arrays[0].dtype)
-            offset = 0
-            for a in arrays:
-                buf[offset:offset + a.size] = a.ravel()
-                offset += a.size
+            # pack in C (hvdtpu_pack memcpys + zeroes the pad tail):
+            # ctypes releases the GIL for the call, so the training
+            # thread keeps running while this background thread packs
+            buf = np.empty((padded,), arrays[0].dtype)
+            n_arr = len(arrays)
+            srcs = (ctypes.c_void_p * n_arr)(
+                *[a.ctypes.data for a in arrays]
+            )
+            nbytes = (ctypes.c_longlong * n_arr)(
+                *[a.nbytes for a in arrays]
+            )
+            self._lib.hvdtpu_pack(
+                srcs, nbytes, n_arr,
+                ctypes.c_void_p(buf.ctypes.data),
+                ctypes.c_longlong(buf.nbytes),
+            )
             out = eng.allreduce(
                 jnp.asarray(buf), ReduceOp(root_or_rop), prescale,
                 postscale, ps,

@@ -334,6 +334,24 @@ void hvdtpu_timeline_activity(const char* tensor, const char* activity,
     s->timeline->ActivityEnd(tensor, activity);
 }
 
+// Fusion-buffer pack: concatenate n contiguous byte buffers into dst and
+// zero the tail up to dst_bytes (the power-of-two pad).  Called from the
+// exec callback through ctypes, which RELEASES the GIL for the duration —
+// the training thread keeps running while the background thread memcpys
+// (reference: the batched fusion-buffer memcpy kernels of
+// cuda_kernels.cu, host-side here because the buffer feeds a compiled
+// XLA collective).
+void hvdtpu_pack(const void** srcs, const long long* nbytes, int n,
+                 char* dst, long long dst_bytes) {
+  long long off = 0;
+  for (int i = 0; i < n; ++i) {
+    std::memcpy(dst + off, srcs[i], static_cast<size_t>(nbytes[i]));
+    off += nbytes[i];
+  }
+  if (off < dst_bytes)
+    std::memset(dst + off, 0, static_cast<size_t>(dst_bytes - off));
+}
+
 // Runtime timeline control (reference: horovod_start_timeline /
 // horovod_stop_timeline in operations.cc).  Returns 0 on success, 1 when
 // already active / not initialized / unopenable.

@@ -333,9 +333,10 @@ def grouped_allgather(
         return [allgather(t, name, process_set) for t in tensors]
     prefix = name or "grouped_allgather"
 
-    # one small collective: every tensor's dim0 from every rank
+    # one small collective: every tensor's dim0 from every rank (int32:
+    # jax truncates int64 without x64 mode, with a warning per call)
     dim0s = np.asarray(allgather(
-        jnp.asarray([[a.shape[0] for a in arrs]], jnp.int64),
+        jnp.asarray([[a.shape[0] for a in arrs]], jnp.int32),
         name=f"{prefix}.dim0s", process_set=process_set,
     ))  # (n_contributors, n_tensors)
     n_contrib = dim0s.shape[0]

@@ -142,3 +142,12 @@ def test_fusion_threshold_zero_disables_fusion():
     assert len(plan.buckets) == 2  # one bucket per tensor
     out = unfuse(fuse(leaves, plan), plan)
     np.testing.assert_array_equal(np.asarray(out[0]), np.ones(4))
+
+
+def test_scalar_allreduce_preserves_zero_d_shape():
+    """0-d inputs round-trip as 0-d through the native fused path
+    (regression: ascontiguousarray promotes 0-d to 1-d; the unpack
+    reshape must use the original shape)."""
+    out = hvd.allreduce(jnp.asarray(3.0), name="scalar_rt", op=hvd.Sum)
+    assert np.asarray(out).shape == ()
+    assert float(out) == 3.0
