@@ -315,3 +315,43 @@ def test_elastic_fault_recovery(tmp_path):
     assert any(e["event"] == "batch" and e["world"] == 2 for e in events)
     assert any(e["event"] == "batch" and e["world"] == 1
                and e["worker"] == survivor for e in events)
+
+
+@pytest.mark.integration
+def test_elastic_scale_down(tmp_path):
+    """Start at 2 workers, remove a slot mid-run: the displaced worker is
+    kept alive through the next rendezvous, told to shut down, and exits
+    0; the survivor finishes every batch alone (reference: elastic
+    discovery-driven scale-down)."""
+    hosts, script = _write_discovery(tmp_path, "localhost:2\n")
+    logdir = tmp_path / "logs"
+    logdir.mkdir()
+    proc = subprocess.Popen(
+        _elastic_cmd(script, logdir, epochs=1, batches=120, min_np=1),
+        env=_elastic_env(), cwd=REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    # shrink once both workers are demonstrably training together
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        if any(e["event"] == "batch" and e["world"] == 2
+               for e in _read_logs(logdir)):
+            break
+        time.sleep(0.5)
+    hosts.write_text("localhost:1\n")
+    try:
+        out, err = proc.communicate(timeout=240)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, err = proc.communicate()
+        pytest.fail(f"elastic scale-down job hung:\n{err[-3000:]}")
+    assert proc.returncode == 0, f"stdout:{out[-2000:]}\nstderr:{err[-3000:]}"
+    events = _read_logs(logdir)
+    dones = [e for e in events if e["event"] == "done"]
+    assert len(dones) == 1, dones
+    assert dones[0]["world"] == 1
+    # no lost or duplicated batches across the resize
+    assert abs(dones[0]["weight"] - 120.0) < 1e-6
+    # the world really was 2 before the shrink and 1 after
+    assert any(e["event"] == "batch" and e["world"] == 2 for e in events)
+    assert any(e["event"] == "batch" and e["world"] == 1 for e in events)
