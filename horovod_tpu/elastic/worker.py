@@ -228,6 +228,11 @@ def rendezvous() -> dict:
         raise HorovodInternalError("elastic driver closed during rendezvous")
     if msg.get("type") == "shutdown":
         get_logger().info("elastic: driver requested shutdown")
+        # a displaced worker arrives here via exec-restart with a live
+        # state file it will never load — clean it up on the way out
+        path = os.environ.pop(ENV_RESTORE, None)
+        if path and os.path.exists(path):
+            os.remove(path)
         raise SystemExit(0)
     if msg.get("type") != "assignment":
         raise HorovodInternalError(f"unexpected rendezvous reply: {msg}")
@@ -317,19 +322,31 @@ def clean_shutdown() -> None:
 
 
 def reset_world(state) -> None:
-    """Full reset: re-rendezvous, rebuild backend + framework, re-sync
-    (reference: common/elastic.py _reset + §3.4's 'full communicator
-    rebuild' step).  Valid only when all remaining peers are alive (a
-    planned membership change): the coordination-service shutdown barrier
-    then completes.  Peer-death recovery goes through
-    :func:`restart_after_failure` instead."""
+    """Reset for a PLANNED membership change (reference: common/elastic.py
+    _reset + §3.4's 'full communicator rebuild' step).
+
+    Multi-process worlds exec-restart with the LIVE state rather than
+    re-initializing in process.  The in-process path must run the
+    coordination-service shutdown barrier across all old members — but
+    notification skew means a peer can be blocked inside a collective
+    when the first member tears down; that peer then recovers via
+    exec-restart and NEVER reaches the barrier, and jaxlib FATALs every
+    member still waiting in it (observed in the scale-down integration
+    test).  Exec-restart needs no cross-member teardown at all: the
+    process image (heartbeats, service, collectives mid-flight) is
+    replaced wholesale, and the live-state file + post-boot ``sync()``
+    preserve the reference's keep-state-on-planned-change semantics."""
     from ..common import basics
 
     state._materialize_to_host()
     notification_manager.clear()
-    # tear down BEFORE the (potentially long) rendezvous wait: the old
-    # client's heartbeat watchdog would otherwise hard-kill this process
-    # while it waits for replacement workers to spawn
+    if basics._require_init().topology.num_processes > 1:
+        get_logger().info(
+            "elastic: membership change — exec-restarting with live state"
+        )
+        snap = state._snapshot() if hasattr(state, "_snapshot") else None
+        _persist_and_exec(snap)  # does not return
+    # single-process world: nothing to barrier with — rebuild in process
     basics.shutdown()
     _teardown_jax()
     msg = rendezvous()
@@ -388,8 +405,10 @@ def _persist_and_exec(snap) -> None:
 
 def maybe_restore_after_restart(state) -> None:
     """On wrapper entry after an exec-restart, reload the persisted
-    snapshot (then the normal ``state.sync()`` re-broadcasts rank 0's
-    authoritative copy)."""
+    snapshot, fire the user's reset callbacks (a restart IS the reset —
+    reference: _reset invoking on_reset after every membership change),
+    then the normal ``state.sync()`` re-broadcasts rank 0's authoritative
+    copy."""
     import pickle
 
     path = os.environ.pop(ENV_RESTORE, None)
@@ -402,3 +421,4 @@ def maybe_restore_after_restart(state) -> None:
         state._apply_snapshot(snap)
         state.save()
         get_logger().info("elastic: state restored after worker restart")
+    state.on_reset()

@@ -84,9 +84,9 @@ class _Worker:
         self.slot = slot
         self.proc = proc
         self.exit_code: Optional[int] = None
-        # slot removed by discovery: the worker stays alive through the
-        # next rendezvous (so the old world's teardown barrier completes)
-        # and is then told to shut down
+        # slot removed by discovery: the worker stays a member until the
+        # next rendezvous, where it is told to shut down (it arrives
+        # there via its own exec-restart; no cross-member teardown)
         self.leaving = False
 
     @property
@@ -362,8 +362,8 @@ class ElasticDriver:
                     pass
                 sock.close()
             # leaving workers (removed slots) and latecomers from dead
-            # epochs are told to shut down; they exit 0 after having
-            # participated in the old world's teardown
+            # epochs are told to shut down; they clean up their restart
+            # state file and exit 0
             for wid, sock in list(self._pending_rendezvous.items()):
                 if wid not in members:
                     try:

@@ -319,10 +319,10 @@ def test_elastic_fault_recovery(tmp_path):
 
 @pytest.mark.integration
 def test_elastic_scale_down(tmp_path):
-    """Start at 2 workers, remove a slot mid-run: the displaced worker is
-    kept alive through the next rendezvous, told to shut down, and exits
-    0; the survivor finishes every batch alone (reference: elastic
-    discovery-driven scale-down)."""
+    """Start at 2 workers, remove a slot mid-run: the displaced worker
+    rendezvouses, takes the "shutdown" reply and exits 0; the survivor
+    exec-restarts with live state and finishes every batch alone
+    (reference: elastic discovery-driven scale-down)."""
     hosts, script = _write_discovery(tmp_path, "localhost:2\n")
     logdir = tmp_path / "logs"
     logdir.mkdir()
@@ -333,11 +333,16 @@ def test_elastic_scale_down(tmp_path):
     )
     # shrink once both workers are demonstrably training together
     deadline = time.time() + 120
+    trained_together = False
     while time.time() < deadline:
         if any(e["event"] == "batch" and e["world"] == 2
                for e in _read_logs(logdir)):
+            trained_together = True
             break
         time.sleep(0.5)
+    if not trained_together:
+        proc.kill()
+        pytest.fail("2-world training never started before the shrink")
     hosts.write_text("localhost:1\n")
     try:
         out, err = proc.communicate(timeout=240)
@@ -355,3 +360,9 @@ def test_elastic_scale_down(tmp_path):
     # the world really was 2 before the shrink and 1 after
     assert any(e["event"] == "batch" and e["world"] == 2 for e in events)
     assert any(e["event"] == "batch" and e["world"] == 1 for e in events)
+    # GRACEFUL path, not crash recovery: no worker failed (the displaced
+    # worker took the rendezvous "shutdown" reply and exited 0, so the
+    # driver logged no nonzero exits and blacklisted nothing)
+    assert "failed with exit code" not in err, err[-2000:]
+    # user reset callbacks fired on the survivor after the restart
+    assert any(e["event"] == "reset" for e in events), events
