@@ -38,6 +38,8 @@ ENV_DRIVER = "HVD_TPU_ELASTIC_DRIVER"
 ENV_WORKER_ID = "HVD_TPU_ELASTIC_WORKER_ID"
 ENV_RESTORE = "HVD_TPU_ELASTIC_RESTORE"
 
+ENV_RESTARTED = "HVD_TPU_ELASTIC_RESTARTED"
+
 _ASSIGNMENT_ENV = (
     "HVD_TPU_COORDINATOR", "HVD_TPU_NUM_PROCESSES", "HVD_TPU_PROCESS_ID",
     "HVD_TPU_NATIVE_PORT",
@@ -136,7 +138,7 @@ class WorkerNotificationManager:
                 with self._lock:
                     self._pending_epoch = msg.get("epoch")
                     self._pending_failure = bool(msg.get("failure"))
-                    if self._pending_failure and not self._watchdog_armed:
+                    if not self._watchdog_armed:
                         self._watchdog_armed = arm = True
                 get_logger().info(
                     "elastic: hosts updated (epoch %s, failure=%s)",
@@ -148,11 +150,15 @@ class WorkerNotificationManager:
                     ).start()
 
     def _failure_watchdog(self) -> None:
-        """A peer died.  If the main thread is wedged inside a collective
-        that can never complete (the XLA cross-process op blocks until the
-        coordination service FATALs the process), no exception ever reaches
-        the elastic run wrapper.  After a grace period, recover from here:
-        persist the last *committed* state and exec-restart the worker."""
+        """The membership changed.  If the main thread is wedged inside a
+        collective that can never complete — a peer died mid-op, OR a
+        peer saw a planned change first and exec-restarted while we were
+        still blocked waiting for its contribution — no exception ever
+        reaches the elastic run wrapper, and the coordination service
+        FATALs the process at its heartbeat deadline.  After a grace
+        period, recover from here: persist the last *committed* state and
+        exec-restart the worker.  (Rolling a planned change back to the
+        last commit is safe: post-boot ``sync()`` re-seeds from rank 0.)"""
         import time
 
         deadline = time.time() + _FAILURE_GRACE
@@ -396,6 +402,9 @@ def _persist_and_exec(snap) -> None:
         with os.fdopen(fd, "wb") as f:
             pickle.dump(snap, f)
         os.environ[ENV_RESTORE] = path
+    # marked even with no snapshot: the post-boot wrapper must still fire
+    # the user's reset callbacks (the restart IS the reset)
+    os.environ[ENV_RESTARTED] = "1"
     for k in _ASSIGNMENT_ENV:
         os.environ.pop(k, None)
     sys.stdout.flush()
@@ -411,14 +420,19 @@ def maybe_restore_after_restart(state) -> None:
     copy."""
     import pickle
 
+    restarted = os.environ.pop(ENV_RESTARTED, None) is not None
     path = os.environ.pop(ENV_RESTORE, None)
-    if not path or not os.path.exists(path):
-        return
-    with open(path, "rb") as f:
-        snap = pickle.load(f)
-    os.remove(path)
-    if snap is not None and hasattr(state, "_apply_snapshot"):
-        state._apply_snapshot(snap)
-        state.save()
-        get_logger().info("elastic: state restored after worker restart")
-    state.on_reset()
+    if path and os.path.exists(path):
+        with open(path, "rb") as f:
+            snap = pickle.load(f)
+        os.remove(path)
+        if snap is not None and hasattr(state, "_apply_snapshot"):
+            state._apply_snapshot(snap)
+            state.save()
+            get_logger().info(
+                "elastic: state restored after worker restart"
+            )
+    if restarted:
+        # reset callbacks fire on every exec-restart, snapshot or not —
+        # a restart with no committed state is still a membership reset
+        state.on_reset()

@@ -192,8 +192,11 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
 
 
 def _grad_kind(g):
-    if type(g).__module__.startswith("tensorflow"):
+    mod = type(g).__module__
+    if mod.startswith("tensorflow"):
         return "tf"
+    if mod.startswith("torch"):
+        return "torch"
     try:
         import jax
 
@@ -208,6 +211,10 @@ def _distributed_apply(self, grads, trainable_variables=None):
     op, prescale, postscale = _scale_factors(
         self._hvd_op, self._hvd_predivide, self._hvd_process_set
     )
+    # classify on the INCOMING grads: local aggregation converts to numpy
+    # below, and the framework bridge (e.g. torch apply rejecting numpy)
+    # must still engage on the flush pass
+    kinds = {_grad_kind(g) for g in grads if g is not None}
     n = self._hvd_passes_per_step
     if n > 1:
         def _is_traced(g):
@@ -225,7 +232,12 @@ def _distributed_apply(self, grads, trainable_variables=None):
                 "state; compile-free execution is required (e.g. "
                 "model.compile(..., run_eagerly=True))"
             )
-        grads = [None if g is None else np.asarray(g) for g in grads]
+        grads = [
+            None if g is None
+            else (g.detach().cpu().numpy()
+                  if _grad_kind(g) == "torch" else np.asarray(g))
+            for g in grads
+        ]
         if self._hvd_agg is None:
             self._hvd_agg = [None if g is None else g.copy() for g in grads]
         else:
@@ -240,8 +252,11 @@ def _distributed_apply(self, grads, trainable_variables=None):
             grads = [None if g is None else g / n for g in grads]
         self._hvd_agg = None
         self._hvd_agg_count = 0
+        if kinds == {"tf"}:
+            # eager-only path (guard above); the aggregated numpy arrays
+            # route through the numpy engine and return fine to TF
+            kinds = {"np"}
 
-    kinds = {_grad_kind(g) for g in grads if g is not None}
     if kinds == {"tf"}:
         from . import mpi_ops
 
@@ -263,6 +278,24 @@ def _distributed_apply(self, grads, trainable_variables=None):
             grads, self._hvd_compression, op, prescale, postscale,
             self._hvd_process_set,
         )
+    elif kinds == {"torch"}:
+        # Keras torch backend: bridge through numpy (grads arrive
+        # detached from keras's backward) and hand torch tensors back —
+        # keras's torch apply rejects numpy
+        import torch
+
+        np_grads = _allreduce_np_grads(
+            [None if g is None
+             else (g.detach().cpu().numpy() if hasattr(g, "detach")
+                   else np.asarray(g))  # already numpy after aggregation
+             for g in grads],
+            self._hvd_compression, op, prescale, postscale,
+            self._hvd_process_set, "DistributedOptimizer",
+        )
+        # copy: the engine may hand back a read-only buffer view, which
+        # torch.as_tensor would wrap with a non-writable warning
+        reduced = [None if g is None else torch.as_tensor(np.array(g))
+                   for g in np_grads]
     else:
         reduced = _allreduce_np_grads(
             grads, self._hvd_compression, op, prescale, postscale,
