@@ -9,6 +9,8 @@ from __future__ import annotations
 
 import keras
 
+from ..elastic import run  # noqa: F401 (re-export)
+from ..elastic.sampler import ElasticSampler  # noqa: F401 (re-export)
 from ..tensorflow.elastic import TensorFlowKerasState
 
 
@@ -75,5 +77,6 @@ class UpdateEpochStateCallback(keras.callbacks.Callback):
         self.state.epoch = epoch + 1
 
 
-__all__ = ["KerasState", "CommitStateCallback", "UpdateBatchStateCallback",
+__all__ = ["KerasState", "run", "ElasticSampler",
+           "CommitStateCallback", "UpdateBatchStateCallback",
            "UpdateEpochStateCallback"]
