@@ -9,10 +9,11 @@ import jax, jax.numpy as jnp, numpy as np, optax
 from horovod_tpu.models.transformer import Transformer, TransformerConfig
 from bench import peak_flops_for_current_gen
 
-def run(attention_impl, batch=8, seq=2048):
+def run(attention_impl, batch=8, seq=2048, remat=False):
     cfg = TransformerConfig(
         vocab_size=32000, num_layers=12, num_heads=12, head_dim=64,
         max_seq_len=seq, dtype=jnp.bfloat16, attention_impl=attention_impl,
+        remat=remat,
     )
     model = Transformer(cfg)
     rs = np.random.RandomState(0)
@@ -45,14 +46,18 @@ def run(attention_impl, batch=8, seq=2048):
     flops = 6 * n_params * toks  # standard decoder train FLOPs
     peak = peak_flops_for_current_gen()
     mfu = f"{flops / dt / peak:.3f}" if peak else "n/a (unknown TPU gen)"
-    print(f"{attention_impl:6s}: step {dt*1e3:7.1f} ms  {toks/dt:9.0f} tok/s  "
-          f"MFU(6ND) {mfu}  params {n_params/1e6:.0f}M")
+    tag = attention_impl + ("+remat" if remat else "")
+    print(f"{tag:12s} b{batch:<3d}: step {dt*1e3:7.1f} ms  "
+          f"{toks/dt:9.0f} tok/s  MFU(6ND) {mfu}  params {n_params/1e6:.0f}M")
 
 print("backend:", jax.default_backend(), file=sys.stderr)
 import traceback
-for impl, batch in [("dot", 4), ("flash", 4), ("dot", 8), ("flash", 8)]:
+configs = [("dot", 4, False), ("flash", 4, False), ("dot", 8, False),
+           ("flash", 8, False), ("flash", 16, False),
+           ("flash", 16, True), ("flash", 32, True)]
+for impl, batch, remat in configs:
     try:
-        run(impl, batch=batch)
+        run(impl, batch=batch, remat=remat)
     except Exception as e:
         if "Ran out of memory" in str(e):
             print(f"{impl:6s} batch {batch}: OOM (hbm exceeded)")
