@@ -211,6 +211,14 @@ def worker(mode: str) -> int:
         "step_time_ms": round(dt / iters * 1e3, 2),
         "n_devices": jax.device_count(),
     }
+    if not on_tpu:
+        # the record must say WHY it is a CPU number: this line only
+        # happens when the axon tunnel was unreachable at run time (the
+        # TPU-measured history lives in PERF.md / BENCH_r02.json)
+        result["note"] = (
+            "cpu fallback: tpu backend unreachable at bench time; "
+            "see PERF.md for the chip-measured record (mfu 0.32-0.33)"
+        )
     gen = os.environ.get("PALLAS_AXON_TPU_GEN")
     if on_tpu and image_size == 224 and gen in PEAK_FLOPS:
         # MFU only when the generation is explicitly known — a guessed
