@@ -212,12 +212,12 @@ def worker(mode: str) -> int:
         "n_devices": jax.device_count(),
     }
     if not on_tpu:
-        # the record must say WHY it is a CPU number: this line only
-        # happens when the axon tunnel was unreachable at run time (the
-        # TPU-measured history lives in PERF.md / BENCH_r02.json)
+        # the record must say WHY it is a CPU number (probe failure or a
+        # failed TPU attempt — the orchestrator prints which to stderr);
+        # the chip-measured history lives in PERF.md / BENCH_r02.json
         result["note"] = (
-            "cpu fallback: tpu backend unreachable at bench time; "
-            "see PERF.md for the chip-measured record (mfu 0.32-0.33)"
+            "cpu fallback: the tpu probe or tpu run failed at bench "
+            "time; see PERF.md for the chip-measured record"
         )
     gen = os.environ.get("PALLAS_AXON_TPU_GEN")
     if on_tpu and image_size == 224 and gen in PEAK_FLOPS:

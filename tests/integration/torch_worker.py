@@ -1,0 +1,77 @@
+"""Per-rank torch-adapter worker for launcher integration tests.
+
+Reference analog: test/parallel/test_torch.py under ``horovodrun -np 2``
+(SURVEY.md §4) — cross-process collectives on torch tensors, grouped
+ops, SyncBatchNorm global statistics, and gradient flow through the
+differentiable stats allreduce.
+"""
+
+import sys
+
+import numpy as np
+import torch
+
+import horovod_tpu.torch as hvd
+
+
+def main():
+    hvd.init()
+    nproc = hvd.cross_size()
+    assert nproc == int(sys.argv[1]), (nproc, sys.argv)
+    me = hvd.cross_rank()
+
+    # average + grouped ops across ranks
+    out = hvd.allreduce(torch.tensor([float(me)]))
+    np.testing.assert_allclose(out.numpy(), [np.mean(np.arange(nproc))])
+    outs = hvd.grouped_allreduce(
+        [torch.ones(2) * (me + 1), torch.full((3,), float(me))],
+        op=hvd.Sum, name="torch_grouped",
+    )
+    np.testing.assert_allclose(
+        outs[0].numpy(), np.full(2, nproc * (nproc + 1) / 2)
+    )
+    np.testing.assert_allclose(
+        outs[1].numpy(), np.full(3, sum(range(nproc)))
+    )
+
+    # alltoall with uneven splits: rank r sends c+1 rows tagged 10r+c
+    send = torch.cat([
+        torch.full((c + 1,), 10.0 * me + c) for c in range(nproc)
+    ])
+    recv, rsplits = hvd.alltoall(
+        send, splits=torch.tensor([c + 1 for c in range(nproc)]),
+        name="torch_a2a",
+    )
+    assert rsplits.tolist() == [me + 1] * nproc
+    np.testing.assert_allclose(
+        recv.numpy(),
+        np.concatenate([np.full(me + 1, 10.0 * p + me)
+                        for p in range(nproc)]),
+    )
+
+    # SyncBatchNorm: global stats over per-rank constant batches.
+    # Rank r feeds (r+1); global mean = mean(1..n), var likewise.
+    bn = hvd.SyncBatchNorm(1, eps=0.0, affine=False, momentum=1.0)
+    bn.train()
+    x = torch.full((2, 1, 3), float(me + 1), requires_grad=True)
+    out = bn(x)
+    vals = np.arange(1, nproc + 1)
+    g_mean = vals.mean()
+    g_var = ((vals - g_mean) ** 2).mean()
+    expected = (x.detach().numpy() - g_mean) / np.sqrt(g_var) \
+        if nproc > 1 else np.zeros_like(x.detach().numpy())
+    np.testing.assert_allclose(out.detach().numpy(), expected,
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(bn.running_mean.numpy(), [g_mean],
+                               rtol=1e-5)
+    # gradient flows through the differentiable stats allreduce
+    out.sum().backward()
+    assert x.grad is not None and np.isfinite(x.grad.numpy()).all()
+
+    hvd.barrier()
+    print(f"TORCH_WORKER_OK rank={hvd.rank()} nproc={nproc}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

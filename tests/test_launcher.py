@@ -156,3 +156,15 @@ def test_tpurun_pytorch_synthetic_example():
     assert res.returncode == 0, \
         f"stdout:\n{res.stdout[-2000:]}\nstderr:\n{res.stderr[-3000:]}"
     assert "Total img/sec on 2 worker(s)" in res.stdout, res.stdout[-2000:]
+
+
+@pytest.mark.integration
+def test_tpurun_torch_adapter():
+    """Torch adapter under 2 real processes: grouped ops, uneven
+    alltoall, SyncBatchNorm global stats + gradient flow (reference
+    analog: test/parallel/test_torch.py under horovodrun -np 2)."""
+    worker = os.path.join(REPO, "tests", "integration", "torch_worker.py")
+    res = _run_tpurun(2, timeout=420, target=worker, target_args=["2"])
+    assert res.returncode == 0, \
+        f"stdout:\n{res.stdout[-2000:]}\nstderr:\n{res.stderr[-3000:]}"
+    assert res.stdout.count("TORCH_WORKER_OK") == 2
