@@ -152,7 +152,11 @@ def DistributedGradientTape(gradtape, device_dense: str = "",
                             process_set: Optional[ProcessSet] = None):
     """Reference: hvd.DistributedGradientTape.  ``device_dense``/
     ``device_sparse`` are accepted for signature parity; placement is the
-    engine's concern here (the reference used them to pin GPU copies)."""
+    engine's concern here (the reference used them to pin GPU copies).
+    Sparse gradients (tf.IndexedSlices, e.g. from embedding lookups)
+    densify on the wire — the reference's ``sparse_as_dense=True``
+    behavior, which is the right default on TPU (tested:
+    test_distributed_gradient_tape_indexed_slices)."""
     return _DistributedGradientTape(
         gradtape, compression, op, gradient_predivide_factor, process_set,
         num_groups,

@@ -291,3 +291,19 @@ def test_local_gradient_aggregation_in_tf_function():
     np.testing.assert_allclose(v.numpy(), [8.0])
     step(7.0)  # flush -> mean(5, 7) = 6.0
     np.testing.assert_allclose(v.numpy(), [2.0])
+
+
+def test_distributed_gradient_tape_indexed_slices():
+    """Embedding-style sparse gradients (IndexedSlices) densify through
+    the allreduce with duplicate indices summed (the reference's
+    sparse_as_dense=True behavior)."""
+    emb = tf.Variable(tf.ones((10, 4)))
+    with hvd.DistributedGradientTape(tf.GradientTape()) as tape:
+        rows = tf.gather(emb, [1, 3, 3])
+        loss = tf.reduce_sum(rows)
+    g = tape.gradient(loss, [emb])[0]
+    assert not isinstance(g, tf.IndexedSlices)
+    dense = np.asarray(g)
+    np.testing.assert_allclose(dense[1], np.ones(4))
+    np.testing.assert_allclose(dense[3], np.full(4, 2.0))  # dup summed
+    np.testing.assert_allclose(dense[0], np.zeros(4))
