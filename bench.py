@@ -53,43 +53,63 @@ def peak_flops_for_current_gen():
 
 
 PROBE_TIMEOUT_S = 60
-PROBE_RETRIES = 2
+# Overall deadline for the whole bench orchestration.  The driver runs this
+# script under an external timeout; if that kills us before the result line
+# prints, the round records NOTHING — strictly worse than a CPU fallback.
+# Every window below is clipped so a CPU line always lands inside this.
+DEADLINE_S = float(os.environ.get("BENCH_DEADLINE_S", 1800))
+# Wall-clock budget for the initial probe window.  One axon outage at
+# bench time erased round 3's TPU number (VERDICT round 3 weak #1); the
+# probe now keeps retrying with backoff for this long before conceding.
+PROBE_WINDOW_S = float(os.environ.get("BENCH_PROBE_WINDOW_S", 420))
+# After a CPU fallback run, one last TPU attempt is made (the tunnel may
+# have recovered while the CPU run burned time) within this extra window.
+FINAL_PROBE_WINDOW_S = float(os.environ.get("BENCH_FINAL_PROBE_WINDOW_S", 120))
 TPU_RUN_TIMEOUT_S = 330
 CPU_RUN_TIMEOUT_S = 150
 
 
-def tpu_available() -> bool:
-    """Probe the TPU backend in a subprocess with a hard timeout.
+def probe_backend(window_s: float) -> str:
+    """Probe the default JAX backend in a subprocess with a hard
+    per-attempt timeout, retrying with backoff until ``window_s`` of
+    wall-clock is spent.
 
-    A clean cpu-only answer is deterministic (no retry); only
-    failures/hangs are retried, boundedly.
-    """
+    Returns "tpu", "cpu" (a clean deterministic cpu-only answer — no
+    retries, no point re-probing later), or "unknown" (failures/hangs
+    exhausted the window; the tunnel may recover)."""
     probe = "import jax; d = jax.devices(); assert d; print(d[0].platform)"
-    for attempt in range(1, PROBE_RETRIES + 1):
+    deadline = time.monotonic() + window_s
+    attempt = 0
+    while True:
+        attempt += 1
+        per_attempt = min(PROBE_TIMEOUT_S, max(5, deadline - time.monotonic()))
         try:
             out = subprocess.run(
                 [sys.executable, "-c", probe],
                 capture_output=True,
                 text=True,
-                timeout=PROBE_TIMEOUT_S,
+                timeout=per_attempt,
             )
             if out.returncode == 0:
-                return "cpu" not in out.stdout
+                return "cpu" if "cpu" in out.stdout else "tpu"
             reason = (out.stderr.strip().splitlines() or ["rc=%d" % out.returncode])[-1]
         except subprocess.TimeoutExpired:
-            reason = f"probe hung >{PROBE_TIMEOUT_S}s"
+            reason = f"probe hung >{per_attempt:.0f}s"
+        remaining = deadline - time.monotonic()
         print(
-            f"[bench] TPU probe attempt {attempt}/{PROBE_RETRIES} failed: {reason}",
+            f"[bench] TPU probe attempt {attempt} failed ({reason}); "
+            f"{remaining:.0f}s left in window",
             file=sys.stderr,
         )
-        if attempt < PROBE_RETRIES:
-            time.sleep(2 * attempt)
-    return False
+        if remaining <= 5:
+            return "unknown"
+        time.sleep(min(remaining, min(60, 5 * attempt)))
 
 
-def run_worker(mode: str, timeout_s: int) -> bool:
-    """Run ``bench.py --worker <mode>`` under a deadline; forward its JSON
-    line to stdout.  Returns True iff a result line was produced."""
+def run_worker(mode: str, timeout_s: int):
+    """Run ``bench.py --worker <mode>`` under a deadline.  Returns the JSON
+    result line (str) or None — the caller decides which line to print so
+    the one-line output contract holds across fallback + re-attempt."""
     env = dict(os.environ)
     if mode == "cpu":
         # prevent axon registration entirely so nothing can hang
@@ -108,14 +128,13 @@ def run_worker(mode: str, timeout_s: int) -> bool:
             err = e.stderr if isinstance(e.stderr, str) else e.stderr.decode()
             sys.stderr.write(err[-3000:])
         print(f"[bench] {mode} run hung >{timeout_s}s", file=sys.stderr)
-        return False
+        return None
     sys.stderr.write(out.stderr)
     for line in out.stdout.splitlines():
         if line.startswith("{"):
-            print(line)
-            return True
+            return line
     print(f"[bench] {mode} run rc={out.returncode}, no result line", file=sys.stderr)
-    return False
+    return None
 
 
 def worker(mode: str) -> int:
@@ -247,17 +266,52 @@ def worker(mode: str) -> int:
 def main() -> int:
     if len(sys.argv) >= 3 and sys.argv[1] == "--worker":
         return worker(sys.argv[2])
-    if tpu_available():
-        if run_worker("tpu", TPU_RUN_TIMEOUT_S):
+    t0 = time.monotonic()
+
+    def remaining() -> float:
+        return DEADLINE_S - (time.monotonic() - t0)
+
+    # clip the probe window so a failed probe + CPU fallback still fits
+    probe_window = max(
+        30.0, min(PROBE_WINDOW_S, remaining() - CPU_RUN_TIMEOUT_S - 30))
+    backend = probe_backend(probe_window)
+    if backend == "tpu":
+        line = run_worker("tpu", TPU_RUN_TIMEOUT_S)
+        if line:
+            print(line)
             return 0
         print("[bench] TPU attempt failed; falling back to CPU", file=sys.stderr)
-    else:
+    elif backend == "cpu":
         print(
-            "[bench] TPU backend unavailable after bounded retries; "
-            "falling back to CPU so a result line is still emitted",
+            "[bench] this host's default backend is CPU (deterministic "
+            "answer, no retries spent); running the CPU benchmark",
             file=sys.stderr,
         )
-    return 0 if run_worker("cpu", CPU_RUN_TIMEOUT_S) else 1
+    else:
+        print(
+            f"[bench] TPU probe exhausted its {probe_window:.0f}s retry "
+            "window; running the CPU fallback, then re-probing once more",
+            file=sys.stderr,
+        )
+    cpu_line = run_worker("cpu", CPU_RUN_TIMEOUT_S)
+    # End-of-run TPU re-attempt — for the hung/unknown probe and for a
+    # probe-ok-but-run-failed outage (the tunnel may have recovered while
+    # the CPU run burned time); never for a deterministic cpu-only host.
+    # A late TPU number beats a CPU fallback every time — but only chase
+    # it when a full probe + chip run still fits the deadline; at the
+    # margin, banking the CPU line beats risking an empty round.
+    if (backend != "cpu"
+            and remaining() > FINAL_PROBE_WINDOW_S + TPU_RUN_TIMEOUT_S + 30
+            and probe_backend(FINAL_PROBE_WINDOW_S) == "tpu"):
+        print("[bench] TPU recovered; re-attempting the chip run", file=sys.stderr)
+        line = run_worker("tpu", TPU_RUN_TIMEOUT_S)
+        if line:
+            print(line)
+            return 0
+    if cpu_line:
+        print(cpu_line)
+        return 0
+    return 1
 
 
 if __name__ == "__main__":

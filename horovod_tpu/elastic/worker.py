@@ -60,6 +60,16 @@ _FAILURE_GRACE = float(
     os.environ.get("HVD_TPU_ELASTIC_FAILURE_GRACE_SECONDS", "10.0")
 )
 
+# When the watchdog fires on a PLANNED membership change (failure=False),
+# the keep-state contract says live progress must survive.  The watchdog
+# first attempts a live snapshot under this deadline; only if the snapshot
+# itself blocks (the main thread really is wedged in a collective the
+# change killed, and the snapshot needs that device) does it fall back to
+# the last committed snapshot.
+_PLANNED_SNAPSHOT_TIMEOUT = float(
+    os.environ.get("HVD_TPU_ELASTIC_PLANNED_SNAPSHOT_SECONDS", "30.0")
+)
+
 
 def elastic_enabled() -> bool:
     return os.environ.get(ENV_ELASTIC, "0") in ("1", "true")
@@ -175,17 +185,67 @@ class WorkerNotificationManager:
                 self._watchdog_armed = False
                 return
             state = self._watched_state
+            failure = self._pending_failure
+        if failure:
+            get_logger().warning(
+                "elastic: main thread did not begin recovery within %.1fs of "
+                "a peer failure (likely blocked in a dead collective); "
+                "forcing exec-restart from the last commit", _FAILURE_GRACE,
+            )
+            # On a FAILURE the committed snapshot ONLY, never a live
+            # state._snapshot(): the main thread may be mid-batch
+            # (inconsistent fields), and a live snapshot's host
+            # materialization could block on the very dead collective this
+            # thread is rescuing it from.  With no commit yet, restart bare
+            # and let post-boot state.sync() re-seed from rank 0.
+            snap = getattr(state, "_saved", None) if state is not None else None
+            _persist_and_exec(snap)
+            return
+        # PLANNED change (failure=False): the contract is keep-state.  The
+        # main thread may merely be in a long non-collective phase (eval, a
+        # checkpoint write) rather than wedged — rolling back to the last
+        # commit would silently discard live progress, and if this worker
+        # becomes rank 0 of the new world, post-boot sync() would broadcast
+        # the rolled-back (or commit-less fresh) state to every peer.
+        # Attempt a live snapshot under a bounded deadline first; it can
+        # only block if the main thread really is stuck in a collective the
+        # membership change killed, and then the commit fallback applies.
+        # Residual risk, accepted: if the main thread is actively MUTATING
+        # state (not merely in a long eval/checkpoint phase), the side-
+        # thread snapshot can catch fields mid-update (each field is
+        # consistent, cross-field skew possible).  Post-boot sync()
+        # re-seeds every peer from rank 0, so skew only matters if THIS
+        # worker becomes rank 0 — still strictly better than discarding
+        # the progress outright, which loses data on every planned change
+        # for commit-less users.  Commit periodically to shrink both.
         get_logger().warning(
             "elastic: main thread did not begin recovery within %.1fs of a "
-            "peer failure (likely blocked in a dead collective); forcing "
-            "exec-restart from the last commit", _FAILURE_GRACE,
+            "planned membership change; attempting a live state snapshot "
+            "(%.0fs budget) before exec-restart",
+            _FAILURE_GRACE, _PLANNED_SNAPSHOT_TIMEOUT,
         )
-        # the committed snapshot ONLY, never a live state._snapshot(): the
-        # main thread may be mid-batch (inconsistent fields), and a live
-        # snapshot's host materialization could block on the very dead
-        # collective this thread is rescuing it from.  With no commit yet,
-        # restart bare and let post-boot state.sync() re-seed from rank 0.
-        snap = getattr(state, "_saved", None) if state is not None else None
+        snap, ok = _bounded_live_snapshot(state, _PLANNED_SNAPSHOT_TIMEOUT)
+        with self._lock:
+            if self._pending_epoch is None:
+                # the main thread began recovery while we were snapshotting
+                # — stand down and let it drive its own restart
+                self._watchdog_armed = False
+                return
+        if not ok:
+            snap = getattr(state, "_saved", None) if state is not None else None
+            if snap is None:
+                get_logger().error(
+                    "elastic: live snapshot timed out and no commit exists "
+                    "— restarting bare; ALL training progress on this "
+                    "worker is lost.  Call state.commit() periodically to "
+                    "bound this loss."
+                )
+            else:
+                get_logger().warning(
+                    "elastic: live snapshot timed out; falling back to the "
+                    "last committed snapshot (progress since the last "
+                    "commit is lost)"
+                )
         _persist_and_exec(snap)
 
     def check_for_updates(self) -> None:
@@ -387,6 +447,36 @@ def restart_after_failure(state) -> None:
     snap = state._snapshot() if hasattr(state, "_snapshot") else None
     get_logger().info("elastic: peer failure — exec-restarting this worker")
     _persist_and_exec(snap)
+
+
+def _bounded_live_snapshot(state, timeout_s: float):
+    """Attempt ``state._snapshot()`` on a side thread under a deadline.
+
+    Returns ``(snapshot, True)`` on success, ``(None, False)`` when the
+    state has no snapshot hook, the snapshot raised, or it blocked past
+    the deadline (the thread is daemonic; an abandoned attempt cannot
+    keep the process alive, and the caller exec-restarts anyway)."""
+    if state is None or not hasattr(state, "_snapshot"):
+        return None, False
+    box = {}
+
+    def _snap():
+        try:
+            box["snap"] = state._snapshot()
+        except BaseException as e:  # device errors are not Exception-only
+            box["err"] = e
+
+    t = threading.Thread(target=_snap, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if "snap" in box:
+        return box["snap"], True
+    if "err" in box:
+        get_logger().warning(
+            "elastic: live snapshot raised %s: %s",
+            type(box["err"]).__name__, box["err"],
+        )
+    return None, False
 
 
 def _persist_and_exec(snap) -> None:

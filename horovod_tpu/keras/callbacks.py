@@ -79,9 +79,10 @@ class MetricAverageCallback(keras.callbacks.Callback):
 
 
 class LearningRateWarmupCallback(keras.callbacks.Callback):
-    """Linear LR warmup from ``target_lr / size`` to ``target_lr`` over
-    the first epochs (reference: hvd.callbacks.LearningRateWarmupCallback,
-    after Goyal et al.)."""
+    """Linear LR warmup from ``target_lr / cross_size()`` (the number of
+    gradient-averaging processes) to ``target_lr`` over the first epochs
+    (reference: hvd.callbacks.LearningRateWarmupCallback, after Goyal et
+    al.)."""
 
     def __init__(self, target_lr: float, warmup_epochs: float = 5,
                  steps_per_epoch: Optional[int] = None,
@@ -97,7 +98,13 @@ class LearningRateWarmupCallback(keras.callbacks.Callback):
     def _initial(self) -> float:
         if self.initial_lr is not None:
             return self.initial_lr
-        size = basics.size() if basics.is_initialized() else 1
+        # cross_size (process count), not size (chip count): the adapter's
+        # gradient averaging divides by the number of contributing
+        # PROCESSES, and the scaling recipe's target_lr is scaled by the
+        # same factor — so warmup must start from target/processes.  On
+        # one-chip-per-process topologies the two are equal.  (ADVICE
+        # round 3; pass initial_lr explicitly to override.)
+        size = basics.cross_size() if basics.is_initialized() else 1
         return self.target_lr / size
 
     def on_epoch_begin(self, epoch, logs=None):

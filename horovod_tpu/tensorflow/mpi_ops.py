@@ -13,12 +13,22 @@ programs (e.g. Keras ``model.fit``'s compiled ``train_step``) execute the
 same negotiated collective at run time.  Output shapes are re-asserted
 where statically known (allreduce/broadcast preserve shape).
 
+``tf.function(jit_compile=True)``: py_function has no XLA lowering, so
+shape-preserving collectives switch to the XLA custom-call bridge
+(``xla_ops`` — reference: tensorflow/xla_mpi_ops.cc) when tracing for a
+must-compile function (auto-detected; HOROVOD_ENABLE_XLA_OPS=1 forces it
+for all graph mode, =0 disables).  Shape-dynamic collectives (allgather,
+alltoall, reducescatter) cannot be XLA-compiled — same scoping as the
+reference's allreduce-only XLA op set — and raise with a migration hint.
+
 The TPU compute path for new code remains the JAX API; this adapter
 exists for reference-script parity and CPU-hosted TF training.
 """
 
 from __future__ import annotations
 
+import os
+import sys
 from typing import Optional
 
 import numpy as np
@@ -33,9 +43,47 @@ def _is_symbolic(t) -> bool:
     return isinstance(t, tf.Tensor) and not hasattr(t, "numpy")
 
 
+def _xla_path() -> bool:
+    """True when collectives should lower through the XLA custom-call
+    bridge: tracing for jit_compile=True (or forced via env) and the
+    bridge built.  Trace-time only — never on the eager fast path."""
+    if os.environ.get("HOROVOD_ENABLE_XLA_OPS", "").lower() in ("0", "false"):
+        return False
+    from . import xla_ops
+
+    return xla_ops.in_jit_trace() and xla_ops.available()
+
+
+def _reject_in_jit(op_name: str) -> None:
+    from . import xla_ops
+
+    # consider_env=False: the HOROVOD_ENABLE_XLA_OPS force flag must not
+    # reject shape-dynamic ops in PLAIN graphs, where py_function works
+    if xla_ops.in_jit_trace(consider_env=False):
+        raise NotImplementedError(
+            f"hvd.{op_name} has a data-dependent output shape and cannot "
+            "run inside tf.function(jit_compile=True) (XLA needs static "
+            "shapes; the reference's XLA op set is likewise "
+            "allreduce-only).  Call it outside the jit-compiled function, "
+            "or use the JAX surface (horovod_tpu.ops.spmd_ops) where "
+            "uneven collectives are compiled natively."
+        )
+
+
+def _check_xla_error() -> None:
+    """Surface an engine error captured inside a compiled program (the
+    XLA bridge cannot raise through XLA) from the next eager/graph entry.
+    sys.modules guard: never pays the bridge import on sessions that
+    never used jit_compile."""
+    m = sys.modules.get(__package__ + ".xla_ops")
+    if m is not None:
+        m.maybe_reraise()
+
+
 def _run(engine_fn, tensor, out_dtype=None, preserve_shape=True):
     """Execute ``engine_fn(np_array) -> np_array`` on a TF tensor, in
     eager or graph mode."""
+    _check_xla_error()
     tensor = tf.convert_to_tensor(tensor)
     out_dtype = out_dtype or tensor.dtype
     if not _is_symbolic(tensor):
@@ -61,6 +109,14 @@ def allreduce(tensor, average: Optional[bool] = None,
               process_set: Optional[ProcessSet] = None):
     """Reference: horovod/tensorflow/mpi_ops.py allreduce (op defaults to
     Average, as upstream's ``hvd.allreduce``)."""
+    tensor = tf.convert_to_tensor(tensor)  # once; _run's convert is a no-op
+    if _is_symbolic(tensor) and _xla_path():
+        from . import xla_ops
+
+        return xla_ops.xla_allreduce(
+            tensor, average=average, name=name, op=op,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor, process_set=process_set)
     return _run(
         lambda a: _ops.allreduce(
             a, average=average, name=name, op=op,
@@ -79,6 +135,7 @@ def grouped_allreduce(tensors, average: Optional[bool] = None,
                       process_set: Optional[ProcessSet] = None):
     """Reference: horovod/tensorflow/mpi_ops.py grouped_allreduce — the
     group executes atomically (all fuse together or none)."""
+    _check_xla_error()
     tensors = [tf.convert_to_tensor(t) for t in tensors]
     kwargs = dict(
         average=average, name=name, op=op, prescale_factor=prescale_factor,
@@ -88,6 +145,13 @@ def grouped_allreduce(tensors, average: Optional[bool] = None,
         outs = _ops.grouped_allreduce([t.numpy() for t in tensors], **kwargs)
         return [tf.convert_to_tensor(np.asarray(o), dtype=t.dtype)
                 for o, t in zip(outs, tensors)]
+    if _xla_path():
+        from . import xla_ops
+
+        return xla_ops.xla_grouped_allreduce(
+            tensors, average=average, name=name, op=op,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor, process_set=process_set)
     douts = [t.dtype for t in tensors]
 
     def run(*arrays):
@@ -107,6 +171,9 @@ def allgather(tensor, name: Optional[str] = None,
               process_set: Optional[ProcessSet] = None):
     """Concatenate each rank's tensor along axis 0; first dims may differ
     per rank (reference: HorovodAllgather's uneven recvcounts)."""
+    tensor = tf.convert_to_tensor(tensor)
+    if _is_symbolic(tensor):
+        _reject_in_jit("allgather")
     return _run(
         lambda a: _ops.allgather(a, name=name, process_set=process_set),
         tensor, preserve_shape=False,
@@ -115,6 +182,12 @@ def allgather(tensor, name: Optional[str] = None,
 
 def broadcast(tensor, root_rank: int, name: Optional[str] = None,
               process_set: Optional[ProcessSet] = None):
+    tensor = tf.convert_to_tensor(tensor)
+    if _is_symbolic(tensor) and _xla_path():
+        from . import xla_ops
+
+        return xla_ops.xla_broadcast(tensor, root_rank, name=name,
+                                     process_set=process_set)
     return _run(
         lambda a: _ops.broadcast(a, root_rank, name=name,
                                  process_set=process_set),
@@ -129,6 +202,7 @@ def alltoall(tensor, splits=None, name: Optional[str] = None,
              process_set: Optional[ProcessSet] = None):
     """Returns (received, received_splits) like the reference's
     HorovodAlltoall."""
+    _check_xla_error()
     tensor = tf.convert_to_tensor(tensor)
     have_splits = splits is not None
     if have_splits:
@@ -142,6 +216,8 @@ def alltoall(tensor, splits=None, name: Optional[str] = None,
         return np.asarray(received), np.asarray(recv_splits, np.int32)
 
     symbolic = _is_symbolic(tensor) or (have_splits and _is_symbolic(splits))
+    if symbolic:
+        _reject_in_jit("alltoall")
     if not symbolic:
         received, recv_splits = run(tensor, splits if have_splits else None)
         return (tf.convert_to_tensor(received, dtype=tensor.dtype),
@@ -159,6 +235,9 @@ def alltoall(tensor, splits=None, name: Optional[str] = None,
 def reducescatter(tensor, op: Optional[ReduceOp] = None,
                   name: Optional[str] = None,
                   process_set: Optional[ProcessSet] = None):
+    tensor = tf.convert_to_tensor(tensor)
+    if _is_symbolic(tensor):
+        _reject_in_jit("reducescatter")
     return _run(
         lambda a: _ops.reducescatter(a, op=op, name=name,
                                      process_set=process_set),
@@ -170,6 +249,7 @@ def reducescatter(tensor, op: Optional[ReduceOp] = None,
 
 
 def barrier(process_set: Optional[ProcessSet] = None) -> None:
+    _check_xla_error()
     _ops.barrier(process_set=process_set)
 
 

@@ -180,6 +180,16 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
     direct path.  ``backward_passes_per_step > 1`` aggregates locally for
     N applies and allreduces once (eager-mode python state; matches the
     reference's LocalGradientAggregationHelper semantics)."""
+    # Re-wrap guard (ADVICE round 3): wrapping twice would make
+    # ``super(self.__class__, self)`` resolve to the same frame in both
+    # dynamic subclasses — infinite recursion instead of a clear error.
+    # Matches the reference, which raises ValueError on an already-wrapped
+    # optimizer (easy to hit re-running user setup after an exec-restart).
+    if optimizer.__class__.__dict__.get("apply") is _distributed_apply:
+        raise ValueError(
+            "optimizer is already a horovod_tpu DistributedOptimizer; "
+            "wrapping it twice is not supported"
+        )
     cls = type(optimizer.__class__.__name__, (optimizer.__class__,), {
         "apply": _distributed_apply,
     })

@@ -207,8 +207,12 @@ def alltoall_async(tensor: torch.Tensor,
 
     def finalize(out):
         received, recv_splits = out
+        # np.array(copy=True): recv_splits can arrive as a read-only
+        # buffer view, and from_numpy on one yields a tensor whose
+        # in-place writes are undefined behavior (ADVICE round 3)
         return (_from_np(received, tensor),
-                torch.from_numpy(np.asarray(recv_splits)).to(torch.int32))
+                torch.from_numpy(
+                    np.array(recv_splits, copy=True)).to(torch.int32))
 
     return _handles.allocate(inner, finalize)
 

@@ -1,0 +1,64 @@
+"""Build hook for the native core (reference analog: setup.py driving the
+CMake build — SURVEY.md §2.5, scaled to this dependency-free core).
+
+``pip install .`` compiles ``horovod_tpu/native/libhvd_tpu_core.so`` from
+``horovod_tpu/native/src`` with the system g++ — no Python headers needed
+(the core is a flat C API loaded via ctypes, not a CPython extension).
+The build is marked optional: on a machine with no C++ toolchain the
+install still succeeds and the framework uses its Python fallback
+controller (single-process) or the lazy in-tree `make` (dev checkouts).
+"""
+
+import os
+import subprocess
+
+from setuptools import Extension, setup
+from setuptools.command.build_ext import build_ext
+
+_SRC_DIR = os.path.join("horovod_tpu", "native", "src")
+_SOURCES = ["message.cc", "controller.cc", "c_api.cc"]
+_CXXFLAGS = ["-O2", "-fPIC", "-std=c++17", "-Wall", "-Wextra", "-pthread"]
+
+
+class NativeCoreExtension(Extension):
+    def __init__(self):
+        super().__init__(
+            "horovod_tpu.native.libhvd_tpu_core",
+            sources=[os.path.join(_SRC_DIR, s) for s in _SOURCES],
+        )
+        self.optional = True  # no toolchain -> pure-python install
+
+
+class BuildNativeCore(build_ext):
+    def get_ext_filename(self, fullname):
+        # a plain shared library, dlopened by ctypes: no CPython ABI
+        # suffix — the loader looks for exactly "libhvd_tpu_core.so"
+        if fullname.split(".")[-1] == "libhvd_tpu_core":
+            return os.path.join(*fullname.split(".")[:-1],
+                                "libhvd_tpu_core.so")
+        return super().get_ext_filename(fullname)
+
+    def build_extension(self, ext):
+        if not isinstance(ext, NativeCoreExtension):
+            return super().build_extension(ext)
+        out = self.get_ext_fullpath(ext.name)
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        cxx = os.environ.get("CXX", "g++")
+        cmd = [cxx, *_CXXFLAGS, "-shared", "-o", out, *ext.sources]
+        self.announce(" ".join(cmd), level=2)
+        # failures must surface as CCompilerError: that is the ONLY family
+        # setuptools' optional-extension filter swallows — a raw
+        # FileNotFoundError (no g++) would abort the whole install instead
+        # of degrading to the documented pure-python fallback
+        try:
+            subprocess.run(cmd, check=True)
+        except (OSError, subprocess.SubprocessError) as e:
+            from distutils.errors import CCompilerError
+
+            raise CCompilerError(f"native core build failed: {e}") from e
+
+
+setup(
+    ext_modules=[NativeCoreExtension()],
+    cmdclass={"build_ext": BuildNativeCore},
+)

@@ -164,6 +164,81 @@ def test_run_wrapper_reraises_without_elastic_driver():
     assert state.value == 0
 
 
+def _fired_watchdog(monkeypatch, state, failure, snapshot_timeout=5.0):
+    """Drive WorkerNotificationManager._failure_watchdog to the point of
+    forced restart (main thread never clears the pending update) and
+    capture what snapshot it would persist."""
+    from horovod_tpu.elastic import worker as w
+
+    persisted = {}
+
+    def fake_persist(snap):
+        persisted["snap"] = snap
+        raise SystemExit(0)  # the real one execv-replaces the process
+
+    monkeypatch.setattr(w, "_persist_and_exec", fake_persist)
+    monkeypatch.setattr(w, "_FAILURE_GRACE", 0.2)
+    monkeypatch.setattr(w, "_PLANNED_SNAPSHOT_TIMEOUT", snapshot_timeout)
+
+    mgr = w.WorkerNotificationManager()
+    mgr.watch_state(state)
+    mgr._pending_epoch = 1
+    mgr._pending_failure = failure
+    with pytest.raises(SystemExit):
+        mgr._failure_watchdog()
+    return persisted["snap"]
+
+
+def test_watchdog_failure_rolls_back_to_commit(monkeypatch):
+    # On failure=True the watchdog must persist the COMMITTED snapshot,
+    # never a live one (live materialization could block on the dead
+    # collective it is rescuing the worker from).
+    state = ObjectState(value=1)
+    state.commit()
+    state.value = 999  # uncommitted live progress
+    snap = _fired_watchdog(monkeypatch, state, failure=True)
+    assert snap is not None
+    restored = ObjectState(value=0)
+    restored._apply_snapshot(snap)
+    assert restored.value == 1
+
+
+def test_watchdog_planned_change_keeps_live_state(monkeypatch):
+    # ADVICE round 3 (medium): a planned change's contract is keep-state.
+    # The watchdog must attempt a live snapshot so >grace non-collective
+    # phases (eval, checkpoint writes) don't silently lose progress.
+    state = ObjectState(value=1)
+    state.commit()
+    state.value = 999
+    snap = _fired_watchdog(monkeypatch, state, failure=False)
+    restored = ObjectState(value=0)
+    restored._apply_snapshot(snap)
+    assert restored.value == 999
+
+
+def test_watchdog_planned_change_falls_back_when_snapshot_blocks(monkeypatch):
+    # If the live snapshot itself wedges (main thread really is stuck in a
+    # dead collective), the bounded attempt times out and the committed
+    # snapshot is used instead.
+    state = ObjectState(value=1)
+    state.commit()
+    state.value = 999
+
+    real_snapshot = state._snapshot
+
+    def blocked_snapshot():
+        time.sleep(60)
+        return real_snapshot()
+
+    state._snapshot = blocked_snapshot
+    snap = _fired_watchdog(
+        monkeypatch, state, failure=False, snapshot_timeout=0.3
+    )
+    restored = ObjectState(value=0)
+    restored._apply_snapshot(snap)
+    assert restored.value == 1
+
+
 def test_run_wrapper_keeps_state_on_hosts_updated(monkeypatch):
     import horovod_tpu.elastic as elastic
 

@@ -63,6 +63,28 @@ def test_distributed_optimizer_matches_plain_sgd():
         np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
 
 
+def test_distributed_optimizer_rejects_double_wrap():
+    # ADVICE round 3: wrapping twice used to recurse infinitely inside
+    # super(self.__class__, self).apply — must be a clear error instead.
+    opt = hvd.DistributedOptimizer(keras.optimizers.SGD(0.1))
+    with pytest.raises(ValueError, match="already"):
+        hvd.DistributedOptimizer(opt)
+
+
+def test_warmup_default_initial_lr_uses_process_count(monkeypatch):
+    # ADVICE round 3: gradient averaging divides by the PROCESS count
+    # (cross_size), so the warmup default must start from
+    # target/processes, not target/chips.
+    from horovod_tpu.common import basics
+    from horovod_tpu.keras.callbacks import LearningRateWarmupCallback
+
+    monkeypatch.setattr(basics, "is_initialized", lambda: True)
+    monkeypatch.setattr(basics, "cross_size", lambda: 2)
+    monkeypatch.setattr(basics, "size", lambda: 16)  # 8 chips/process
+    cb = LearningRateWarmupCallback(target_lr=0.8)
+    assert cb._initial() == pytest.approx(0.4)
+
+
 def test_backward_passes_per_step_aggregates():
     model = _tiny_model()
     opt = hvd.DistributedOptimizer(

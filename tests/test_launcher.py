@@ -128,6 +128,9 @@ def test_tpurun_tensorflow_adapter():
     res = _run_tpurun(2, timeout=420, target=tf_worker, target_args=["2"])
     assert res.returncode == 0, f"stdout:\n{res.stdout}\nstderr:\n{res.stderr[-4000:]}"
     assert res.stdout.count("TF_WORKER_OK") == 2
+    # the jit_compile=True leg must have RUN (bridge builds under g++,
+    # which this image has) — a silent skip would mask a regression
+    assert res.stdout.count("TF_WORKER_XLA_OK") == 2, res.stdout
 
 
 @pytest.mark.integration

@@ -307,3 +307,130 @@ def test_distributed_gradient_tape_indexed_slices():
     np.testing.assert_allclose(dense[1], np.ones(4))
     np.testing.assert_allclose(dense[3], np.full(4, 2.0))  # dup summed
     np.testing.assert_allclose(dense[0], np.zeros(4))
+
+
+# -- XLA custom-call bridge (jit_compile=True) -------------------------------
+# Reference: tensorflow/xla_mpi_ops.cc — collectives inside a must-compile
+# tf.function.  World of one process: allreduce is identity/×size.
+
+def _xla_bridge():
+    from horovod_tpu.tensorflow import xla_ops
+
+    if not xla_ops.available():
+        pytest.skip("TF XLA bridge unavailable (no toolchain or TF libs)")
+    return xla_ops
+
+
+def test_allreduce_inside_jit_compile():
+    _xla_bridge()
+
+    @tf.function(jit_compile=True)
+    def f(x):
+        return hvd.allreduce(x, op=hvd.Sum, name="jit_allreduce") * 2.0
+
+    x = tf.constant([[1.0, 2.0], [3.0, 4.0]])
+    np.testing.assert_allclose(f(x).numpy(), x.numpy() * 2.0)
+
+
+def test_grouped_allreduce_inside_jit_compile():
+    _xla_bridge()
+
+    @tf.function(jit_compile=True)
+    def f(a, b):
+        x, y = hvd.grouped_allreduce([a, b], op=hvd.Sum, name="jit_group")
+        return x + 0.0, y + 0.0
+
+    a = tf.constant([1.0, 2.0])
+    b = tf.constant(np.arange(6, dtype=np.float32).reshape(2, 3))
+    xa, xb = f(a, b)
+    np.testing.assert_allclose(xa.numpy(), a.numpy())
+    np.testing.assert_allclose(xb.numpy(), b.numpy())
+
+
+def test_broadcast_inside_jit_compile():
+    _xla_bridge()
+
+    @tf.function(jit_compile=True)
+    def f(x):
+        return hvd.broadcast(x, root_rank=0, name="jit_bcast")
+
+    x = tf.constant([5.0, 6.0])
+    np.testing.assert_allclose(f(x).numpy(), x.numpy())
+
+
+def test_distributed_gradient_tape_inside_jit_compile():
+    _xla_bridge()
+    w = tf.Variable([2.0, -1.0])
+
+    @tf.function(jit_compile=True)
+    def step(scale):
+        with hvd.DistributedGradientTape(tf.GradientTape()) as tape:
+            loss = tf.reduce_sum(w * w) * scale
+        return tape.gradient(loss, [w])[0]
+
+    g = step(tf.constant(3.0))
+    np.testing.assert_allclose(g.numpy(), [12.0, -6.0], rtol=1e-6)
+
+
+def test_allgather_inside_jit_compile_raises_with_hint():
+    _xla_bridge()
+
+    @tf.function(jit_compile=True)
+    def f(x):
+        return hvd.allgather(x, name="jit_ag")
+
+    with pytest.raises(Exception, match="data-dependent output shape"):
+        f(tf.constant([1.0]))
+
+
+def test_jit_compile_detection_does_not_leak_to_plain_graph():
+    # plain tf.function must keep the py_function path (XlaCustomCallV2
+    # has no CPU kernel outside compiled clusters)
+    _xla_bridge()
+
+    @tf.function
+    def f(x):
+        return hvd.allreduce(x, op=hvd.Sum, name="plain_graph_after_xla")
+
+    x = tf.constant([7.0])
+    np.testing.assert_allclose(f(x).numpy(), [7.0])
+
+
+def test_jit_average_semantics():
+    _xla_bridge()
+
+    @tf.function(jit_compile=True)
+    def f(x):
+        return hvd.allreduce(x, name="jit_avg")  # default Average
+
+    x = tf.constant([4.0, 8.0])
+    np.testing.assert_allclose(f(x).numpy(), x.numpy())
+
+
+def test_engine_error_in_jit_surfaces_at_next_eager_call(monkeypatch):
+    # An engine failure inside a cached compiled step cannot raise
+    # through XLA: the callback records it (identity data returned) and
+    # the next eager collective re-raises it.  Async main-thread raise is
+    # disabled here to test the deferred path deterministically.
+    xla_ops = _xla_bridge()
+    monkeypatch.setenv("HVD_TPU_TF_XLA_ASYNC_RAISE", "0")
+
+    @tf.function(jit_compile=True)
+    def f(x):
+        return hvd.allreduce(x, op=hvd.Sum, name="jit_err")
+
+    f(tf.constant([1.0]))  # trace + first run OK
+
+    def boom(*a, **k):
+        raise RuntimeError("engine exploded")
+
+    monkeypatch.setattr(xla_ops, "_dispatch", boom)
+    out = f(tf.constant([2.0]))  # swallowed: identity data
+    np.testing.assert_allclose(out.numpy(), [2.0])
+    monkeypatch.undo()
+    with pytest.raises(RuntimeError, match="engine exploded"):
+        hvd.allreduce(tf.constant([1.0]), name="post_err")
+    # and the error is consumed — next call is clean
+    np.testing.assert_allclose(
+        hvd.allreduce(tf.constant([3.0]), op=hvd.Sum,
+                      name="post_err2").numpy(), [3.0])
