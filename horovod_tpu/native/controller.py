@@ -26,6 +26,7 @@ import numpy as np
 
 from ..common.exceptions import HorovodInternalError
 from ..common.topology import Topology
+from ..utils import profiler
 from ..utils.env_parser import Config
 from ..utils.logging import get_logger
 
@@ -300,39 +301,45 @@ class NativeController:
                 n = self._auto_counters.get(op_type, 0) + 1
                 self._auto_counters[op_type] = n
                 name = f"op{op_type}.auto.{n}"
-        arr = jnp.asarray(array)
-        dtype_enum = _DTYPE_TO_ENUM.get(str(arr.dtype))
-        if dtype_enum is None:
-            raise TypeError(
-                f"dtype {arr.dtype} is not supported on the native "
-                "collective path"
+        # the ENQUEUE span also lands in any active jax.profiler capture
+        # (utils/profiler.py bridge), same activity name as the timeline
+        with profiler.span(name, "ENQUEUE"):
+            arr = jnp.asarray(array)
+            dtype_enum = _DTYPE_TO_ENUM.get(str(arr.dtype))
+            if dtype_enum is None:
+                raise TypeError(
+                    f"dtype {arr.dtype} is not supported on the native "
+                    "collective path"
+                )
+            shape = (ctypes.c_longlong * max(arr.ndim, 1))(*(
+                list(arr.shape) or [0]
+            ))
+            fut = Future()
+            # Register the future under a caller-assigned id BEFORE the
+            # entry becomes visible to the background thread — the 1 ms
+            # cycle can execute the entry before control returns from the
+            # ctypes call.
+            entry_id = counter
+            with self._entries_lock:
+                self._entries[entry_id] = _Entry(
+                    arr, fut, op_type, extra, name=name
+                )
+            # reduce_op rides in the root_rank field for allreduce (the C
+            # core treats both as opaque fuse keys); keep them separate
+            # fields here.
+            if splits is not None:
+                splits_list = [int(s) for s in np.asarray(splits).ravel()]
+                c_splits = (ctypes.c_longlong * len(splits_list))(
+                    *splits_list)
+                n_splits = len(splits_list)
+            else:
+                c_splits, n_splits = None, 0
+            rc = self._lib.hvdtpu_enqueue(
+                ctypes.c_longlong(entry_id), name.encode(), op_type,
+                dtype_enum, shape, arr.ndim, process_set_id, group_id,
+                root_rank if op_type == OP_BROADCAST else int(reduce_op),
+                prescale, postscale, c_splits, n_splits,
             )
-        shape = (ctypes.c_longlong * max(arr.ndim, 1))(*(
-            list(arr.shape) or [0]
-        ))
-        fut = Future()
-        # Register the future under a caller-assigned id BEFORE the entry
-        # becomes visible to the background thread — the 1 ms cycle can
-        # execute the entry before control returns from the ctypes call.
-        entry_id = counter
-        with self._entries_lock:
-            self._entries[entry_id] = _Entry(
-                arr, fut, op_type, extra, name=name
-            )
-        # reduce_op rides in the root_rank field for allreduce (the C core
-        # treats both as opaque fuse keys); keep them separate fields here.
-        if splits is not None:
-            splits_list = [int(s) for s in np.asarray(splits).ravel()]
-            c_splits = (ctypes.c_longlong * len(splits_list))(*splits_list)
-            n_splits = len(splits_list)
-        else:
-            c_splits, n_splits = None, 0
-        rc = self._lib.hvdtpu_enqueue(
-            ctypes.c_longlong(entry_id), name.encode(), op_type, dtype_enum,
-            shape, arr.ndim, process_set_id, group_id,
-            root_rank if op_type == OP_BROADCAST else int(reduce_op),
-            prescale, postscale, c_splits, n_splits,
-        )
         if rc < 0:
             with self._entries_lock:
                 self._entries.pop(entry_id, None)
@@ -408,8 +415,16 @@ class NativeController:
                     )
             if not entries:
                 return
-            self._execute(op, process_set, root_or_rop, prescale, postscale,
-                          entries, extents)
+            # XLA_COMM span on the exec thread for jax.profiler captures —
+            # covers dispatch of the fused program (through data-ready when
+            # the timeline is active, which blocks in resolve()); matches
+            # the timeline's span of the same name (utils/profiler.py)
+            label = entries[0].name or f"op{op}"
+            if len(entries) > 1:
+                label += f"+{len(entries) - 1}"
+            with profiler.span(label, "XLA_COMM"):
+                self._execute(op, process_set, root_or_rop, prescale,
+                              postscale, entries, extents)
         except BaseException as exc:  # never let exceptions cross into C++
             get_logger().error("native exec callback failed: %s", exc)
             try:

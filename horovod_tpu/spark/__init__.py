@@ -29,7 +29,7 @@ from .estimator import (  # noqa: F401
     TorchModel,
 )
 from .store import (  # noqa: F401
-    GCSStore, HDFSStore, LocalStore, S3Store, Store,
+    FsspecStore, GCSStore, HDFSStore, LocalStore, S3Store, Store,
 )
 
 

@@ -16,6 +16,8 @@ import sys
 
 import numpy as np
 
+from . import sharding
+
 
 def _load_store(spec):
     from . import store as store_mod
@@ -29,19 +31,19 @@ def _load_store(spec):
         raise ValueError(
             f"worker cannot reconstruct store class {spec['store_cls']!r}; "
             "estimator subprocess workers support the built-in stores "
-            "(LocalStore/HDFSStore/S3Store/GCSStore)"
+            "(LocalStore/FsspecStore/HDFSStore/S3Store/GCSStore)"
         )
     return cls(spec["store_prefix"])
 
 
-def _load_val(store, spec):
-    path = os.path.join(
-        store.get_val_data_path(spec["run_id"]), "part_0.npz"
-    )
-    if not store.exists(path):
+def _load_val(store, spec, manifest):
+    if not manifest.get("val_shards"):
         return None
-    with np.load(io.BytesIO(store.read_bytes(path))) as z:
-        return {k: z[k] for k in z.files}
+    reader = sharding.ShardReader(
+        store, store.get_val_data_path(spec["run_id"]), 0,
+        manifest["val_shards"],
+    )
+    return reader.load_all()
 
 
 def _write_history(store, spec, history):
@@ -53,26 +55,28 @@ def _write_history(store, spec, history):
     )
 
 
-def _load_shard(store, spec, rank):
-    path = os.path.join(
-        store.get_train_data_path(spec["run_id"]), f"part_{rank}.npz"
+def _shard_reader(store, spec, rank):
+    """This rank's streaming train reader + the run manifest (reference:
+    the per-task Petastorm reader over assigned row groups)."""
+    manifest = sharding.read_manifest(
+        store, store.get_run_path(spec["run_id"])
     )
-    with np.load(io.BytesIO(store.read_bytes(path))) as z:
-        return {k: z[k] for k in z.files}
+    reader = sharding.ShardReader(
+        store, store.get_train_data_path(spec["run_id"]), rank,
+        manifest["shards_per_rank"][rank],
+    )
+    return reader, manifest
 
 
-def _batches(shard, spec, rng):
-    feats = [shard[c] for c in spec["feature_cols"]]
-    labels = [shard[c] for c in spec["label_cols"]]
-    n = len(feats[0])
-    bs = spec["batch_size"]
-    idx = rng.permutation(n)
-    # drop the ragged tail so every rank steps the same number of times
-    # (reference: Petastorm loaders make epochs divisible; ragged tails
-    # would desynchronize the allreduce count across ranks)
-    for start in range(0, n - bs + 1, bs):
-        take = idx[start:start + bs]
-        yield [f[take] for f in feats], [l[take] for l in labels]
+def _batches(reader, spec, rng, usable_rows):
+    """One epoch of (features, labels) batches; every rank yields exactly
+    usable_rows // batch_size batches (manifest-equalized — ragged tails
+    would desynchronize the allreduce count across ranks)."""
+    for batch in reader.iter_batches(
+        rng, spec["batch_size"], usable_rows
+    ):
+        yield ([batch[c] for c in spec["feature_cols"]],
+               [batch[c] for c in spec["label_cols"]])
 
 
 def _resolve_flax_pieces(extra):
@@ -109,10 +113,11 @@ def _train_flax(spec, store, rank):
 
     model = spec["model"]
     optimizer, loss_fn = _resolve_flax_pieces(spec["extra"])
-    shard = _load_shard(store, spec, rank)
+    reader, manifest = _shard_reader(store, spec, rank)
+    usable = manifest["usable_rows"]
     rng = np.random.RandomState(spec["seed"] + 1)
 
-    sample_feats, _ = next(_batches(shard, spec, rng))
+    sample_feats, _ = next(_batches(reader, spec, rng, usable))
     variables = model.init(
         jax.random.PRNGKey(spec["seed"]), *map(jnp.asarray, sample_feats)
     )
@@ -130,12 +135,12 @@ def _train_flax(spec, store, rank):
 
         return jax.value_and_grad(compute)(p)
 
-    val = _load_val(store, spec) if hvd.cross_rank() == 0 else None
+    val = _load_val(store, spec, manifest) if hvd.cross_rank() == 0 else None
     history = {"loss": [], "val_loss": []}
     for epoch in range(spec["epochs"]):
         epoch_rng = np.random.RandomState(spec["seed"] + 1 + epoch)
         loss = None
-        for feats, labels in _batches(shard, spec, epoch_rng):
+        for feats, labels in _batches(reader, spec, epoch_rng, usable):
             feats = [jnp.asarray(f) for f in feats]
             labels = [jnp.asarray(l) for l in labels]
             loss, grads = grads_of(params, feats, labels)
@@ -192,7 +197,8 @@ def _train_torch(spec, store, rank):
     optimizer = hvd_torch.DistributedOptimizer(
         optimizer, named_parameters=model.named_parameters()
     )
-    shard = _load_shard(store, spec, rank)
+    reader, manifest = _shard_reader(store, spec, rank)
+    usable = manifest["usable_rows"]
 
     def to_tensors(feats, labels):
         tf = [torch.as_tensor(np.asarray(f, np.float32)) for f in feats]
@@ -203,12 +209,13 @@ def _train_torch(spec, store, rank):
         )
         return tf, ty
 
-    val = _load_val(store, spec) if hvd_torch.cross_rank() == 0 else None
+    val = (_load_val(store, spec, manifest)
+           if hvd_torch.cross_rank() == 0 else None)
     history = {"loss": [], "val_loss": []}
     for epoch in range(spec["epochs"]):
         epoch_rng = np.random.RandomState(spec["seed"] + 1 + epoch)
         loss = None
-        for feats, labels in _batches(shard, spec, epoch_rng):
+        for feats, labels in _batches(reader, spec, epoch_rng, usable):
             tf, ty = to_tensors(feats, labels)
             optimizer.zero_grad()
             loss = loss_fn(model(*tf), ty)
@@ -251,11 +258,18 @@ def _train_keras(spec, store, rank):
     # RNGs this seeds
     keras.utils.set_random_seed(spec["seed"])
     model = keras.models.model_from_json(extra["model_json"])
-    shard = _load_shard(store, spec, rank)
-    feats = [np.asarray(shard[c], np.float32)
-             for c in spec["feature_cols"]]
-    x = feats[0] if len(feats) == 1 else feats
-    y = np.asarray(shard[spec["label_cols"][0]])
+    reader, manifest = _shard_reader(store, spec, rank)
+    usable = manifest["usable_rows"]
+    steps_per_epoch = usable // spec["batch_size"]
+
+    def _xy(feats, labels):
+        fx = [np.asarray(f, np.float32) for f in feats]
+        return fx[0] if len(fx) == 1 else fx, np.asarray(labels[0])
+
+    sample_feats, _sample_labels = next(_batches(
+        reader, spec, np.random.RandomState(spec["seed"] + 1), usable
+    ))
+    sample_x, _ = _xy(sample_feats, _sample_labels)
 
     # identical start on every rank: the estimator's initial weights ride
     # the spec (reference: the estimator broadcasts the driver's model).
@@ -265,8 +279,8 @@ def _train_keras(spec, store, rank):
     if extra["weights"]:
         model.set_weights([np.asarray(w) for w in extra["weights"]])
     else:
-        model(feats[0][:1] if len(feats) == 1
-              else [f[:1] for f in feats])  # build
+        model(sample_x[:1] if len(sample_feats) == 1
+              else [f[:1] for f in sample_x])  # build
         hvd_keras.broadcast_model_weights(model, root_rank=0)
     # capture the BUILT architecture before compile() attaches the
     # DistributedOptimizer (whose dynamic subclass can't deserialize
@@ -287,7 +301,7 @@ def _train_keras(spec, store, rank):
     val_losses = []
     callbacks = []
     if hvd_keras.cross_rank() == 0:
-        val = _load_val(store, spec)
+        val = _load_val(store, spec, manifest)
         if val is not None:
             vfeats = [np.asarray(val[c], np.float32)
                       for c in spec["feature_cols"]]
@@ -302,9 +316,22 @@ def _train_keras(spec, store, rank):
 
             callbacks.append(_ValCallback())
 
+    # streaming epochs: one shard resident at a time (reference: the
+    # Petastorm reader feeding keras fit); shuffle = shard order + rows
+    # within each shard per epoch, identical step counts across ranks
+    def _epochs():
+        epoch = 0
+        while True:
+            epoch_rng = np.random.RandomState(spec["seed"] + 1 + epoch)
+            for feats, labels in _batches(reader, spec, epoch_rng,
+                                          usable):
+                yield _xy(feats, labels)
+            epoch += 1
+
     hist = model.fit(
-        x, y, batch_size=spec["batch_size"], epochs=spec["epochs"],
-        shuffle=True, verbose=spec["verbose"], callbacks=callbacks,
+        _epochs(), steps_per_epoch=steps_per_epoch,
+        epochs=spec["epochs"], verbose=spec["verbose"],
+        callbacks=callbacks,
     )
 
     if hvd_keras.cross_rank() == 0:

@@ -4,16 +4,18 @@ Reference parity: horovod/spark/common/store.py (SURVEY.md §2.4 "Spark
 Estimators") — a Store owns the run directories estimators materialize
 training data into and checkpoint models out of (LocalStore, HDFSStore,
 S3Store, GCSStore, DBFSLocalStore upstream).  TPU-native scope: the
-LocalStore is fully functional (and is what the tests exercise); the
-remote stores resolve through fsspec when available, mirroring the
-upstream URL-prefix dispatch in Store.create().
+LocalStore is the tested default; remote stores resolve through fsspec
+(present in this image), mirroring the upstream URL-prefix dispatch in
+``Store.create()`` — any ``scheme://`` fsspec knows (s3, gs, hdfs,
+memory, ...) yields a working store, and ``memory://`` doubles as the
+in-process fake filesystem the round-trip tests run against.
 """
 
 from __future__ import annotations
 
 import os
 import time
-from typing import Optional
+from typing import List, Optional
 
 
 class Store:
@@ -56,13 +58,30 @@ class Store:
     def exists(self, path: str) -> bool:
         raise NotImplementedError
 
+    def list_files(self, path: str) -> List[str]:
+        """Base names of the files directly under ``path`` (sorted);
+        empty when the directory does not exist."""
+        raise NotImplementedError
+
+    # -- worker reconstruction ---------------------------------------------
+
+    def worker_spec(self) -> dict:
+        """How estimator subprocess workers rebuild this store
+        (class name + ctor args — the spec travels pickled)."""
+        return {"store_cls": type(self).__name__,
+                "store_prefix": self.prefix_path}
+
     @staticmethod
     def create(prefix_path: str) -> "Store":
-        """URL-prefix dispatch (reference: Store.create)."""
+        """URL-prefix dispatch (reference: Store.create).  Named schemes
+        map to their dedicated classes; any other ``scheme://`` URL
+        resolves through fsspec's registry (e.g. ``memory://``)."""
         for scheme, cls in (("hdfs://", HDFSStore), ("s3://", S3Store),
                             ("gs://", GCSStore)):
             if prefix_path.startswith(scheme):
                 return cls(prefix_path)
+        if "://" in prefix_path:
+            return FsspecStore(prefix_path)
         return LocalStore(prefix_path)
 
 
@@ -87,25 +106,39 @@ class LocalStore(Store):
     def exists(self, path: str) -> bool:
         return os.path.exists(path)
 
+    def list_files(self, path: str) -> List[str]:
+        if not os.path.isdir(path):
+            return []
+        return sorted(
+            f for f in os.listdir(path)
+            if os.path.isfile(os.path.join(path, f))
+        )
 
-class _FsspecStore(Store):
+
+class FsspecStore(Store):
     """Remote store via fsspec (reference: HDFSStore/S3Store/GCSStore).
-    fsspec is not installed in this image, so these are load-bearing only
-    where it exists; construction fails fast with guidance otherwise."""
+
+    ``prefix_path`` keeps its URL form (``s3://bucket/runs``); the
+    filesystem is resolved from the scheme.  Subclasses pin ``protocol``
+    for the reference-named stores; the base class accepts any scheme
+    fsspec's registry resolves (``memory://`` is the test double)."""
 
     protocol: Optional[str] = None
 
     def __init__(self, prefix_path: str):
         super().__init__(prefix_path)
+        proto = self.protocol or prefix_path.split("://", 1)[0]
         try:
             import fsspec
 
-            self._fs = fsspec.filesystem(self.protocol)
-        except ImportError as e:
+            self._fs = fsspec.filesystem(proto)
+        except (ImportError, OSError, ValueError) as e:
+            # ImportError: fsspec or the backend package missing;
+            # OSError: backend present but unusable (e.g. hdfs w/o JVM)
             raise ImportError(
-                f"{type(self).__name__} requires fsspec (pip install "
-                f"fsspec) with the {self.protocol} backend; use "
-                "LocalStore in environments without it"
+                f"{type(self).__name__} requires fsspec with a "
+                f"{proto!r} backend; use LocalStore in environments "
+                "without it"
             ) from e
 
     def makedirs(self, path: str) -> None:
@@ -122,14 +155,27 @@ class _FsspecStore(Store):
     def exists(self, path: str) -> bool:
         return self._fs.exists(path)
 
+    def list_files(self, path: str) -> List[str]:
+        if not self._fs.exists(path):
+            return []
+        out = []
+        for info in self._fs.ls(path, detail=True):
+            if info.get("type") == "file":
+                out.append(os.path.basename(info["name"].rstrip("/")))
+        return sorted(out)
 
-class HDFSStore(_FsspecStore):
+
+class HDFSStore(FsspecStore):
     protocol = "hdfs"
 
 
-class S3Store(_FsspecStore):
+class S3Store(FsspecStore):
     protocol = "s3"
 
 
-class GCSStore(_FsspecStore):
+class GCSStore(FsspecStore):
     protocol = "gs"
+
+
+# Backwards-compatible alias: round-3 shipped the fsspec base privately.
+_FsspecStore = FsspecStore

@@ -1,0 +1,57 @@
+"""jax.profiler bridge for the negotiated-collective spans.
+
+Reference analog: SURVEY.md §5.1 — the reference's timeline is its own
+Chrome-trace writer; its NVTX hooks put the same spans into the vendor
+profiler so one capture shows framework activity next to kernel
+activity.  The TPU-native equivalent: every negotiated collective emits
+``TraceMe`` spans (via :class:`jax.profiler.TraceAnnotation`) with the
+SAME activity names the Chrome timeline uses (ENQUEUE / XLA_COMM), so a
+single ``jax.profiler.trace`` XPlane capture shows where negotiation
+and collective execution sit relative to XLA's own ops.
+
+Span semantics (TraceMe spans are thread-local, so each side of the
+handoff gets its own span — the negotiation wait is the *gap*):
+
+  * ``hvd_tpu::<name>::ENQUEUE``   — training thread, inside enqueue();
+  * ``hvd_tpu::<op>::XLA_COMM``    — background exec thread, dispatch →
+    data-ready of the fused collective program.
+
+Overhead when no capture is active is one atomic load per span (TraceMe
+fast path), so the bridge is always on; set ``HVD_TPU_PROFILER_BRIDGE=0``
+to compile it out at import.
+
+Capture recipe (works on the 8-device CPU mesh and on TPU)::
+
+    import jax
+    jax.profiler.start_trace("/tmp/hvd-trace")
+    ... training steps / hvd.allreduce calls ...
+    jax.profiler.stop_trace()
+    # open the trace:
+    #   tensorboard --logdir /tmp/hvd-trace   (Profile plugin), or
+    #   load plugins/profile/<ts>/<host>.trace.json.gz in ui.perfetto.dev
+
+``tools/profile_capture.py`` scripts exactly this and produced the
+committed example trace (docs/example_trace.json.gz).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+_ENABLED = os.environ.get("HVD_TPU_PROFILER_BRIDGE", "1") != "0"
+
+if _ENABLED:
+    try:
+        from jax.profiler import TraceAnnotation
+    except Exception:  # pragma: no cover - ancient jax
+        _ENABLED = False
+
+_NULL = contextlib.nullcontext()
+
+
+def span(name: str, activity: str):
+    """Context manager for one framework span in the XPlane capture."""
+    if not _ENABLED:
+        return _NULL
+    return TraceAnnotation(f"hvd_tpu::{name}::{activity}")

@@ -1,0 +1,13 @@
+"""Fake pyspark.sql: just what horovod_tpu.spark.run touches."""
+
+from . import CALLS, _Session
+
+
+class _Builder:
+    def getOrCreate(self):
+        CALLS.append(("getOrCreate", None))
+        return _Session()
+
+
+class SparkSession:
+    builder = _Builder()

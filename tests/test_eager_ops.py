@@ -6,6 +6,8 @@ handle tests (allreduce_async/synchronize/poll).  Multi-process eager paths
 get exercised by the tpurun integration tests.
 """
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -151,3 +153,33 @@ def test_scalar_allreduce_preserves_zero_d_shape():
     out = hvd.allreduce(jnp.asarray(3.0), name="scalar_rt", op=hvd.Sum)
     assert np.asarray(out).shape == ()
     assert float(out) == 3.0
+
+
+def test_profiler_bridge_spans_in_xplane_capture(tmp_path):
+    """The jax.profiler bridge (utils/profiler.py) puts ENQUEUE/XLA_COMM
+    spans into an XPlane capture with the same names the Chrome timeline
+    uses — SURVEY.md §5.1's 'framework spans next to XLA ops' view."""
+    import glob
+    import gzip
+    import json
+
+    logdir = str(tmp_path / "trace")
+    x = jnp.arange(1024, dtype=jnp.float32)
+    hvd.allreduce(x, name="bridge_warm")  # compile outside the capture
+    jax.profiler.start_trace(logdir)
+    try:
+        out = hvd.allreduce(x, name="bridge_probe", op=hvd.Sum)
+        jax.block_until_ready(out)
+    finally:
+        jax.profiler.stop_trace()
+    traces = glob.glob(
+        os.path.join(logdir, "plugins", "profile", "*", "*.trace.json.gz")
+    )
+    assert traces, "no trace file produced"
+    with gzip.open(traces[0]) as f:
+        events = json.load(f)["traceEvents"]
+    names = {str(e.get("name", "")) for e in events}
+    assert any("hvd_tpu::bridge_probe" in n and "ENQUEUE" in n
+               for n in names), sorted(n for n in names if "hvd" in n)
+    assert any("hvd_tpu::bridge_probe" in n and "XLA_COMM" in n
+               for n in names), sorted(n for n in names if "hvd" in n)

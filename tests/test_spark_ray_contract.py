@@ -1,0 +1,144 @@
+"""Contract tests for the pyspark / ray launch paths with faked modules.
+
+Reference analog: the reference CI runs horovod.spark/ray against real
+installations; this image ships neither (VERDICT r3 item 5), so these
+tests inject minimal fakes (tests/_fake_modules) that pin the exact API
+calls `horovod_tpu.spark.run` and `RayExecutor.run` make and the env
+each worker receives.  The real function bodies execute — only the
+framework init (which would rendezvous) and the external cluster API
+are faked.
+"""
+
+import os
+import sys
+
+import pytest
+
+FAKES = os.path.join(os.path.dirname(__file__), "_fake_modules")
+
+
+@pytest.fixture
+def fake_cluster_modules(monkeypatch):
+    """Put the fake pyspark/ray first on sys.path, purge real/previous
+    imports, and restore os.environ afterwards (the task bodies under
+    test mutate it)."""
+    saved_env = dict(os.environ)
+    monkeypatch.syspath_prepend(FAKES)
+    for name in list(sys.modules):
+        if name == "pyspark" or name.startswith("pyspark.") \
+                or name == "ray" or name.startswith("ray."):
+            monkeypatch.delitem(sys.modules, name)
+    yield
+    for name in list(sys.modules):
+        if name == "pyspark" or name.startswith("pyspark.") \
+                or name == "ray" or name.startswith("ray."):
+            del sys.modules[name]
+    os.environ.clear()
+    os.environ.update(saved_env)
+
+
+@pytest.fixture
+def recorded_init(monkeypatch):
+    """Replace horovod_tpu.init with a recorder that snapshots the
+    coordination env the worker body set up before calling it."""
+    import horovod_tpu
+
+    snapshots = []
+
+    def fake_init(*args, **kwargs):
+        snapshots.append({
+            k: v for k, v in os.environ.items()
+            if k.startswith("HVD_TPU_")
+        })
+
+    monkeypatch.setattr(horovod_tpu, "init", fake_init)
+    return snapshots
+
+
+def _worker_fn(tag):
+    # runs inside the (fake) cluster task, after hvd.init()
+    return (tag, os.environ["HVD_TPU_PROCESS_ID"])
+
+
+def test_spark_run_contract(fake_cluster_modules, recorded_init):
+    """spark.run executes fn in num_proc barrier tasks: parallelize →
+    barrier → mapPartitions → collect, BarrierTaskContext.barrier after
+    fn, coordination env per rank (SURVEY.md §2.4 horovod.spark.run)."""
+    import pyspark
+
+    pyspark._reset()
+    import horovod_tpu.spark as spark
+
+    results = spark.run(_worker_fn, args=("job",), num_proc=3)
+
+    # per-rank results in rank order
+    assert results == [("job", "0"), ("job", "1"), ("job", "2")]
+    # every rank initialized with the same coordinator, its own rank id
+    assert len(recorded_init) == 3
+    coords = {s["HVD_TPU_COORDINATOR"] for s in recorded_init}
+    assert len(coords) == 1 and ":" in coords.pop()
+    for rank, snap in enumerate(recorded_init):
+        assert snap["HVD_TPU_PROCESS_ID"] == str(rank)
+        assert snap["HVD_TPU_NUM_PROCESSES"] == "3"
+    # the pyspark call sequence: session → parallelize(n, n) → barrier
+    # rdd → mapPartitions → collect → per-task barrier()
+    events = [e for e, _ in pyspark.CALLS]
+    assert events[:5] == [
+        "getOrCreate", "parallelize", "barrier_rdd", "mapPartitions",
+        "collect",
+    ]
+    assert pyspark.CALLS[1][1] == (3, 3)  # n items over n partitions
+    assert [p for e, p in pyspark.CALLS if e == "barrier"] == [0, 1, 2]
+
+
+def test_spark_run_without_pyspark_raises():
+    """Without pyspark the contract is an ImportError pointing at the
+    alternatives — not a silent local fallback."""
+    import horovod_tpu.spark as spark
+
+    if any(n == "pyspark" for n in sys.modules):
+        pytest.skip("real pyspark present")
+    with pytest.raises(ImportError, match="RayExecutor|tpurun"):
+        spark.run(_worker_fn, num_proc=2)
+
+
+def test_ray_executor_contract(fake_cluster_modules, recorded_init):
+    """RayExecutor on the (fake) ray backend: ray.init at start(), one
+    remote task per worker, results via ray.get in rank order, each
+    worker env-wired to the same coordinator (reference:
+    horovod/ray/runner.py RayExecutor.run → run_remote + get)."""
+    import ray
+
+    ray._reset()
+    # import AFTER the fake is on sys.path so _ray_available() sees it
+    import importlib
+
+    import horovod_tpu.ray as hvd_ray
+
+    importlib.reload(hvd_ray)
+    ex = hvd_ray.RayExecutor(num_workers=4)
+    assert ex._backend == "ray"
+    with pytest.raises(RuntimeError, match="start"):
+        ex.run(_worker_fn)
+    ex.start()
+    assert ray.is_initialized()
+    results = ex.run(_worker_fn, args=["rayjob"])
+    ex.shutdown()
+
+    assert results == [("rayjob", str(r)) for r in range(4)]
+    assert len(recorded_init) == 4
+    for rank, snap in enumerate(recorded_init):
+        assert snap["HVD_TPU_PROCESS_ID"] == str(rank)
+        assert snap["HVD_TPU_NUM_PROCESSES"] == "4"
+        assert ":" in snap["HVD_TPU_COORDINATOR"]
+    events = [e for e, _ in ray.CALLS]
+    assert events.count("init") == 1
+    assert events.count("task_submit") == 4
+    # all four tasks submitted before any get (fan-out, then gather)
+    assert events.index("get") > max(
+        i for i, e in enumerate(events) if e == "task_submit"
+    )
+    # ranks submitted in order
+    assert [a[0] for e, a in ray.CALLS if e == "task_submit"] == [
+        0, 1, 2, 3,
+    ]
