@@ -39,6 +39,21 @@ ENV_WORKER_ID = "HVD_TPU_ELASTIC_WORKER_ID"
 ENV_RESTORE = "HVD_TPU_ELASTIC_RESTORE"
 
 ENV_RESTARTED = "HVD_TPU_ELASTIC_RESTARTED"
+# memfd-based state handoff: the snapshot lives in RAM on an inherited
+# fd (execv keeps non-CLOEXEC fds), so restart cost does not ride disk
+# bandwidth — measured 90s of a 110s restart at 1 GB state on this
+# host's ~50 MB/s /tmp before the memfd path existed (PERF.md r4)
+ENV_RESTORE_FD = "HVD_TPU_ELASTIC_RESTORE_FD"
+# restart-cost accounting riding across the execv boundary (PERF.md
+# "elastic restart cost"): persist seconds, snapshot bytes, exec wallclock
+ENV_T_PERSIST = "HVD_TPU_ELASTIC_T_PERSIST"
+ENV_SNAP_BYTES = "HVD_TPU_ELASTIC_SNAP_BYTES"
+ENV_T_EXEC = "HVD_TPU_ELASTIC_T_EXEC"
+
+#: timing of the most recent exec-restart, filled by
+#: maybe_restore_after_restart on the post-boot side:
+#: {persist_s, snapshot_bytes, reboot_s, restore_s, total_s}
+last_restart_stats: Optional[dict] = None
 
 _ASSIGNMENT_ENV = (
     "HVD_TPU_COORDINATOR", "HVD_TPU_NUM_PROCESSES", "HVD_TPU_PROCESS_ID",
@@ -295,7 +310,13 @@ def rendezvous() -> dict:
     if msg.get("type") == "shutdown":
         get_logger().info("elastic: driver requested shutdown")
         # a displaced worker arrives here via exec-restart with a live
-        # state file it will never load — clean it up on the way out
+        # state snapshot it will never load — release it on the way out
+        fd_env = os.environ.pop(ENV_RESTORE_FD, None)
+        if fd_env is not None:
+            try:
+                os.close(int(fd_env))
+            except (OSError, ValueError):
+                pass
         path = os.environ.pop(ENV_RESTORE, None)
         if path and os.path.exists(path):
             os.remove(path)
@@ -486,12 +507,32 @@ def _persist_and_exec(snap) -> None:
     import pickle
     import sys
     import tempfile
+    import time
 
     if snap is not None:
-        fd, path = tempfile.mkstemp(prefix="hvd_tpu_elastic_state_")
-        with os.fdopen(fd, "wb") as f:
-            pickle.dump(snap, f)
-        os.environ[ENV_RESTORE] = path
+        t0 = time.time()
+        try:
+            # RAM-backed handoff: flags=0 clears python's MFD_CLOEXEC
+            # default so execv keeps the fd; the kernel reclaims the
+            # memory when the post-boot load closes it — no disk write,
+            # no leaked file if the reboot dies
+            mfd = os.memfd_create("hvd_tpu_elastic_state", 0)
+        except (AttributeError, OSError):
+            mfd = None
+        if mfd is not None:
+            with os.fdopen(mfd, "wb", closefd=False) as f:
+                pickle.dump(snap, f)
+            size = os.lseek(mfd, 0, os.SEEK_CUR)
+            os.lseek(mfd, 0, os.SEEK_SET)
+            os.environ[ENV_RESTORE_FD] = str(mfd)
+        else:  # pre-memfd kernels: disk tempfile
+            fd, path = tempfile.mkstemp(prefix="hvd_tpu_elastic_state_")
+            with os.fdopen(fd, "wb") as f:
+                pickle.dump(snap, f)
+            size = os.path.getsize(path)
+            os.environ[ENV_RESTORE] = path
+        os.environ[ENV_T_PERSIST] = f"{time.time() - t0:.4f}"
+        os.environ[ENV_SNAP_BYTES] = str(size)
     # marked even with no snapshot: the post-boot wrapper must still fire
     # the user's reset callbacks (the restart IS the reset)
     os.environ[ENV_RESTARTED] = "1"
@@ -499,6 +540,7 @@ def _persist_and_exec(snap) -> None:
         os.environ.pop(k, None)
     sys.stdout.flush()
     sys.stderr.flush()
+    os.environ[ENV_T_EXEC] = f"{time.time():.4f}"
     os.execv(sys.executable, [sys.executable] + sys.argv)
 
 
@@ -509,20 +551,72 @@ def maybe_restore_after_restart(state) -> None:
     then the normal ``state.sync()`` re-broadcasts rank 0's authoritative
     copy."""
     import pickle
+    import time
+
+    global last_restart_stats
 
     restarted = os.environ.pop(ENV_RESTARTED, None) is not None
+    t_exec = os.environ.pop(ENV_T_EXEC, None)
+    persist_s = float(os.environ.pop(ENV_T_PERSIST, 0) or 0)
+    snap_bytes = int(os.environ.pop(ENV_SNAP_BYTES, 0) or 0)
+    # reboot = execv → wrapper entry: interpreter + jax import, boot
+    # rendezvous, hvd.init against the new world
+    reboot_s = (time.time() - float(t_exec)) if t_exec else 0.0
+    restore_s = 0.0
+    snap = _NOTHING = object()
+    fd_env = os.environ.pop(ENV_RESTORE_FD, None)
     path = os.environ.pop(ENV_RESTORE, None)
-    if path and os.path.exists(path):
-        with open(path, "rb") as f:
-            snap = pickle.load(f)
+    if fd_env is not None:
+        t0 = time.time()
+        try:
+            with os.fdopen(int(fd_env), "rb") as f:  # close frees the RAM
+                snap = pickle.load(f)
+        except Exception as e:
+            # a lost/garbled/unloadable handoff (bad fd, truncated pickle,
+            # MemoryError on a loaded host, a state class that moved
+            # between boots) must not crash-loop the worker: boot bare and
+            # let post-boot sync() re-seed from rank 0
+            get_logger().error(
+                "elastic: state handoff unusable (%s: %s); continuing "
+                "without the snapshot — sync() re-seeds from rank 0",
+                type(e).__name__, e,
+            )
+            snap = _NOTHING
+    elif path and os.path.exists(path):
+        t0 = time.time()
+        try:
+            with open(path, "rb") as f:
+                snap = pickle.load(f)
+        except Exception as e:  # same crash-loop guard as the fd path
+            get_logger().error(
+                "elastic: state snapshot file unusable (%s: %s); "
+                "continuing without it — sync() re-seeds from rank 0",
+                type(e).__name__, e,
+            )
+            snap = _NOTHING
         os.remove(path)
+    if snap is not _NOTHING:
         if snap is not None and hasattr(state, "_apply_snapshot"):
             state._apply_snapshot(snap)
             state.save()
-            get_logger().info(
-                "elastic: state restored after worker restart"
-            )
+        restore_s = time.time() - t0
+        get_logger().info(
+            "elastic: state restored after worker restart"
+        )
     if restarted:
+        last_restart_stats = {
+            "persist_s": persist_s,
+            "snapshot_bytes": snap_bytes,
+            "reboot_s": reboot_s,
+            "restore_s": restore_s,
+            "total_s": persist_s + reboot_s + restore_s,
+        }
+        get_logger().info(
+            "elastic: restart cost %.2fs total (persist %.2fs, "
+            "reboot %.2fs, restore %.2fs; snapshot %d bytes)",
+            last_restart_stats["total_s"], persist_s, reboot_s,
+            restore_s, snap_bytes,
+        )
         # reset callbacks fire on every exec-restart, snapshot or not —
         # a restart with no committed state is still a membership reset
         state.on_reset()

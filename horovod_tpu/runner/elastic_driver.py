@@ -383,6 +383,11 @@ class ElasticDriver:
     # -- main loop ---------------------------------------------------------
 
     def run(self) -> int:
+        from .launch import ensure_sigterm_unwinds
+
+        # a terminated driver must unwind so the finally below reaps the
+        # worker fleet instead of orphaning it
+        restore_handler = ensure_sigterm_unwinds()
         host, port = self._start_server()
         # workers resolve the driver by this address; local workers can
         # always use loopback
@@ -395,9 +400,13 @@ class ElasticDriver:
                 self._server.close()
             except OSError:
                 pass
-            for w in self._workers.values():
-                if w.alive:
-                    w.proc.terminate()
+            from .launch import reap_workers
+
+            # terminate → grace → kill: jaxlib's preemption notifier
+            # swallows a bare SIGTERM in every initialized worker
+            reap_workers([w.proc for w in self._workers.values()
+                          if w.alive])
+            restore_handler()
 
     def _run(self, driver_addr: str, driver_host: str) -> int:
         log = get_logger()

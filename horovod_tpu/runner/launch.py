@@ -28,24 +28,83 @@ from typing import Dict, List, Optional, Tuple
 from .config_parser import config_to_env, load_config_file
 
 
+def ensure_sigterm_unwinds():
+    """Convert SIGTERM into SystemExit so a terminated launcher unwinds
+    through its finally-blocks and kills the worker fleet — the default
+    handler exits without unwinding and ORPHANS every worker (observed:
+    orphaned elastic workers surviving their driver and polluting later
+    jobs on the host).  No-op off the main thread, where the default
+    behavior stands anyway.
+
+    Returns a zero-arg restore callable: library embeddings (estimator
+    fit() inside a Spark driver, RayExecutor in a user process) must not
+    leave the process-wide handler permanently replaced."""
+
+    def _raise(signum, frame):
+        raise SystemExit(128 + signum)
+
+    try:
+        prev = signal.signal(signal.SIGTERM, _raise)
+    except ValueError:
+        return lambda: None
+
+    def _restore():
+        try:
+            signal.signal(signal.SIGTERM, prev)
+        except (ValueError, TypeError):
+            pass
+
+    return _restore
+
+
+def reap_workers(procs: List["subprocess.Popen"],
+                 grace_s: float = 5.0) -> None:
+    """terminate → grace → SIGKILL → wait.  SIGTERM alone does NOT stop
+    a worker: jaxlib's preemption notifier installs a SIGTERM handler in
+    every process that ran jax.distributed.initialize, so terminated
+    workers keep running (observed: orphans surviving their driver)."""
+    alive = [p for p in procs if p.poll() is None]
+    for p in alive:
+        p.terminate()
+    deadline = time.time() + grace_s
+    while time.time() < deadline:
+        if all(p.poll() is not None for p in alive):
+            return
+        time.sleep(0.1)
+    for p in alive:
+        if p.poll() is None:
+            p.kill()
+    for p in alive:
+        # SIGKILL cannot be blocked, so this wait is bounded; without it
+        # the killed children linger as zombies in long-lived callers
+        p.wait()
+
+
 def monitor_lockstep(procs: List["subprocess.Popen"],
                      label: str = "tpurun") -> int:
     """Exit-code lockstep monitoring: first nonzero exit terminates the
     rest (reference: gloo_run's monitor loop).  Shared by the launcher
-    and the estimator/executor subprocess backends."""
-    while True:
-        codes = [p.poll() for p in procs]
-        for rank, code in enumerate(codes):
-            if code is not None and code != 0:
-                print(f"[{label}] rank {rank} exited with {code}; "
-                      "terminating remaining workers", file=sys.stderr)
-                for p in procs:
-                    if p.poll() is None:
-                        p.terminate()
-                return code
-        if all(c == 0 for c in codes):
-            return 0
-        time.sleep(0.1)
+    and the estimator/executor subprocess backends.  Any exception —
+    including the SIGTERM-as-SystemExit from ensure_sigterm_unwinds —
+    reaps the fleet before propagating."""
+    restore_handler = ensure_sigterm_unwinds()
+    try:
+        while True:
+            codes = [p.poll() for p in procs]
+            for rank, code in enumerate(codes):
+                if code is not None and code != 0:
+                    print(f"[{label}] rank {rank} exited with {code}; "
+                          "terminating remaining workers", file=sys.stderr)
+                    reap_workers(procs)
+                    return code
+            if all(c == 0 for c in codes):
+                return 0
+            time.sleep(0.1)
+    except BaseException:
+        reap_workers(procs)
+        raise
+    finally:
+        restore_handler()
 
 
 def _free_port() -> int:

@@ -6,10 +6,13 @@ test/integration/test_elastic_torch.py via elastic_common.py (SURVEY.md §4)
 and logs progress so the test can assert recovery/rescale bookkeeping.
 
 Usage: elastic_worker.py <logdir> <num_epochs> <batches_per_epoch>
+                         [ballast_bytes]
 Each batch "trains" by allreducing a per-worker gradient of 1.0 (average),
 so after any membership dance the final weight must equal the number of
 completed batches exactly — lost/duplicated batches would show up as a
-wrong weight.
+wrong weight.  ``ballast_bytes`` adds a numpy array of that size to the
+state so restart cost vs state size is measurable (the reset callback
+logs a ``restart_stats`` event with the persist/reboot/restore split).
 """
 
 import json
@@ -32,15 +35,26 @@ def log(logdir, **kv):
 def main():
     logdir, num_epochs, batches = sys.argv[1], int(sys.argv[2]), int(
         sys.argv[3])
+    ballast_bytes = int(sys.argv[4]) if len(sys.argv) > 4 else 0
     hvd.init()
     log(logdir, event="init", rank=hvd.cross_rank(), world=hvd.cross_size(),
         pid=os.getpid())
 
+    kwargs = {}
+    if ballast_bytes:
+        kwargs["ballast"] = np.ones(ballast_bytes // 8, np.float64)
     state = hvd.elastic.TpuState(
-        weight=np.zeros(()), epoch=0, batch=0, resets=0)
-    state.register_reset_callbacks([
-        lambda: log(logdir, event="reset", world=hvd.cross_size())
-    ])
+        weight=np.zeros(()), epoch=0, batch=0, resets=0, **kwargs)
+
+    def on_reset():
+        from horovod_tpu.elastic import worker as elastic_worker
+
+        log(logdir, event="reset", world=hvd.cross_size())
+        if elastic_worker.last_restart_stats:
+            log(logdir, event="restart_stats",
+                **elastic_worker.last_restart_stats)
+
+    state.register_reset_callbacks([on_reset])
 
     @hvd.elastic.run
     def train(state):

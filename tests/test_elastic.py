@@ -269,7 +269,7 @@ def _write_discovery(tmp_path, hosts_content):
 
 
 def _elastic_cmd(script, logdir, epochs, batches, min_np=1, np_=None,
-                 max_np=None):
+                 max_np=None, ballast_bytes=None):
     cmd = [sys.executable, "-m", "horovod_tpu.runner",
            "--host-discovery-script", str(script),
            "--min-np", str(min_np)]
@@ -279,6 +279,8 @@ def _elastic_cmd(script, logdir, epochs, batches, min_np=1, np_=None,
         cmd += ["--max-np", str(max_np)]
     cmd += ["--", sys.executable, WORKER, str(logdir), str(epochs),
             str(batches)]
+    if ballast_bytes is not None:
+        cmd.append(str(ballast_bytes))
     return cmd
 
 
@@ -337,6 +339,103 @@ def test_elastic_scale_up(tmp_path):
     assert all(abs(e["weight"] - 120.0) < 1e-6 for e in dones)
     # worker 0 really did run alone before the rescale
     assert any(e["event"] == "batch" and e["world"] == 1 for e in events)
+
+
+@pytest.mark.integration
+def test_terminated_driver_reaps_workers(tmp_path):
+    """SIGTERM on the launcher must take the worker fleet down with it
+    (regression: the default SIGTERM handler skipped the driver's
+    finally-block and orphaned every elastic worker, which then polluted
+    later jobs on the host)."""
+    hosts, script = _write_discovery(tmp_path, "localhost:2\n")
+    logdir = tmp_path / "logs"
+    logdir.mkdir()
+    proc = subprocess.Popen(
+        _elastic_cmd(script, logdir, epochs=1, batches=2000, min_np=2),
+        env=_elastic_env(), cwd=REPO,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    try:
+        deadline = time.time() + 90
+        pids = []
+        while time.time() < deadline and len(pids) < 2:
+            pids = [e["pid"] for e in _read_logs(logdir)
+                    if e["event"] == "init"]
+            time.sleep(0.5)
+        assert len(pids) == 2, "workers never initialized"
+        proc.terminate()
+        proc.wait(timeout=30)
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            alive = []
+            for pid in pids:
+                try:
+                    os.kill(pid, 0)
+                    alive.append(pid)
+                except OSError:
+                    pass
+            if not alive:
+                return
+            time.sleep(0.5)
+        for pid in alive:  # clean up before failing
+            os.kill(pid, signal.SIGKILL)
+        pytest.fail(f"orphaned workers survived driver SIGTERM: {alive}")
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+
+@pytest.mark.integration
+def test_elastic_restart_cost_bounded_at_100mb(tmp_path):
+    """A planned membership change with 100 MB of elastic state must
+    exec-restart in bounded time, with the disk snapshot (persist +
+    restore) a small fraction of it (VERDICT r3 item 3; the measured
+    split lives in PERF.md 'elastic restart cost')."""
+    hosts, script = _write_discovery(tmp_path, "localhost:2\n")
+    logdir = tmp_path / "logs"
+    logdir.mkdir()
+    proc = subprocess.Popen(
+        _elastic_cmd(script, logdir, epochs=1, batches=400, min_np=1,
+                     max_np=3, ballast_bytes=100_000_000),
+        env=_elastic_env(), cwd=REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        # both workers training, then a planned scale-up to 3
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            evs = _read_logs(logdir)
+            if sum(1 for e in evs
+                   if e["event"] == "batch" and e["batch"] >= 3) >= 2:
+                break
+            time.sleep(0.5)
+        else:
+            pytest.fail("workers never started training")
+        hosts.write_text("localhost:3\n")
+        deadline = time.time() + 180
+        stats = []
+        while time.time() < deadline and not stats:
+            stats = [e for e in _read_logs(logdir)
+                     if e["event"] == "restart_stats"]
+            time.sleep(0.5)
+        assert stats, "no restart_stats event after the planned change"
+        for s in stats:
+            # snapshot really carried the ballast across the restart
+            assert s["snapshot_bytes"] > 100_000_000, s
+            # disk snapshot must not dominate: pickle+unpickle of 100 MB
+            # is sub-second on any local disk; the bound is generous for
+            # CI load
+            assert s["persist_s"] + s["restore_s"] < 10.0, s
+            # end-to-end bound (reboot includes jax import + rendezvous)
+            assert s["total_s"] < 60.0, s
+    finally:
+        proc.terminate()
+        try:
+            proc.communicate(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.communicate()
 
 
 @pytest.mark.integration

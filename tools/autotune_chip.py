@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Score the negotiation autotuner on real hardware (VERDICT r3 item 7).
+
+Reference analog: HOROVOD_AUTOTUNE=1 tuning fusion-threshold/cycle-time
+against live training traffic (SURVEY.md §2.1 ParameterManager).  This
+drives the eager negotiated path with a ResNet-50-shaped gradient
+submission pattern (54 tensors, ~25.6M params, conv kernels to BN
+scalars) until the hill climb holds, then reports what the tuner chose
+and what it bought vs the starting configuration.
+
+Run on the chip (or anywhere)::
+
+    python tools/autotune_chip.py [--seconds 120] [--log autotune.csv]
+
+The committed chip run lives at docs/autotune_v5e.csv with the finding
+in PERF.md ("Round 4: autotune on the chip").
+"""
+
+import argparse
+import os
+import sys
+import time
+
+
+def resnet50_grad_sizes():
+    """Parameter-tensor sizes of a bottleneck ResNet-50 (fan-out of the
+    per-layer grads DistributedOptimizer would submit), largest-first
+    like a backward pass emits them."""
+    sizes = []
+    stages = [(3, 64), (4, 128), (6, 256), (3, 512)]
+    in_ch = 64
+    sizes.append(64 * 7 * 7 * 3)  # stem
+    for blocks, ch in stages:
+        for b in range(blocks):
+            sizes.append(in_ch * ch)          # 1x1 reduce
+            sizes.append(ch * ch * 9)         # 3x3
+            sizes.append(ch * ch * 4)         # 1x1 expand
+            if b == 0:
+                sizes.append(in_ch * ch * 4)  # projection
+            in_ch = ch * 4
+    sizes.append(2048 * 1000)  # head
+    return sizes
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seconds", type=float, default=120.0)
+    ap.add_argument("--log", default="autotune.csv")
+    args = ap.parse_args()
+
+    os.environ["HVD_TPU_AUTOTUNE"] = "1"
+    os.environ["HVD_TPU_AUTOTUNE_LOG"] = args.log
+    import jax
+    import jax.numpy as jnp
+
+    import horovod_tpu as hvd
+    from horovod_tpu.common import basics
+
+    hvd.init()
+    ctrl = basics._require_init().controller
+    print(f"backend={jax.default_backend()} "
+          f"start: threshold={ctrl.fusion_threshold()} "
+          f"cycle={ctrl.cycle_time_ms()}ms "
+          f"tuning={ctrl.autotune_active()}", flush=True)
+
+    grads = [jnp.ones((n,), jnp.float32) for n in resnet50_grad_sizes()]
+    total_mb = sum(g.size for g in grads) * 4 / 1e6
+    print(f"{len(grads)} grad tensors, {total_mb:.1f} MB/step", flush=True)
+
+    t0 = time.time()
+    steps = 0
+    step_times = []
+    while time.time() - t0 < args.seconds:
+        t1 = time.perf_counter()
+        # constant names across steps = the DistributedOptimizer pattern,
+        # so the ResponseCache bypass engages like real training
+        outs = hvd.grouped_allreduce(grads, name="grad")
+        jax.block_until_ready(outs)
+        step_times.append(time.perf_counter() - t1)
+        steps += 1
+        if steps % 20 == 0:
+            print(f"step {steps}: threshold={ctrl.fusion_threshold()} "
+                  f"cycle={ctrl.cycle_time_ms()}ms "
+                  f"tuning={ctrl.autotune_active()} "
+                  f"last20={sum(step_times[-20:]) / 20 * 1e3:.1f}ms",
+                  flush=True)
+        if not ctrl.autotune_active() and steps > 20:
+            print("tuner holds — converged", flush=True)
+            break
+    n = len(step_times)
+    first = step_times[:max(n // 5, 1)]
+    last = step_times[-max(n // 5, 1):]
+    print(f"done: {steps} steps in {time.time() - t0:.0f}s; "
+          f"final threshold={ctrl.fusion_threshold()} "
+          f"cycle={ctrl.cycle_time_ms()}ms; "
+          f"first-fifth mean {sum(first) / len(first) * 1e3:.1f}ms "
+          f"vs last-fifth {sum(last) / len(last) * 1e3:.1f}ms "
+          f"(log: {args.log})", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
