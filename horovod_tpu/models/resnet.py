@@ -105,6 +105,14 @@ class ResNet(nn.Module):
     dtype: Any = jnp.bfloat16
     bn_axis_name: Optional[str] = None  # set to mesh axis for sync-BN
     stem: str = "conv"  # "conv" (classic 7x7/s2) | "space_to_depth"
+    # Per-block rematerialization (save-nothing policy): a MEMORY
+    # lever, not a speed lever — backward recomputes each block's convs
+    # from the block input, cutting stored activations to block
+    # boundaries, but on v5e it measured 21% SLOWER with MORE total
+    # HBM traffic than XLA's stored-activation schedule (PERF.md round
+    # 4 lever sweep).  Use it to fit larger batches/models, expecting
+    # that throughput cost.
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -127,13 +135,26 @@ class ResNet(nn.Module):
         x = norm(name="bn_init")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        block_cls = self.block_cls
+        if self.remat:
+            # prevent_cse=True (default) is load-bearing: with CSE
+            # allowed, XLA eliminated the recomputation and restored the
+            # stored-activation schedule — measured identical FLOPs/time
+            # to remat=False (PERF.md round 4 lever sweep)
+            block_cls = nn.remat(block_cls)
+        block_index = 0
         for i, block_size in enumerate(self.stage_sizes):
             for j in range(block_size):
                 strides = (2, 2) if i > 0 and j == 0 else (1, 1)
-                x = self.block_cls(
+                # explicit names pinned to the unwrapped auto-names so
+                # toggling remat never renames params (nn.remat's wrapper
+                # class would otherwise prefix them Checkpoint...)
+                x = block_cls(
                     features=self.num_filters * 2 ** i,
                     strides=strides, conv=conv, norm=norm, act=nn.relu,
+                    name=f"{self.block_cls.__name__}_{block_index}",
                 )(x)
+                block_index += 1
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=jnp.float32,
                      name="head")(x.astype(jnp.float32))

@@ -1,0 +1,365 @@
+"""Fused training-mode BatchNorm(+residual)+ReLU — pallas TPU kernels.
+
+Reference analog: the reference leans on cuDNN's fused
+BatchNormalization kernels through torch/TF; on TPU the XLA lowering of
+train-mode BN+ReLU measures ~2x its HBM roofline in isolation and the
+whole BN apparatus costs ~20% of the ResNet-50 step (PERF.md round 4
+lever sweep: eval-BN step 38.9 ms vs train-BN 48.7 ms at batch 128).
+These kernels do the minimum passes over HBM:
+
+  forward:  stats kernel (read x once; per-channel sum/sumsq) +
+            apply kernel (read x, write y) = 3 passes
+  backward: reduce kernel (read x, dy, y; dgamma/dbeta) +
+            dx kernel (read x, dy, y, write dx/[dres]) — the relu mask
+            comes from y (already resident for the reduce), xhat is
+            recomputed from x, mean, rstd instead of being stored.
+
+Layout: NHWC input viewed as (M, C), M = N*H*W (free reshape).  C < 128
+channels are lane-folded: the (M, C) view becomes (M/f, C*f) with
+f = 128 // C, per-lane partial stats are folded to C outside the kernel
+and the per-channel parameters are lane-tiled back — so stage-1 ResNet
+sites (C = 64, the largest spatial extents) still run fused.
+
+MEASURED VERDICT (round 4, v5e): the XLA path stays the default.  In
+fwd+bwd context XLA's own lowering runs at 1.23-1.55x the 8-pass HBM
+roofline at C>=256 ResNet shapes — the isolated 2x forward gap does not
+survive training context — while this first pallas cut measured ~2.3x
+its own pass count (Mosaic pipelining, not traffic, is the limiter).
+The kernels remain OPT-IN via ``HVD_TPU_FUSED_BN=1`` as a correct,
+tested harness to revisit on other TPU generations; default and
+off-TPU use the XLA reference implementation.  ``impl="interpret"``
+(pallas interpreter) drives the CPU numerics tests.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_LANES = 128
+_MAX_BM = int(os.environ.get('HVD_TPU_FUSED_BN_BM', 2048))
+
+
+def _pick_bm(m: int) -> Optional[int]:
+    bm = _MAX_BM
+    while bm >= 16:
+        if m % bm == 0:
+            return bm
+        bm //= 2
+    return None
+
+
+def _view(x: jnp.ndarray) -> Tuple[jnp.ndarray, int, int]:
+    """(N,...,C) -> (M/f, C*f) lane-folded 2-D view; returns (view, f,
+    M) or raises ValueError for unfoldable shapes."""
+    c = x.shape[-1]
+    m = x.size // c
+    if c >= _LANES:
+        return x.reshape(m, c), 1, m
+    if _LANES % c != 0:
+        raise ValueError(f"C={c} does not divide the lane width")
+    f = _LANES // c
+    if m % f != 0:
+        raise ValueError(f"M={m} not divisible by fold factor {f}")
+    return x.reshape(m // f, c * f), f, m
+
+
+# -- kernels ----------------------------------------------------------------
+
+
+def _stats_kernel(x_ref, sums_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        sums_ref[:] = jnp.zeros_like(sums_ref)
+
+    xb = x_ref[:].astype(jnp.float32)
+    sums_ref[0, :] += jnp.sum(xb, axis=0)
+    sums_ref[1, :] += jnp.sum(xb * xb, axis=0)
+
+
+def _apply_kernel(x_ref, scale_ref, shift_ref, res_ref, y_ref, *, relu):
+    xb = x_ref[:].astype(jnp.float32)
+    y = xb * scale_ref[0, :] + shift_ref[0, :]
+    if res_ref is not None:
+        y = y + res_ref[:].astype(jnp.float32)
+    if relu:
+        y = jnp.maximum(y, 0.0)
+    y_ref[:] = y.astype(y_ref.dtype)
+
+
+def _bwd_reduce_kernel(x_ref, dy_ref, y_ref, mean_ref, rstd_ref,
+                       sums_ref, *, relu):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        sums_ref[:] = jnp.zeros_like(sums_ref)
+
+    dyb = dy_ref[:].astype(jnp.float32)
+    if relu:
+        # f32 compare: Mosaic on v5e rejects bf16 cmpf
+        dyb = jnp.where(y_ref[:].astype(jnp.float32) > 0, dyb, 0.0)
+    xhat = (x_ref[:].astype(jnp.float32) - mean_ref[0, :]) * rstd_ref[0, :]
+    sums_ref[0, :] += jnp.sum(dyb, axis=0)
+    sums_ref[1, :] += jnp.sum(dyb * xhat, axis=0)
+
+
+def _dx_kernel(x_ref, dy_ref, y_ref, mean_ref, rstd_ref, gr_ref,
+               mdb_ref, mdg_ref, dx_ref, dres_ref, *, relu):
+    dyb = dy_ref[:].astype(jnp.float32)
+    if relu:
+        dyb = jnp.where(y_ref[:].astype(jnp.float32) > 0, dyb, 0.0)
+    if dres_ref is not None:
+        dres_ref[:] = dyb.astype(dres_ref.dtype)
+    xhat = (x_ref[:].astype(jnp.float32) - mean_ref[0, :]) * rstd_ref[0, :]
+    dx = gr_ref[0, :] * (dyb - mdb_ref[0, :] - xhat * mdg_ref[0, :])
+    dx_ref[:] = dx.astype(dx_ref.dtype)
+
+
+# -- host-side orchestration ------------------------------------------------
+
+
+def _row_spec(bm, cols):
+    return pl.BlockSpec((bm, cols), lambda i: (i, 0))
+
+
+def _param_spec(cols):
+    return pl.BlockSpec((1, cols), lambda i: (0, 0))
+
+
+def _fold(v, f, c):
+    """(C*f,) lane partials -> (C,) true per-channel values."""
+    return v.reshape(f, c).sum(0) if f > 1 else v
+
+
+def _tile(v, f):
+    """(C,) per-channel -> (C*f,) lane-tiled."""
+    return jnp.tile(v, f) if f > 1 else v
+
+
+def _pallas_forward(x, gamma, beta, residual, eps, relu, interpret):
+    xv, f, m = _view(x)
+    bm = _pick_bm(xv.shape[0])
+    if bm is None:
+        raise ValueError(f"no block size divides M'={xv.shape[0]}")
+    cols = xv.shape[1]
+    grid = (xv.shape[0] // bm,)
+
+    sums = pl.pallas_call(
+        _stats_kernel,
+        grid=grid,
+        in_specs=[_row_spec(bm, cols)],
+        out_specs=pl.BlockSpec((2, cols), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((2, cols), jnp.float32),
+        interpret=interpret,
+    )(xv)
+    s1 = _fold(sums[0], f, x.shape[-1])
+    s2 = _fold(sums[1], f, x.shape[-1])
+    mean = s1 / m
+    var = jnp.maximum(s2 / m - mean * mean, 0.0)
+    rstd = jax.lax.rsqrt(var + eps)
+
+    scale = gamma * rstd                    # (C,)
+    shift = beta - mean * scale
+    args = [xv, _tile(scale, f)[None], _tile(shift, f)[None]]
+    in_specs = [_row_spec(bm, cols), _param_spec(cols), _param_spec(cols)]
+    if residual is not None:
+        rv, _, _ = _view(residual)
+        args.append(rv)
+        in_specs.append(_row_spec(bm, cols))
+        kernel = functools.partial(_apply_kernel, relu=relu)
+    else:
+        kernel = functools.partial(
+            lambda x_ref, s_ref, b_ref, y_ref, relu: _apply_kernel(
+                x_ref, s_ref, b_ref, None, y_ref, relu=relu),
+            relu=relu,
+        )
+    y = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=_row_spec(bm, cols),
+        out_shape=jax.ShapeDtypeStruct(xv.shape, x.dtype),
+        interpret=interpret,
+    )(*args)
+    return y.reshape(x.shape), mean, var, rstd
+
+
+def _pallas_backward(x, y, dy, gamma, mean, rstd, has_residual, relu,
+                     interpret):
+    xv, f, m = _view(x)
+    yv, _, _ = _view(y)
+    dyv, _, _ = _view(dy)
+    bm = _pick_bm(xv.shape[0])
+    cols = xv.shape[1]
+    grid = (xv.shape[0] // bm,)
+    c = x.shape[-1]
+    mean_t = _tile(mean, f)[None]
+    rstd_t = _tile(rstd, f)[None]
+
+    sums = pl.pallas_call(
+        functools.partial(_bwd_reduce_kernel, relu=relu),
+        grid=grid,
+        in_specs=[_row_spec(bm, cols), _row_spec(bm, cols),
+                  _row_spec(bm, cols), _param_spec(cols),
+                  _param_spec(cols)],
+        out_specs=pl.BlockSpec((2, cols), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((2, cols), jnp.float32),
+        interpret=interpret,
+    )(xv, dyv, yv, mean_t, rstd_t)
+    dbeta = _fold(sums[0], f, c)
+    dgamma_hat = _fold(sums[1], f, c)  # sum(dy_relu * xhat)
+
+    gr = gamma * rstd
+    out_shapes = [jax.ShapeDtypeStruct(xv.shape, x.dtype)]
+    out_specs = [_row_spec(bm, cols)]
+    if has_residual:
+        out_shapes.append(jax.ShapeDtypeStruct(xv.shape, x.dtype))
+        out_specs.append(_row_spec(bm, cols))
+        kernel = functools.partial(_dx_kernel, relu=relu)
+    else:
+        kernel = functools.partial(
+            lambda x_ref, dy_ref, y_ref, me, rs, g, mdb, mdg, dx_ref,
+            relu: _dx_kernel(x_ref, dy_ref, y_ref, me, rs, g, mdb, mdg,
+                             dx_ref, None, relu=relu),
+            relu=relu,
+        )
+    outs = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[_row_spec(bm, cols), _row_spec(bm, cols),
+                  _row_spec(bm, cols), _param_spec(cols),
+                  _param_spec(cols), _param_spec(cols),
+                  _param_spec(cols), _param_spec(cols)],
+        out_specs=out_specs if has_residual else out_specs[0],
+        out_shape=out_shapes if has_residual else out_shapes[0],
+        interpret=interpret,
+    )(xv, dyv, yv, mean_t, rstd_t, _tile(gr, f)[None],
+      _tile(dbeta / m, f)[None], _tile(dgamma_hat / m, f)[None])
+    if has_residual:
+        dxv, dresv = outs
+        dres = dresv.reshape(x.shape)
+    else:
+        dxv, dres = outs, None
+    return dxv.reshape(x.shape), dgamma_hat, dbeta, dres
+
+
+# -- reference (XLA) path ---------------------------------------------------
+
+
+def _reference(x, gamma, beta, residual, eps, relu):
+    xf = x.astype(jnp.float32)
+    axes = tuple(range(x.ndim - 1))
+    mean = xf.mean(axes)
+    var = jnp.maximum((xf * xf).mean(axes) - mean * mean, 0.0)
+    rstd = jax.lax.rsqrt(var + eps)
+    y = (xf - mean) * rstd * gamma + beta
+    if residual is not None:
+        y = y + residual.astype(jnp.float32)
+    if relu:
+        y = jnp.maximum(y, 0.0)
+    return y.astype(x.dtype), mean, var, rstd
+
+
+def _use_pallas(x) -> bool:
+    # OPT-IN (HVD_TPU_FUSED_BN=1): the round-4 chip measurement rejected
+    # these kernels as the default — XLA's own fwd+bwd BN+ReLU runs at
+    # 1.23-1.55x the 8-pass roofline at ResNet shapes on v5e while this
+    # first pallas cut measured ~2.3x its own pass count (PERF.md).  The
+    # op stays as the measured harness to revisit per TPU generation.
+    if os.environ.get("HVD_TPU_FUSED_BN", "0") != "1":
+        return False
+    if jax.default_backend() != "tpu":
+        return False
+    try:
+        xv, _, _ = _view(x)
+    except ValueError:
+        return False
+    return _pick_bm(xv.shape[0]) is not None
+
+
+# -- public op with custom vjp ---------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _fused(x, gamma, beta, residual, eps, relu, impl):
+    out, _ = _fused_vjp_fwd(x, gamma, beta, residual, eps, relu, impl)
+    return out  # (y, batch_mean, batch_var)
+
+
+def _fused_vjp_fwd(x, gamma, beta, residual, eps, relu, impl):
+    if impl in ("pallas", "interpret"):
+        y, mean, var, rstd = _pallas_forward(
+            x, gamma, beta, residual, eps, relu,
+            interpret=(impl == "interpret"))
+    else:
+        y, mean, var, rstd = _reference(x, gamma, beta, residual, eps,
+                                        relu)
+    # (mean, var) ride as outputs for the running-stats update; their
+    # cotangents are ignored in the bwd — the dx formula already carries
+    # the full through-batch-stats dependence, and stats consumers
+    # (running averages) are non-differentiated state
+    return ((y, mean, var),
+            (x, y, gamma, mean, rstd, residual is not None))
+
+
+def _fused_bwd(eps, relu, impl, res, cts):
+    dy, _dmean, _dvar = cts
+    x, y, gamma, mean, rstd, has_residual = res
+    if impl in ("pallas", "interpret"):
+        dx, dgamma_hat, dbeta, dres = _pallas_backward(
+            x, y, dy, gamma, mean, rstd, has_residual, relu,
+            interpret=(impl == "interpret"))
+    else:
+        xf = x.astype(jnp.float32)
+        dyf = dy.astype(jnp.float32)
+        if relu:
+            dyf = jnp.where(y > 0, dyf, 0.0)
+        axes = tuple(range(x.ndim - 1))
+        xhat = (xf - mean) * rstd
+        dbeta = dyf.sum(axes)
+        dgamma_hat = (dyf * xhat).sum(axes)
+        m = x.size // x.shape[-1]
+        dx = (gamma * rstd * (
+            dyf - dbeta / m - xhat * dgamma_hat / m)).astype(x.dtype)
+        dres = dyf.astype(x.dtype) if has_residual else None
+    return dx, dgamma_hat.astype(gamma.dtype), dbeta.astype(gamma.dtype), \
+        dres
+
+
+_fused.defvjp(_fused_vjp_fwd, _fused_bwd)
+
+
+def fused_batch_norm_act(
+    x: jnp.ndarray,
+    gamma: jnp.ndarray,
+    beta: jnp.ndarray,
+    residual: Optional[jnp.ndarray] = None,
+    *,
+    eps: float = 1e-5,
+    relu: bool = True,
+    impl: Optional[str] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Training-mode BN (+ optional residual add) (+ optional ReLU).
+
+    STANDALONE op — deliberately not wired into the ResNet BN path,
+    which stays on XLA per the round-4 measurement (module docstring).
+    Returns ``(y, batch_mean, batch_var)``; the caller owns the
+    running-stats update.  Differentiable in x, gamma, beta, residual
+    via the fused backward.  ``impl``: None (auto: pallas only on TPU
+    with ``HVD_TPU_FUSED_BN=1`` and tileable shapes, else XLA
+    reference), "pallas", "interpret" (pallas interpreter — tests),
+    "reference".
+    """
+    if impl is None:
+        impl = "pallas" if _use_pallas(x) else "reference"
+    gamma = gamma.astype(jnp.float32)
+    beta = beta.astype(jnp.float32)
+    return _fused(x, gamma, beta, residual, eps, relu, impl)

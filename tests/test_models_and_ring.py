@@ -342,3 +342,39 @@ def test_transformer_remat_matches_no_remat():
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
         )
+
+
+def test_resnet_remat_numerics_identical():
+    """remat=True must change only the backward's memory/FLOP schedule,
+    never the numbers: identical loss and gradients vs remat=False."""
+    import numpy as np
+    from horovod_tpu.models import resnet as rn
+
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 32, 32, 3))
+    y = jnp.asarray([1, 3])
+
+    def loss_grads(remat):
+        model = rn.ResNetTiny(dtype=jnp.float32, remat=remat)
+        variables = model.init(jax.random.PRNGKey(0), x)
+
+        def loss_fn(params):
+            out, _ = model.apply(
+                {"params": params,
+                 "batch_stats": variables["batch_stats"]},
+                x, mutable=["batch_stats"],
+            )
+            import optax
+
+            return optax.softmax_cross_entropy_with_integer_labels(
+                out, y
+            ).mean()
+
+        return jax.value_and_grad(loss_fn)(variables["params"])
+
+    loss0, g0 = loss_grads(False)
+    loss1, g1 = loss_grads(True)
+    np.testing.assert_allclose(float(loss0), float(loss1),
+                               rtol=1e-5, atol=1e-6)
+    for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
