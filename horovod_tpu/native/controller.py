@@ -525,6 +525,18 @@ class NativeController:
             # padding it would only waste up to 2x transfer/ICI bytes.
             from ..ops.adasum import _next_pow2
 
+            if len(entries) == 1:
+                # single-entry bucket: no fusion buffer to build — the
+                # numpy pack round-trip (payload→host, pack, host→device,
+                # result→host) is pure overhead here, a measured slice of
+                # eager single-op latency (PERF.md round-4).  Hand the
+                # device array straight to the engine.
+                e = entries[0]
+                resolve(e, eng.allreduce(
+                    jnp.asarray(e.payload), ReduceOp(root_or_rop),
+                    prescale, postscale, ps,
+                ))
+                return
             raw = [np.asarray(e.payload) for e in entries]
             sizes = [int(a.size) for a in raw]
             # shapes from the originals: ascontiguousarray promotes 0-d

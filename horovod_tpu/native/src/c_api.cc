@@ -76,13 +76,22 @@ GlobalState* g() {
 
 void BackgroundThreadLoop() {
   // Reference: BackgroundThreadLoop in operations.cc — cycle, then sleep
-  // the (possibly autotuned) cycle time.
+  // the (possibly autotuned) cycle time.  The sleep is SKIPPED when the
+  // cycle just made progress (new submissions popped or responses
+  // executed) or more work is already queued: in-flight ops never pay
+  // the idle-poll interval — the next request piggybacks on the
+  // response broadcast just handled (round-4 eager latency; PERF.md).
+  // Progress-gating bounds the spin: a rank merely WAITING (stall,
+  // straggler peer, join barrier) makes no progress and sleeps, so the
+  // fleet cannot busy-loop the negotiation channel through a stall.
   auto* s = g();
   while (!s->shutdown.load()) {
     if (!s->controller->RunLoopOnce()) {
       s->loop_dead.store(true);
       break;
     }
+    if (s->queue->Size() > 0 || s->controller->last_cycle_progress())
+      continue;
     auto ms = s->params->cycle_time_ms();
     std::this_thread::sleep_for(
         std::chrono::duration<double, std::milli>(ms));

@@ -19,6 +19,7 @@ bool Controller::RunLoopOnce() {
   // Cache-hit signatures travel as bare positions (the reference's
   // ResponseCache bit vector); only misses are fully encoded.
   auto newly = queue_->PopAll();
+  last_cycle_progress_.store(!newly.empty());
   std::vector<int64_t> hit_positions;
   std::vector<TensorTableEntry> full;
   for (auto& e : newly) {
@@ -202,6 +203,7 @@ bool Controller::RunLoopOnce() {
           timeline_->ActivityEnd(resp.names[i], "XLA_COMM");
   }
   if (cycle_bytes > 0) params_->Observe(cycle_bytes);
+  if (!responses.empty()) last_cycle_progress_.store(true);
   if (timeline_ && timeline_->active() && !responses.empty())
     timeline_->MarkCycle();
 

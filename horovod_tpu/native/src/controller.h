@@ -74,6 +74,14 @@ class Controller {
   // O(positions) bytes; a miss cycle carries full encodings).
   int64_t last_request_bytes() const { return last_request_bytes_.load(); }
 
+  // Whether the last cycle did anything (popped new entries or executed
+  // responses).  Gates the background loop's sleep-skip: progress means
+  // more work is likely imminent (piggyback the next request on the
+  // response just handled); NO progress — e.g. every rank blocked on a
+  // straggler — must sleep, or the fleet busy-spins the negotiation
+  // channel for the whole wait.
+  bool last_cycle_progress() const { return last_cycle_progress_.load(); }
+
  private:
   struct PendingCoord {  // coordinator-side per-name state
     TensorTableEntry meta;
@@ -90,6 +98,7 @@ class Controller {
   void AccountReport(PendingCoord* pc, int32_t r, const TensorTableEntry& e);
 
   std::atomic<int64_t> last_request_bytes_{0};
+  std::atomic<bool> last_cycle_progress_{false};
   // coordinator-side unrecoverable negotiation failure (e.g. replicated
   // cache divergence); broadcast as a no-names error response
   std::string protocol_error_;

@@ -261,6 +261,10 @@ class CollectiveEngine:
             # would all-gather every contribution to every chip first
             # (O(P·tensor) transient — round-2 verdict item 6).  The mask
             # counts each process's tiled contribution exactly once.
+            # NOTE (round 4): baking the scale factors into the program
+            # as cache-keyed constants was tried and REVERTED — no
+            # measurable latency win, and it broke traced scales
+            # (dynamic loss scaling) and recompiled per scale value.
             key = ("allreduce_psum", x.shape, str(x.dtype), int(op))
 
             def make_body():
