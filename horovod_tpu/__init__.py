@@ -37,8 +37,14 @@ from .common.basics import (
     xla_built,
     nccl_built,
     mpi_enabled,
+    mpi_built,
+    mpi_threads_supported,
     gloo_built,
+    gloo_enabled,
     ccl_built,
+    cuda_built,
+    rocm_built,
+    ddl_built,
     native_built,
     start_timeline,
     stop_timeline,
@@ -65,6 +71,8 @@ from .ops.collective_ops import (
     grouped_allgather,
     grouped_allreduce,
     grouped_allreduce_async,
+    grouped_reducescatter,
+    grouped_reducescatter_async,
     join,
     poll,
     reducescatter,

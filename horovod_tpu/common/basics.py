@@ -332,6 +332,31 @@ def ccl_built() -> bool:
     return False
 
 
+def mpi_built() -> bool:
+    return False
+
+
+def gloo_enabled() -> bool:
+    return False
+
+
+def cuda_built() -> bool:
+    return False
+
+
+def rocm_built() -> bool:
+    return False
+
+
+def ddl_built() -> bool:
+    return False
+
+
+def mpi_threads_supported() -> bool:
+    # no MPI at all; scripts that branch on this get the honest answer
+    return False
+
+
 def native_built() -> bool:
     """True when the C++ controller core is loaded (no Python fallback)."""
     st = _require_init()

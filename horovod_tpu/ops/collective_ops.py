@@ -485,6 +485,49 @@ def reducescatter_async(
     return Handle(result)
 
 
+def grouped_reducescatter(
+    tensors: Sequence[Any],
+    op: ReduceOp = Sum,
+    name: Optional[str] = None,
+    process_set: Optional[ProcessSet] = None,
+) -> List[Any]:
+    """Reference: grouped_reducescatter (torch/mpi_ops.py) — the group
+    executes atomically via a GroupTable id on the native path; the
+    fallback path treats the list as one pytree."""
+    return list(
+        grouped_reducescatter_async(
+            tensors, op=op, name=name, process_set=process_set
+        ).wait()
+    )
+
+
+def grouped_reducescatter_async(
+    tensors: Sequence[Any],
+    op: ReduceOp = Sum,
+    name: Optional[str] = None,
+    process_set: Optional[ProcessSet] = None,
+) -> Handle:
+    if not tensors:
+        # before register_group: a size-0 group would enqueue no entries
+        # and its GroupTable entry would never be forgotten
+        return Handle([])
+    ctrl = _native(list(tensors))
+    if ctrl is not None:
+        n_leaves = len(jax.tree_util.tree_leaves(list(tensors)))
+        gid = ctrl.register_group(n_leaves)
+        from ..native.controller import OP_REDUCESCATTER
+
+        return _native_submit(
+            list(tensors), OP_REDUCESCATTER, name,
+            reduce_op=int(op), group_id=gid,
+            process_set_id=(
+                process_set.process_set_id if process_set is not None
+                else 0
+            ),
+        )
+    return reducescatter_async(list(tensors), op, name, process_set)
+
+
 # -- barrier / join ----------------------------------------------------------
 
 

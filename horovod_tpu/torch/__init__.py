@@ -24,26 +24,31 @@ from __future__ import annotations
 from ..common.basics import (  # noqa: F401
     init, shutdown, is_initialized, rank, local_rank, size, local_size,
     cross_rank, cross_size, is_homogeneous, xla_built, nccl_built,
-    mpi_enabled, gloo_built, ccl_built, native_built,
-    start_timeline, stop_timeline,
+    mpi_enabled, mpi_built, mpi_threads_supported, gloo_built,
+    gloo_enabled, ccl_built, cuda_built, rocm_built, ddl_built,
+    native_built, start_timeline, stop_timeline,
 )
 from ..common.exceptions import (  # noqa: F401
     HorovodInternalError, HostsUpdatedInterrupt,
 )
 from ..common.process_sets import ProcessSet, global_process_set  # noqa: F401
+from .. import add_process_set, remove_process_set  # noqa: F401
 from ..ops.reduce_ops import (  # noqa: F401
     Adasum, Average, Max, Min, Product, ReduceOp, Sum,
 )
 from .compression import Compression  # noqa: F401
 from .functions import (  # noqa: F401
-    broadcast_object, broadcast_optimizer_state, broadcast_parameters,
+    allgather_object, broadcast_object, broadcast_optimizer_state,
+    broadcast_parameters,
 )
 from .mpi_ops import (  # noqa: F401
     allgather, allgather_async, allreduce, allreduce_, allreduce_async,
     allreduce_async_, alltoall, alltoall_async, barrier, broadcast,
-    broadcast_, broadcast_async, broadcast_async_, grouped_allreduce,
-    grouped_allreduce_, grouped_allreduce_async, grouped_allreduce_async_,
-    join, poll, reducescatter, reducescatter_async, synchronize,
+    broadcast_, broadcast_async, broadcast_async_, grouped_allgather,
+    grouped_allreduce, grouped_allreduce_, grouped_allreduce_async,
+    grouped_allreduce_async_, grouped_reducescatter,
+    grouped_reducescatter_async, join, poll, reducescatter,
+    reducescatter_async, synchronize,
 )
 from .optimizer import DistributedOptimizer  # noqa: F401
 from .sync_batch_norm import SyncBatchNorm  # noqa: F401

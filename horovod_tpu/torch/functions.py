@@ -44,6 +44,13 @@ def broadcast_object(obj: Any, root_rank: int = 0, name: str = None,
                                            process_set=process_set)
 
 
+def allgather_object(obj: Any, name: str = None, process_set=None) -> list:
+    """Reference: horovod/torch/mpi_ops.py allgather_object — per-rank
+    pickled payloads gathered to every rank; delegates to the shared
+    implementation."""
+    return _jax_functions.allgather_object(obj, process_set=process_set)
+
+
 def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
                               root_rank: int = 0, process_set=None) -> None:
     """Broadcast optimizer state dict from root (reference:

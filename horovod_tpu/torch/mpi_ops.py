@@ -164,6 +164,17 @@ def allgather(tensor: torch.Tensor, **kwargs) -> torch.Tensor:
     return synchronize(allgather_async(tensor, **kwargs))
 
 
+def grouped_allgather(tensors: Sequence[torch.Tensor],
+                      name: Optional[str] = None,
+                      process_set: Optional[ProcessSet] = None) -> list:
+    """Reference: torch grouped_allgather — one fused dim0-table
+    exchange + per-dtype-bucket gather (ops/collective_ops.py)."""
+    outs = _ops.grouped_allgather(
+        [_to_np(t) for t in tensors], name=name, process_set=process_set
+    )
+    return [_from_np(o, t) for o, t in zip(outs, tensors)]
+
+
 # -- broadcast ---------------------------------------------------------------
 
 
@@ -231,6 +242,25 @@ def reducescatter_async(tensor: torch.Tensor, op: Optional[ReduceOp] = None,
 
 def reducescatter(tensor: torch.Tensor, **kwargs) -> torch.Tensor:
     return synchronize(reducescatter_async(tensor, **kwargs))
+
+
+def grouped_reducescatter_async(tensors: Sequence[torch.Tensor],
+                                **kwargs) -> int:
+    """Reference: torch grouped_reducescatter — atomic group release via
+    the native GroupTable id."""
+    inner = _ops.grouped_reducescatter_async(
+        [_to_np(t) for t in tensors], **kwargs
+    )
+
+    def finalize(outs):
+        return [_from_np(o, t) for o, t in zip(outs, tensors)]
+
+    return _handles.allocate(inner, finalize)
+
+
+def grouped_reducescatter(tensors: Sequence[torch.Tensor],
+                          **kwargs) -> list:
+    return synchronize(grouped_reducescatter_async(tensors, **kwargs))
 
 
 def barrier(process_set: Optional[ProcessSet] = None) -> None:

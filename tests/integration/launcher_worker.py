@@ -154,6 +154,15 @@ def main():
         np.asarray(chunk), nproc * np.arange(me * 3, me * 3 + 3)
     )
 
+    # grouped reducescatter: atomic group release, per-entry chunks
+    ra, rb = hvd.grouped_reducescatter(
+        [full, full * 2.0], op=hvd.Sum, name="grp_rs"
+    )
+    np.testing.assert_allclose(
+        np.asarray(ra), nproc * np.arange(me * 3, me * 3 + 3))
+    np.testing.assert_allclose(
+        np.asarray(rb), 2.0 * nproc * np.arange(me * 3, me * 3 + 3))
+
     # object plumbing
     objs = hvd.allgather_object({"rank": hvd.cross_rank()})
     assert [o["rank"] for o in objs] == list(range(nproc))

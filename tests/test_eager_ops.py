@@ -183,3 +183,24 @@ def test_profiler_bridge_spans_in_xplane_capture(tmp_path):
                for n in names), sorted(n for n in names if "hvd" in n)
     assert any("hvd_tpu::bridge_probe" in n and "XLA_COMM" in n
                for n in names), sorted(n for n in names if "hvd" in n)
+
+
+def test_grouped_reducescatter_single():
+    """np=1 degenerate: each entry's full reduction is its own chunk
+    (reference: torch grouped_reducescatter surface)."""
+    a, b = jnp.arange(6.0), jnp.ones((4,)) * 3.0
+    ra, rb = hvd.grouped_reducescatter([a, b], op=hvd.Sum, name="grs1")
+    np.testing.assert_allclose(np.asarray(ra), np.asarray(a))
+    np.testing.assert_allclose(np.asarray(rb), np.asarray(b))
+
+
+def test_build_capability_flags():
+    """Reference: horovod/common/basics.py capability probes — scripts
+    branch on these; every backend the reference can report is answered
+    honestly (XLA yes, everything else no)."""
+    assert hvd.xla_built()
+    for probe in (hvd.nccl_built, hvd.mpi_built, hvd.mpi_enabled,
+                  hvd.mpi_threads_supported, hvd.gloo_built,
+                  hvd.gloo_enabled, hvd.ccl_built, hvd.cuda_built,
+                  hvd.rocm_built, hvd.ddl_built):
+        assert probe() is False, probe

@@ -212,3 +212,22 @@ def test_torch_elastic_state_roundtrip():
     torch.testing.assert_close(model.weight.detach(), w0)
     assert state.epoch == 0
     assert state.model is model  # restored in place via load_state_dict
+
+
+def test_torch_grouped_allgather_and_reducescatter_single():
+    """np=1 degenerate semantics of the new grouped torch wrappers."""
+    import horovod_tpu.torch as hvd_t
+
+    a = torch.arange(6, dtype=torch.float32)
+    b = torch.ones(4) * 2.0
+    ga, gb = hvd_t.grouped_allgather([a, b])
+    assert torch.equal(ga, a) and torch.equal(gb, b)
+    ra, rb = hvd_t.grouped_reducescatter([a, b], op=hvd_t.Sum)
+    assert torch.equal(ra, a) and torch.equal(rb, b)
+
+
+def test_torch_allgather_object_single():
+    import horovod_tpu.torch as hvd_t
+
+    objs = hvd_t.allgather_object({"rank": hvd_t.cross_rank()})
+    assert objs == [{"rank": 0}]
