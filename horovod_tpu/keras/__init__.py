@@ -48,3 +48,66 @@ from ..tensorflow.mpi_ops import (  # noqa: F401
 from ..tensorflow.optimizer import DistributedOptimizer  # noqa: F401
 from . import callbacks  # noqa: F401
 from . import elastic  # noqa: F401
+
+
+def broadcast_global_variables(root_rank: int = 0, models=None) -> None:
+    """Reference: horovod/tensorflow/keras broadcast_global_variables.
+
+    Keras 3 has no TF1 global-variables collection, and any implicit
+    substitute (scanning the heap for live models) would be
+    nondeterministic across ranks — a collective-mismatch hazard.  So
+    the models must be passed explicitly; with ``models=None`` this
+    raises with the migration options (the same documented-fallback
+    pattern the TF adapter uses for untranslatable TF1 surfaces)."""
+    if models is None:
+        raise ValueError(
+            "Keras 3 has no global-variables collection to broadcast. "
+            "Pass models=[model, ...] here, or use "
+            "broadcast_model_weights(model), or add "
+            "callbacks.BroadcastGlobalVariablesCallback(0) to fit() — "
+            "the drop-in equivalent of the reference pattern."
+        )
+    if not isinstance(models, (list, tuple)):
+        models = [models]
+    seen = set()
+    variables = []
+    for model in models:  # caller-supplied order: identical on all ranks
+        for v in model.variables:
+            if id(v) not in seen:
+                seen.add(id(v))
+                variables.append(v)
+    if variables:
+        broadcast_variables(variables, root_rank=root_rank)
+
+
+def load_model(filepath, custom_optimizers=None, custom_objects=None,
+               compression=None):
+    """Reference: horovod/tensorflow/keras load_model — deserialize a
+    saved model and wrap its optimizer in DistributedOptimizer so a
+    restored training run is distributed again.
+
+    A model saved mid-training carries the DistributedOptimizer's
+    dynamic subclass in its config (module horovod_tpu.tensorflow.\
+    optimizer, class_name of the BASE optimizer), which keras cannot
+    locate on its own; the built-in keras optimizer classes — plus any
+    ``custom_optimizers`` — are injected as custom_objects so the base
+    optimizer deserializes, then the wrapper is re-applied."""
+    import keras
+
+    co = dict(custom_objects or {})
+    opt_classes = [
+        cls for cls in vars(keras.optimizers).values()
+        if isinstance(cls, type)
+        and issubclass(cls, keras.optimizers.Optimizer)
+    ]
+    opt_classes.extend(custom_optimizers or [])
+    for cls in opt_classes:
+        co.setdefault(cls.__name__, cls)
+    model = keras.models.load_model(filepath, custom_objects=co)
+    opt = getattr(model, "optimizer", None)
+    if opt is not None and not hasattr(opt, "_hvd_passes_per_step"):
+        kwargs = {}
+        if compression is not None:
+            kwargs["compression"] = compression
+        model.optimizer = DistributedOptimizer(opt, **kwargs)
+    return model
