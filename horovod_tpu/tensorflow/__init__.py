@@ -27,13 +27,15 @@ from __future__ import annotations
 from ..common.basics import (  # noqa: F401
     init, shutdown, is_initialized, rank, local_rank, size, local_size,
     cross_rank, cross_size, is_homogeneous, xla_built, nccl_built,
-    mpi_enabled, gloo_built, ccl_built, native_built,
-    start_timeline, stop_timeline,
+    mpi_enabled, mpi_built, mpi_threads_supported, gloo_built,
+    gloo_enabled, ccl_built, cuda_built, rocm_built, ddl_built,
+    native_built, start_timeline, stop_timeline,
 )
 from ..common.exceptions import (  # noqa: F401
     HorovodInternalError, HostsUpdatedInterrupt,
 )
 from ..common.process_sets import ProcessSet, global_process_set  # noqa: F401
+from .. import add_process_set, remove_process_set  # noqa: F401
 from ..ops.reduce_ops import (  # noqa: F401
     Adasum, Average, Max, Min, Product, ReduceOp, Sum,
 )
@@ -43,8 +45,8 @@ from .functions import (  # noqa: F401
     broadcast_model_weights, broadcast_variables,
 )
 from .mpi_ops import (  # noqa: F401
-    allgather, allreduce, alltoall, barrier, broadcast, grouped_allreduce,
-    join, reducescatter,
+    allgather, allreduce, alltoall, barrier, broadcast, grouped_allgather,
+    grouped_allreduce, grouped_reducescatter, join, reducescatter,
 )
 from .gradient_aggregation import LocalGradientAggregationHelper  # noqa: F401
 from .optimizer import (  # noqa: F401
@@ -52,3 +54,30 @@ from .optimizer import (  # noqa: F401
 )
 from .sync_batch_norm import SyncBatchNormalization  # noqa: F401
 from . import elastic  # noqa: F401
+
+
+def broadcast_global_variables(root_rank: int = 0) -> None:
+    """Reference: horovod/tensorflow broadcast_global_variables — a TF1
+    global-collection API.  TF2 has no global variable collection (the
+    reference itself raises in eager mode pointing at
+    broadcast_variables); same contract here."""
+    raise RuntimeError(
+        "hvd.broadcast_global_variables() requires the TF1 global "
+        "variable collection, which does not exist under TF2 eager "
+        "semantics.  Use hvd.broadcast_variables(model.variables, "
+        f"root_rank={root_rank}) or broadcast_model_weights(model) "
+        "instead (the reference raises the same way in eager mode)."
+    )
+
+
+def __getattr__(name):
+    # lazy: importing horovod_tpu.tensorflow must not pull keras in.
+    # importlib directly — `from . import keras` would probe this very
+    # __getattr__ before importing (infinite recursion).
+    if name == "keras":
+        import importlib
+
+        return importlib.import_module(__name__ + ".keras")
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}"
+    )

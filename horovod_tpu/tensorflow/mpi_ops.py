@@ -36,7 +36,7 @@ import tensorflow as tf
 
 from ..common.process_sets import ProcessSet
 from ..ops import collective_ops as _ops
-from ..ops.reduce_ops import ReduceOp
+from ..ops.reduce_ops import ReduceOp, Sum
 
 
 def _is_symbolic(t) -> bool:
@@ -162,6 +162,53 @@ def grouped_allreduce(tensors, average: Optional[bool] = None,
     for o, t in zip(outs, tensors):
         o.set_shape(t.shape)
     return list(outs)
+
+
+def _run_grouped(engine_fn, tensors, op_name: str):
+    """Shared scaffold for grouped shape-dynamic collectives: eager →
+    engine directly; plain graph → py_function; jit_compile → clean
+    rejection (dim0 may differ per rank, so output dim0 is unknown)."""
+    _check_xla_error()
+    tensors = [tf.convert_to_tensor(t) for t in tensors]
+    if any(_is_symbolic(t) for t in tensors):
+        _reject_in_jit(op_name)
+        douts = [t.dtype for t in tensors]
+
+        def run(*arrays):
+            return [np.asarray(o)
+                    for o in engine_fn([a.numpy() for a in arrays])]
+
+        outs = tf.py_function(run, tensors, Tout=douts)
+        for o, t in zip(outs, tensors):
+            if t.shape.rank is not None:  # unknown rank stays unknown
+                o.set_shape([None] + list(t.shape)[1:])
+        return list(outs)
+    outs = engine_fn([t.numpy() for t in tensors])
+    return [tf.convert_to_tensor(np.asarray(o), dtype=t.dtype)
+            for o, t in zip(outs, tensors)]
+
+
+def grouped_allgather(tensors, name: Optional[str] = None,
+                      process_set: Optional[ProcessSet] = None):
+    """Reference: tf grouped_allgather — atomic fused group (one dim0
+    exchange + per-dtype-bucket gather on the shared implementation)."""
+    return _run_grouped(
+        lambda arrays: _ops.grouped_allgather(
+            arrays, name=name, process_set=process_set),
+        tensors, "grouped_allgather",
+    )
+
+
+def grouped_reducescatter(tensors, op: Optional[ReduceOp] = None,
+                          name: Optional[str] = None,
+                          process_set: Optional[ProcessSet] = None):
+    """Reference: tf grouped_reducescatter — atomic group release."""
+    return _run_grouped(
+        lambda arrays: _ops.grouped_reducescatter(
+            arrays, op=op if op is not None else Sum, name=name,
+            process_set=process_set),
+        tensors, "grouped_reducescatter",
+    )
 
 
 # -- allgather / broadcast ---------------------------------------------------

@@ -434,3 +434,39 @@ def test_engine_error_in_jit_surfaces_at_next_eager_call(monkeypatch):
     np.testing.assert_allclose(
         hvd.allreduce(tf.constant([3.0]), op=hvd.Sum,
                       name="post_err2").numpy(), [3.0])
+
+
+def test_tf_grouped_allgather_and_reducescatter_single():
+    """np=1 degenerate semantics of the grouped tf wrappers (eager +
+    plain-graph py_function paths)."""
+    import horovod_tpu.tensorflow as hvd_tf
+
+    a = tf.constant([1.0, 2.0, 3.0])
+    b = tf.constant([[4.0], [5.0]])
+    ga, gb = hvd_tf.grouped_allgather([a, b])
+    assert np.allclose(ga.numpy(), a.numpy())
+    assert np.allclose(gb.numpy(), b.numpy())
+    ra, rb = hvd_tf.grouped_reducescatter([a, b])
+    assert np.allclose(ra.numpy(), a.numpy())
+    assert np.allclose(rb.numpy(), b.numpy())
+
+    @tf.function  # plain graph (no jit_compile): py_function path
+    def graph_fn(x, y):
+        return hvd_tf.grouped_reducescatter([x, y])
+
+    ra, rb = graph_fn(a, b)
+    assert np.allclose(ra.numpy(), a.numpy())
+    assert np.allclose(rb.numpy(), b.numpy())
+
+
+def test_tf_broadcast_global_variables_raises_with_guidance():
+    import horovod_tpu.tensorflow as hvd_tf
+
+    with pytest.raises(RuntimeError, match="broadcast_variables"):
+        hvd_tf.broadcast_global_variables(0)
+
+
+def test_tf_keras_lazy_attribute():
+    import horovod_tpu.tensorflow as hvd_tf
+
+    assert hasattr(hvd_tf.keras, "DistributedOptimizer")
