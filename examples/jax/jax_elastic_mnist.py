@@ -54,8 +54,17 @@ def main():
     @hvd.elastic.run
     def train(state):
         while state.epoch < 3:
-            state.sampler.set_epoch(state.epoch)
+            if state.sampler.epoch != state.epoch:
+                # entering a NEW epoch.  On a mid-epoch resume/resize the
+                # restored sampler already carries this epoch's progress;
+                # set_epoch here would wipe it and the old batch offset
+                # would slice a shard computed for the new world — samples
+                # dropped AND duplicated.
+                state.sampler.set_epoch(state.epoch)
+            # this rank's REMAINING shard for the current world; batch
+            # indices restart at 0 relative to it on every (re)entry
             indices = list(state.sampler)
+            state.batch = 0
             while state.batch * batch_size < len(indices):
                 lo = state.batch * batch_size
                 idx = indices[lo:lo + batch_size]
@@ -76,6 +85,7 @@ def main():
                     state.commit()
             state.batch = 0
             state.epoch += 1
+            state.sampler.set_epoch(state.epoch)
             state.commit()
             if hvd.rank() == 0:
                 print(f"epoch {state.epoch} done "

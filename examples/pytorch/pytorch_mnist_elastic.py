@@ -1,0 +1,119 @@
+"""Elastic MNIST with the torch adapter.
+
+Reference parity: examples/elastic/pytorch/pytorch_mnist_elastic.py —
+the commit/restore/sync elastic loop (SURVEY.md §3.4) over a torch
+model: ``TorchState(model=..., optimizer=...)``, an ``ElasticSampler``
+that reshards remaining work on every membership change, and
+``@hvd.elastic.run`` wrapping the epoch loop.
+
+Run::
+
+    tpurun --min-np 1 --max-np 4 \
+        --host-discovery-script ./discover.sh \
+        python examples/pytorch/pytorch_mnist_elastic.py
+
+where discover.sh prints the current "host:slots" lines.  Uses
+synthetic MNIST-shaped data (no dataset download in this image).
+"""
+
+import argparse
+
+import numpy as np
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+
+
+class Net(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(784, 128)
+        self.fc2 = nn.Linear(128, 10)
+
+    def forward(self, x):
+        return F.log_softmax(self.fc2(F.relu(self.fc1(x))), dim=1)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--n", type=int, default=4096)
+    args = ap.parse_args()
+
+    hvd.init()
+    rng = np.random.RandomState(0)
+    images = torch.from_numpy(rng.randn(args.n, 784).astype(np.float32))
+    labels = torch.from_numpy(rng.randint(0, 10, size=(args.n,)))
+
+    model = Net()
+    optimizer = torch.optim.SGD(
+        model.parameters(), lr=0.01 * hvd.cross_size(), momentum=0.9
+    )
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters()
+    )
+
+    sampler = hvd.elastic.ElasticSampler(args.n, shuffle=True)
+    state = hvd.elastic.TorchState(
+        model=model, optimizer=optimizer, sampler=sampler,
+        epoch=0, batch=0,
+    )
+
+    def on_reset():
+        # keep the linear-scaling rule in force across resizes
+        for g in optimizer.param_groups:
+            g["lr"] = 0.01 * hvd.cross_size()
+        print(f"[rank {hvd.cross_rank()}] world resized to "
+              f"{hvd.cross_size()}; lr -> {0.01 * hvd.cross_size():.3f}",
+              flush=True)
+
+    state.register_reset_callbacks([on_reset])
+
+    @hvd.elastic.run
+    def train(state):
+        loss = torch.zeros(())  # defined even if a resumed epoch is empty
+        while state.epoch < args.epochs:
+            if state.sampler.epoch != state.epoch:
+                # entering a NEW epoch.  On a mid-epoch resume/resize the
+                # restored sampler already carries this epoch's progress;
+                # set_epoch would wipe it and a stale batch offset would
+                # slice a shard computed for the new world.
+                state.sampler.set_epoch(state.epoch)
+            # this rank's REMAINING shard for the current world; batch
+            # indices restart at 0 relative to it on every (re)entry
+            indices = list(state.sampler)
+            state.batch = 0
+            while state.batch * args.batch_size < len(indices):
+                lo = state.batch * args.batch_size
+                take = indices[lo:lo + args.batch_size]
+                if not take:
+                    break
+                x, y = images[take], labels[take]
+                optimizer.zero_grad()
+                loss = F.nll_loss(model(x), y)
+                loss.backward()
+                optimizer.step()
+                state.sampler.record_batch(state.batch, args.batch_size)
+                state.batch += 1
+                if state.batch % 10 == 0:
+                    state.commit()
+            if hvd.cross_rank() == 0:
+                print(f"epoch {state.epoch}: loss={float(loss):.4f} "
+                      f"world={hvd.cross_size()}", flush=True)
+            state.epoch += 1
+            state.batch = 0
+            state.sampler.set_epoch(state.epoch)
+            state.commit()
+        return float(loss)
+
+    final = train(state)
+    if hvd.cross_rank() == 0:
+        print(f"final loss: {final:.4f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
