@@ -45,11 +45,23 @@ class TransformerConfig:
     # shard_map with the sequence sharded over that axis.
     attention_impl: str = "dot"
     seq_axis_name: Optional[str] = None
+    # False = bidirectional (encoder / BERT-family) attention; only the
+    # 'dot' impl supports it — the flash/ring kernels are causal by
+    # construction (their block-skipping IS the causal mask)
+    causal: bool = True
     # rematerialize each decoder block in the backward pass: activation
     # memory drops from O(layers) to O(1) blocks at ~1/3 extra FLOPs —
     # the standard TPU memory/compute trade (jax.checkpoint) that lets
     # long-context and large-batch configs fit HBM
     remat: bool = False
+
+    def __post_init__(self):
+        if not self.causal and self.attention_impl != "dot":
+            raise ValueError(
+                "bidirectional attention (causal=False) supports only "
+                "attention_impl='dot': the flash/ring kernels' block "
+                "skipping is the causal mask itself"
+            )
 
     @property
     def d_model(self) -> int:
@@ -68,18 +80,21 @@ def rope(x: jax.Array, positions: jax.Array) -> jax.Array:
     return out.astype(x.dtype)
 
 
-def causal_dot_attention(q, k, v, *, q_offset=0, k_offset=0):
-    """Standard causal attention; offsets support sequence-sharded blocks.
+def causal_dot_attention(q, k, v, *, q_offset=0, k_offset=0, causal=True):
+    """Standard attention; offsets support sequence-sharded blocks.
 
     q, k, v: (B, S, H, D).  Softmax in float32 (TPU numerics), matmuls in
-    the input dtype so they hit the MXU in bf16.
+    the input dtype so they hit the MXU in bf16.  ``causal=False`` is
+    the bidirectional (encoder / BERT-family) form — no mask at all.
     """
     d = q.shape[-1]
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(d).astype(q.dtype)
-    q_pos = q_offset + jnp.arange(q.shape[1])
-    k_pos = k_offset + jnp.arange(k.shape[1])
-    mask = q_pos[:, None] >= k_pos[None, :]
-    logits = jnp.where(mask[None, None], logits.astype(jnp.float32), -1e30)
+    logits = logits.astype(jnp.float32)
+    if causal:
+        q_pos = q_offset + jnp.arange(q.shape[1])
+        k_pos = k_offset + jnp.arange(k.shape[1])
+        mask = q_pos[:, None] >= k_pos[None, :]
+        logits = jnp.where(mask[None, None], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
@@ -111,7 +126,7 @@ class Attention(nn.Module):
 
             out = flash_attention(q, k, v)
         else:
-            out = causal_dot_attention(q, k, v)
+            out = causal_dot_attention(q, k, v, causal=cfg.causal)
         return nn.DenseGeneral(
             features=cfg.d_model, axis=(-2, -1), dtype=cfg.dtype,
             use_bias=False, name="o",

@@ -378,3 +378,34 @@ def test_resnet_remat_numerics_identical():
     for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-5, atol=1e-6)
+
+
+def test_encoder_attention_is_bidirectional():
+    """causal=False (BERT-family encoder mode): position 0's output
+    depends on later tokens; causal=True must not."""
+    import numpy as np
+    from horovod_tpu.models.transformer import Transformer, \
+        TransformerConfig
+
+    def out_at_zero(causal, tokens):
+        cfg = TransformerConfig(
+            vocab_size=32, num_layers=1, num_heads=2, head_dim=8,
+            max_seq_len=8, dtype=jnp.float32, causal=causal,
+        )
+        model = Transformer(cfg)
+        v = model.init(jax.random.PRNGKey(0), tokens)
+        return np.asarray(model.apply(v, tokens))[:, 0]
+
+    t1 = jnp.asarray([[1, 2, 3, 4]])
+    t2 = jnp.asarray([[1, 2, 3, 9]])  # perturb only the LAST token
+    assert not np.allclose(out_at_zero(False, t1), out_at_zero(False, t2))
+    np.testing.assert_allclose(out_at_zero(True, t1),
+                               out_at_zero(True, t2), rtol=1e-6)
+    # flash/ring reject encoder mode at CONFIG TIME with guidance
+    import pytest
+
+    with pytest.raises(ValueError, match="causal"):
+        TransformerConfig(
+            vocab_size=32, num_layers=1, num_heads=2, head_dim=8,
+            max_seq_len=8, causal=False, attention_impl="flash",
+        )
