@@ -1,0 +1,117 @@
+"""MNIST-style training with the MXNet adapter.
+
+Reference parity: examples/mxnet/mxnet_mnist.py — the canonical Gluon
+script shape: hvd.init, per-rank data shard, DistributedTrainer over
+collect_params-style parameters, parameter broadcast from rank 0,
+metric allreduce.  Only the import line differs from the reference.
+
+mxnet is not installable in this image (archived upstream); to run the
+example here, put the test fake on the path first:
+
+    PYTHONPATH=tests/_fake_modules tpurun -np 2 \
+        python examples/mxnet/mxnet_mnist.py --epochs 1
+
+With a real mxnet install the same script runs unchanged (the fake
+implements the subset of the NDArray/gluon API this script uses).
+"""
+
+import argparse
+
+import numpy as np
+
+import mxnet as mx
+
+import horovod_tpu.mxnet as hvd
+
+
+def synthetic_mnist(n=2048, seed=0):
+    """Linearly separable blobs in 784-d (no dataset downloads here)."""
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(10, 784).astype(np.float32) * 2.0
+    labels = rng.randint(0, 10, size=n)
+    feats = centers[labels] + rng.randn(n, 784).astype(np.float32) * 0.5
+    return feats, labels.astype(np.int32)
+
+
+def build_params(seed):
+    """A 784->10 linear classifier as gluon Parameters (the fake gluon
+    has no full Block machinery; with real mxnet swap in gluon.nn.Dense
+    and net.collect_params())."""
+    rng = np.random.RandomState(seed)
+    w = mx.gluon.Parameter("weight", shape=(784, 10))
+    b = mx.gluon.Parameter("bias", shape=(10,))
+    w.data()[:] = (rng.randn(784, 10) * 0.01).astype(np.float32)
+    b.data()[:] = np.zeros(10, np.float32)
+    return {"weight": w, "bias": b}
+
+
+def forward(params, x):
+    return x @ params["weight"].data().asnumpy() \
+        + params["bias"].data().asnumpy()
+
+
+def softmax_xent_grads(params, x, y):
+    """Loss + grads for the linear model (numpy autodiff by hand — the
+    fake has no autograd; real mxnet scripts use autograd.record())."""
+    logits = forward(params, x)
+    z = logits - logits.max(axis=1, keepdims=True)
+    p = np.exp(z)
+    p /= p.sum(axis=1, keepdims=True)
+    n = len(y)
+    loss = -np.log(p[np.arange(n), y] + 1e-9).mean()
+    # gluon convention: grads are batch SUMS; trainer.step(batch_size)
+    # applies the 1/batch
+    dlogits = p
+    dlogits[np.arange(n), y] -= 1.0
+    params["weight"].grad()[:] = (x.T @ dlogits).astype(np.float32)
+    params["bias"].grad()[:] = dlogits.sum(axis=0).astype(np.float32)
+    return loss
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=0.1)
+    args = ap.parse_args()
+
+    hvd.init()
+    nproc, me = hvd.cross_size(), hvd.cross_rank()
+
+    feats, labels = synthetic_mnist()
+    shard = slice(me, len(feats), nproc)  # rank-strided shard
+    feats, labels = feats[shard], labels[shard]
+
+    params = build_params(seed=me)  # deliberately divergent init
+    hvd.broadcast_parameters(params, root_rank=0)  # …made identical
+
+    trainer = hvd.DistributedTrainer(
+        params, "sgd", {"learning_rate": args.lr * nproc}
+    )
+
+    steps = len(feats) // args.batch_size
+    for epoch in range(args.epochs):
+        loss = None
+        for s in range(steps):
+            sl = slice(s * args.batch_size, (s + 1) * args.batch_size)
+            loss = softmax_xent_grads(params, feats[sl], labels[sl])
+            trainer.step(args.batch_size)
+        avg = hvd.allreduce(
+            mx.nd.array(np.array([loss], np.float32)), name="loss"
+        )
+        if me == 0:
+            print(f"epoch {epoch}: loss {float(avg.asnumpy()[0]):.4f}",
+                  flush=True)
+
+    # final train accuracy, averaged across ranks
+    acc = (forward(params, feats).argmax(axis=1) == labels).mean()
+    acc = hvd.allreduce(mx.nd.array(np.array([acc], np.float32)),
+                        name="acc")
+    if me == 0:
+        final = float(acc.asnumpy()[0])
+        print(f"final accuracy: {final:.3f}", flush=True)
+        assert final > 0.9, f"did not converge: {final}"
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,196 @@
+"""horovod_tpu.mxnet: the MXNet framework adapter.
+
+Reference parity: the ``horovod.mxnet`` surface (horovod/mxnet/__init__.py,
+mpi_ops.py + the C++ binding mxnet/mpi_ops.cc, adapter.cc,
+tensor_util.cc — SURVEY.md §2.3).  A reference Gluon script needs only
+its import changed::
+
+    import horovod_tpu.mxnet as hvd
+    hvd.init()
+    trainer = hvd.DistributedTrainer(net.collect_params(), "sgd",
+                                     {"learning_rate": 0.01})
+    hvd.broadcast_parameters(net.collect_params(), root_rank=0)
+
+Design: like the torch adapter, MXNet stays the model frontend and
+collectives execute through the shared eager XLA engine via a numpy
+bridge (``asnumpy()`` in, ``t[:] =`` out).  mxnet itself is not
+installable in this image (archived upstream), so this adapter is
+exercised by contract tests against a faked ``mxnet`` module
+(tests/_fake_modules/mxnet) the same way the pyspark/ray launch paths
+are — the adapter bodies below run for real; only NDArray storage is
+faked.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import mxnet as mx
+
+# lifecycle + topology (shared with the JAX surface)
+from ..common.basics import (  # noqa: F401
+    init, shutdown, is_initialized, rank, local_rank, size, local_size,
+    cross_rank, cross_size, is_homogeneous, xla_built, nccl_built,
+    mpi_enabled, mpi_built, mpi_threads_supported, gloo_built,
+    gloo_enabled, ccl_built, cuda_built, rocm_built, ddl_built,
+    native_built, start_timeline, stop_timeline,
+)
+from ..common.exceptions import (  # noqa: F401
+    HorovodInternalError, HostsUpdatedInterrupt,
+)
+from ..common.process_sets import (  # noqa: F401
+    ProcessSet, global_process_set,
+)
+from .. import add_process_set, remove_process_set  # noqa: F401
+from ..ops.reduce_ops import (  # noqa: F401
+    Adasum, Average, Max, Min, Product, ReduceOp, Sum,
+)
+from .functions import broadcast_parameters  # noqa: F401
+from .mpi_ops import (  # noqa: F401
+    allgather, allreduce, allreduce_, alltoall, barrier, broadcast,
+    broadcast_, grouped_allreduce, grouped_allreduce_,
+    grouped_reducescatter, join, reducescatter,
+)
+from . import mpi_ops  # noqa: F401
+
+
+def _split_groups(items, num_groups: int):
+    """Partition items into num_groups contiguous buckets (reference:
+    horovod.mxnet num_groups grouped-allreduce batching); num_groups<=0
+    means one bucket."""
+    if num_groups <= 0 or num_groups >= len(items):
+        return [items] if num_groups <= 0 else [[it] for it in items]
+    size_, rem = divmod(len(items), num_groups)
+    out, start = [], 0
+    for g in range(num_groups):
+        end = start + size_ + (1 if g < rem else 0)
+        out.append(items[start:end])
+        start = end
+    return out
+
+
+class DistributedOptimizer(mx.optimizer.Optimizer):
+    """Wrap an ``mx.optimizer.Optimizer`` so every ``update()`` allreduces
+    the gradient first (reference: horovod/mxnet/__init__.py
+    DistributedOptimizer).
+
+    Reference math, re-based on the engine's Average: the wire carries an
+    AVERAGE allreduce of ``grad / gradient_predivide_factor`` and the
+    wrapped optimizer's ``rescale_grad`` absorbs the remaining
+    ``gradient_predivide_factor``.  (The reference ships SUM + a
+    ``rescale_grad /= size`` fold; here the engine's Average supplies the
+    1/N with the correct contributor count for any chips-per-process
+    topology — the ADVICE-r3 cross_size()-vs-size() trap.)
+    """
+
+    def __init__(self, optimizer, gradient_predivide_factor: float = 1.0,
+                 num_groups: int = 0,
+                 process_set: Optional[ProcessSet] = None):
+        if isinstance(optimizer, DistributedOptimizer):
+            raise ValueError(
+                "optimizer is already a horovod_tpu DistributedOptimizer"
+            )
+        self._optimizer = optimizer
+        self._predivide = float(gradient_predivide_factor)
+        self._num_groups = int(num_groups)
+        self._process_set = process_set
+        optimizer.rescale_grad *= gradient_predivide_factor
+
+    # -- the hook -----------------------------------------------------------
+
+    def _do_allreduce(self, index, grad):
+        if isinstance(index, (tuple, list)):
+            # num_groups splits a multi-index update into that many
+            # atomic grouped allreduces (reference: num_groups batching)
+            groups = _split_groups(list(zip(index, grad)), self._num_groups)
+            for gi, bucket in enumerate(groups):
+                mpi_ops.grouped_allreduce_(
+                    [g for _, g in bucket], average=True,
+                    name=f"allreduce.group.{bucket[0][0]}",
+                    prescale_factor=1.0 / self._predivide,
+                    process_set=self._process_set,
+                )
+        else:
+            mpi_ops.allreduce_(
+                grad, average=True, name=f"allreduce.{index}",
+                prescale_factor=1.0 / self._predivide,
+                process_set=self._process_set,
+            )
+
+    def update(self, index, weight, grad, state):
+        self._do_allreduce(index, grad)
+        self._optimizer.update(index, weight, grad, state)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        self._do_allreduce(index, grad)
+        self._optimizer.update_multi_precision(index, weight, grad, state)
+
+    def create_state(self, index, weight):
+        return self._optimizer.create_state(index, weight)
+
+    def create_state_multi_precision(self, index, weight):
+        return self._optimizer.create_state_multi_precision(index, weight)
+
+    # everything else (learning_rate, wd, schedulers…) delegates.
+    # __dict__ lookup, not self._optimizer: __getattr__ fires for any
+    # missing attribute, and a plain read here would recurse when
+    # _optimizer itself is absent (e.g. during unpickling)
+    def __getattr__(self, item):
+        try:
+            return getattr(self.__dict__["_optimizer"], item)
+        except KeyError:
+            raise AttributeError(item)
+
+
+class DistributedTrainer(mx.gluon.Trainer):
+    """Gluon trainer whose kvstore sync point is a cross-rank allreduce
+    (reference: horovod/mxnet/__init__.py DistributedTrainer).
+
+    The reference folds the world size into the trainer's ``_scale`` and
+    SUM-allreduces at the ``_allreduce_grads`` hook; here the hook is an
+    AVERAGE allreduce (the engine supplies the correct 1/N for any
+    chips-per-process topology) and ``_scale`` only absorbs
+    ``gradient_predivide_factor``.
+    """
+
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 gradient_predivide_factor: float = 1.0,
+                 num_groups: int = 0,
+                 prefix: Optional[str] = None,
+                 process_set: Optional[ProcessSet] = None):
+        if isinstance(optimizer, DistributedOptimizer):
+            raise ValueError(
+                "pass the bare optimizer to DistributedTrainer; it applies "
+                "the distributed hook itself (reference raises here too)"
+            )
+        super().__init__(params, optimizer, optimizer_params, kvstore=None)
+        self._scale *= gradient_predivide_factor
+        self._hvd_predivide = float(gradient_predivide_factor)
+        self._hvd_num_groups = int(num_groups)
+        self._hvd_process_set = process_set
+        self._hvd_prefix = prefix or ""
+
+    def _allreduce_grads(self):
+        live = [(i, j, g) for i, p in enumerate(self._params)
+                if p.grad_req != "null"
+                for j, g in enumerate(p.list_grad())]
+        if not live:
+            return
+        if self._hvd_num_groups > 0:
+            for bucket in _split_groups(live, self._hvd_num_groups):
+                mpi_ops.grouped_allreduce_(
+                    [g for _, _, g in bucket], average=True,
+                    name=(f"{self._hvd_prefix}allreduce.group."
+                          f"{bucket[0][0]}.{bucket[0][1]}"),
+                    prescale_factor=1.0 / self._hvd_predivide,
+                    process_set=self._hvd_process_set,
+                )
+        else:
+            for i, j, grad in live:
+                mpi_ops.allreduce_(
+                    grad, average=True,
+                    name=f"{self._hvd_prefix}allreduce.{i}.{j}",
+                    prescale_factor=1.0 / self._hvd_predivide,
+                    priority=-i,
+                    process_set=self._hvd_process_set,
+                )

@@ -1,0 +1,80 @@
+"""State broadcast helpers for MXNet models.
+
+Reference parity: horovod/mxnet/__init__.py broadcast_parameters (the
+reference keeps it beside the trainer; split out here to mirror the
+torch adapter's layout) — SURVEY.md §2.3 MXNet binding row.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import mxnet as mx
+
+from ..common.process_sets import ProcessSet
+from . import mpi_ops
+
+
+def _deferred_init_error():
+    """mx.gluon.parameter.DeferredInitializationError, reached via
+    getattr so both real mxnet and the test fake resolve it."""
+    param_ns = getattr(getattr(mx, "gluon", None), "parameter", None)
+    return getattr(param_ns, "DeferredInitializationError", ())
+
+
+def _hook_deferred_broadcast(p, root_rank: int, name: str,
+                             process_set: Optional[ProcessSet]) -> None:
+    """Broadcast a deferred-init gluon parameter as soon as its shape is
+    resolved (reference: _append_broadcast_init wrapping _init_impl)."""
+    orig_init_impl = p._init_impl
+
+    def wrapped(*args, **kwargs):
+        orig_init_impl(*args, **kwargs)
+        for i, d in enumerate(p.list_data()):
+            mpi_ops.broadcast_(d, root_rank,
+                               name=f"parameter.{name}.{i}",
+                               process_set=process_set)
+
+    p._init_impl = wrapped
+
+
+def broadcast_parameters(params, root_rank: int = 0,
+                         prefix: Optional[str] = None,
+                         process_set: Optional[ProcessSet] = None) -> None:
+    """Broadcast parameters from ``root_rank`` in place.
+
+    Accepts either a plain ``dict`` of name → NDArray (e.g. a module's
+    ``get_params()`` arg/aux dicts) or a gluon parameter collection
+    (name → ``gluon.Parameter``), matching the reference's two accepted
+    shapes.  Gluon parameters whose shape is still unresolved
+    (``DeferredInitializationError``) are broadcast lazily right after
+    their deferred initialization runs, like the reference.
+    """
+    prefix = prefix or ""
+    if params is None:
+        return
+    if not hasattr(params, "items"):
+        raise ValueError(
+            "broadcast_parameters expects a dict of name->NDArray or a "
+            "gluon parameter collection"
+        )
+    tensors = []
+    deferred_t = _deferred_init_error()
+    for name, p in sorted(params.items(), key=lambda kv: kv[0]):
+        if hasattr(p, "list_data"):  # gluon.Parameter
+            try:
+                data = p.list_data()
+            except Exception as exc:
+                if deferred_t and isinstance(exc, deferred_t):
+                    _hook_deferred_broadcast(p, root_rank,
+                                             f"{prefix}{name}", process_set)
+                    continue
+                raise
+            tensors.extend((f"{prefix}{name}.{i}", d)
+                           for i, d in enumerate(data))
+        else:  # bare NDArray
+            tensors.append((f"{prefix}{name}", p))
+    for name, tensor in tensors:
+        mpi_ops.broadcast_(tensor, root_rank,
+                           name=f"parameter.{name}",
+                           process_set=process_set)

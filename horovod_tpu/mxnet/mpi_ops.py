@@ -1,0 +1,178 @@
+"""MXNet NDArray collectives over the XLA engine.
+
+Reference parity: horovod/mxnet/mpi_ops.py + the C++ binding it fronts
+(mxnet/mpi_ops.cc, adapter.cc, tensor_util.cc — SURVEY.md §2.3).  The
+reference wraps ``mxnet.nd.NDArray`` into ``common::Tensor`` and pushes
+the collective onto MXNet's dependency engine so it completes
+asynchronously behind engine reads; here the NDArray round-trips through
+numpy (``asnumpy()`` / ``t[:] = out``) into the same eager engine every
+other adapter uses, and ops complete before returning.  The reference's
+``priority`` argument orders work on the MXNet engine; our engine
+negotiates readiness cross-rank instead, so ``priority`` is accepted for
+signature parity and ignored (documented divergence).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import mxnet as mx
+
+from ..common.process_sets import ProcessSet
+from ..ops import collective_ops as _ops
+from ..ops.reduce_ops import ReduceOp
+
+
+def _to_np(tensor) -> np.ndarray:
+    if not isinstance(tensor, mx.nd.NDArray):
+        raise ValueError(
+            f"horovod_tpu.mxnet ops take mxnet.nd.NDArray, got "
+            f"{type(tensor).__name__}"
+        )
+    return tensor.asnumpy()
+
+
+def _from_np(a, like) -> "mx.nd.NDArray":
+    return mx.nd.array(np.asarray(a), ctx=like.context, dtype=like.dtype)
+
+
+def _write_back(tensor, a) -> None:
+    tensor[:] = np.asarray(a, dtype=tensor.dtype).reshape(tensor.shape)
+
+
+# -- allreduce ---------------------------------------------------------------
+
+
+def allreduce(tensor, average: Optional[bool] = None,
+              name: Optional[str] = None, priority: int = 0,
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+              op: Optional[ReduceOp] = None,
+              process_set: Optional[ProcessSet] = None):
+    """Reference: horovod/mxnet/mpi_ops.py allreduce — returns a new
+    averaged NDArray."""
+    out = _ops.allreduce(
+        _to_np(tensor), average=average, name=name, op=op,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        process_set=process_set,
+    )
+    return _from_np(out, tensor)
+
+
+def allreduce_(tensor, average: Optional[bool] = None,
+               name: Optional[str] = None, priority: int = 0,
+               prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+               op: Optional[ReduceOp] = None,
+               process_set: Optional[ProcessSet] = None):
+    """In-place allreduce (reference: allreduce_)."""
+    out = _ops.allreduce(
+        _to_np(tensor), average=average, name=name, op=op,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        process_set=process_set,
+    )
+    _write_back(tensor, out)
+    return tensor
+
+
+def grouped_allreduce(tensors: Sequence, average: Optional[bool] = None,
+                      name: Optional[str] = None, priority: int = 0,
+                      prescale_factor: float = 1.0,
+                      postscale_factor: float = 1.0,
+                      op: Optional[ReduceOp] = None,
+                      process_set: Optional[ProcessSet] = None) -> List:
+    outs = _ops.grouped_allreduce(
+        [_to_np(t) for t in tensors], average=average, name=name, op=op,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        process_set=process_set,
+    )
+    return [_from_np(o, t) for o, t in zip(outs, tensors)]
+
+
+def grouped_allreduce_(tensors: Sequence, average: Optional[bool] = None,
+                       name: Optional[str] = None, priority: int = 0,
+                       prescale_factor: float = 1.0,
+                       postscale_factor: float = 1.0,
+                       op: Optional[ReduceOp] = None,
+                       process_set: Optional[ProcessSet] = None) -> List:
+    outs = _ops.grouped_allreduce(
+        [_to_np(t) for t in tensors], average=average, name=name, op=op,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        process_set=process_set,
+    )
+    for t, o in zip(tensors, outs):
+        _write_back(t, o)
+    return list(tensors)
+
+
+# -- allgather ---------------------------------------------------------------
+
+
+def allgather(tensor, name: Optional[str] = None, priority: int = 0,
+              process_set: Optional[ProcessSet] = None):
+    """Reference: horovod/mxnet/mpi_ops.py allgather — concatenates along
+    dim 0 (ranks may differ in dim 0)."""
+    out = _ops.allgather(_to_np(tensor), name=name, process_set=process_set)
+    return _from_np(out, tensor)
+
+
+# -- broadcast ---------------------------------------------------------------
+
+
+def broadcast(tensor, root_rank: int, name: Optional[str] = None,
+              priority: int = 0,
+              process_set: Optional[ProcessSet] = None):
+    out = _ops.broadcast(_to_np(tensor), root_rank, name=name,
+                         process_set=process_set)
+    return _from_np(out, tensor)
+
+
+def broadcast_(tensor, root_rank: int, name: Optional[str] = None,
+               priority: int = 0,
+               process_set: Optional[ProcessSet] = None):
+    out = _ops.broadcast(_to_np(tensor), root_rank, name=name,
+                         process_set=process_set)
+    _write_back(tensor, out)
+    return tensor
+
+
+# -- alltoall / reducescatter ------------------------------------------------
+
+
+def alltoall(tensor, splits=None, name: Optional[str] = None,
+             priority: int = 0,
+             process_set: Optional[ProcessSet] = None) -> Tuple:
+    """Reference: horovod/mxnet/mpi_ops.py alltoall — returns
+    (received, received_splits)."""
+    np_splits = None if splits is None else _to_np(splits)
+    received, recv_splits = _ops.alltoall(
+        _to_np(tensor), splits=np_splits, name=name, process_set=process_set
+    )
+    return (_from_np(received, tensor),
+            mx.nd.array(np.asarray(recv_splits), dtype="int32"))
+
+
+def reducescatter(tensor, op: Optional[ReduceOp] = None,
+                  name: Optional[str] = None, priority: int = 0,
+                  process_set: Optional[ProcessSet] = None):
+    out = _ops.reducescatter(_to_np(tensor), op=op, name=name,
+                             process_set=process_set)
+    return _from_np(out, tensor)
+
+
+def grouped_reducescatter(tensors: Sequence, op: Optional[ReduceOp] = None,
+                          name: Optional[str] = None, priority: int = 0,
+                          process_set: Optional[ProcessSet] = None) -> List:
+    outs = _ops.grouped_reducescatter(
+        [_to_np(t) for t in tensors], op=op, name=name,
+        process_set=process_set,
+    )
+    return [_from_np(o, t) for o, t in zip(outs, tensors)]
+
+
+def barrier(process_set: Optional[ProcessSet] = None) -> None:
+    _ops.barrier(process_set=process_set)
+
+
+def join() -> int:
+    return _ops.join()

@@ -462,10 +462,12 @@ def reducescatter(
 
 def reducescatter_async(
     tensor: Any,
-    op: ReduceOp = Sum,
+    op: Optional[ReduceOp] = Sum,
     name: Optional[str] = None,
     process_set: Optional[ProcessSet] = None,
 ) -> Handle:
+    # adapters (torch/tf/mxnet) pass their own op=None default through
+    op = Sum if op is None else op
     if _native(tensor) is not None:
         from ..native.controller import OP_REDUCESCATTER
 
@@ -503,10 +505,11 @@ def grouped_reducescatter(
 
 def grouped_reducescatter_async(
     tensors: Sequence[Any],
-    op: ReduceOp = Sum,
+    op: Optional[ReduceOp] = Sum,
     name: Optional[str] = None,
     process_set: Optional[ProcessSet] = None,
 ) -> Handle:
+    op = Sum if op is None else op
     if not tensors:
         # before register_group: a size-0 group would enqueue no entries
         # and its GroupTable entry would never be forgotten

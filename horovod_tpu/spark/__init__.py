@@ -12,8 +12,10 @@ no pyspark, so the integration is scoped to:
   * Estimators: :mod:`horovod_tpu.spark.keras` (``KerasEstimator`` — a
     real Keras 3 estimator trained through the Keras adapter;
     ``FlaxEstimator`` for flax modules) and
-    :mod:`horovod_tpu.spark.torch` (``TorchEstimator``) implement the
-    reference's fit(df) -> Transformer contract over a
+    :mod:`horovod_tpu.spark.torch` (``TorchEstimator``) and
+    :mod:`horovod_tpu.spark.lightning` (``TorchEstimator`` /
+    ``LightningEstimator`` over the LightningModule protocol) implement
+    the reference's fit(df) -> Transformer contract over a
     :mod:`~horovod_tpu.spark.store` Store, training across launcher-
     managed subprocess workers (the Spark-barrier transport being
     pyspark-gated in this image).

@@ -168,6 +168,34 @@ def _train_flax(spec, store, rank):
         _write_history(store, spec, history)
 
 
+def _torch_tensors(feats, labels):
+    """Shared torch input coercion: float32 features, integer labels
+    kept integral (cross_entropy) else float32."""
+    import torch
+
+    tf = [torch.as_tensor(np.asarray(f, np.float32)) for f in feats]
+    y = labels[0]
+    ty = torch.as_tensor(
+        y if np.issubdtype(y.dtype, np.integer)
+        else np.asarray(y, np.float32)
+    )
+    return tf, ty
+
+
+def _save_torch_checkpoint(store, spec, model, history):
+    """Rank-0 tail shared by the torch and lightning trainers."""
+    import torch
+
+    bio = io.BytesIO()
+    torch.save(model.state_dict(), bio)
+    store.write_bytes(
+        os.path.join(store.get_checkpoint_path(spec["run_id"]),
+                     "model.bin"),
+        bio.getvalue(),
+    )
+    _write_history(store, spec, history)
+
+
 def _train_torch(spec, store, rank):
     import torch
 
@@ -199,16 +227,6 @@ def _train_torch(spec, store, rank):
     )
     reader, manifest = _shard_reader(store, spec, rank)
     usable = manifest["usable_rows"]
-
-    def to_tensors(feats, labels):
-        tf = [torch.as_tensor(np.asarray(f, np.float32)) for f in feats]
-        y = labels[0]
-        ty = torch.as_tensor(
-            y if np.issubdtype(y.dtype, np.integer)
-            else np.asarray(y, np.float32)
-        )
-        return tf, ty
-
     val = (_load_val(store, spec, manifest)
            if hvd_torch.cross_rank() == 0 else None)
     history = {"loss": [], "val_loss": []}
@@ -216,7 +234,7 @@ def _train_torch(spec, store, rank):
         epoch_rng = np.random.RandomState(spec["seed"] + 1 + epoch)
         loss = None
         for feats, labels in _batches(reader, spec, epoch_rng, usable):
-            tf, ty = to_tensors(feats, labels)
+            tf, ty = _torch_tensors(feats, labels)
             optimizer.zero_grad()
             loss = loss_fn(model(*tf), ty)
             loss.backward()
@@ -226,7 +244,7 @@ def _train_torch(spec, store, rank):
                 float(loss) if loss is not None else None
             )
             if val is not None:
-                tf, ty = to_tensors(
+                tf, ty = _torch_tensors(
                     [val[c] for c in spec["feature_cols"]],
                     [val[c] for c in spec["label_cols"]],
                 )
@@ -236,14 +254,101 @@ def _train_torch(spec, store, rank):
                     )
 
     if hvd_torch.cross_rank() == 0:
-        bio = io.BytesIO()
-        torch.save(model.state_dict(), bio)
-        store.write_bytes(
-            os.path.join(store.get_checkpoint_path(spec["run_id"]),
-                         "model.bin"),
-            bio.getvalue(),
-        )
-        _write_history(store, spec, history)
+        _save_torch_checkpoint(store, spec, model, history)
+
+
+def _resolve_lightning_optimizer(configured):
+    """Normalize configure_optimizers()'s documented return shapes to
+    (optimizer, scheduler_or_None): a bare optimizer, a dict with
+    'optimizer' (+ optional 'lr_scheduler'), a list of such dicts, or
+    the two-list ([optimizers], [schedulers]) form (first of each; the
+    reference's single-optimizer constraint)."""
+    if isinstance(configured, dict):
+        sched = configured.get("lr_scheduler")
+        if isinstance(sched, dict):  # {"scheduler": ..., "interval": ...}
+            sched = sched.get("scheduler")
+        return configured["optimizer"], sched
+    if isinstance(configured, (tuple, list)):
+        if configured and isinstance(configured[0], dict):
+            return _resolve_lightning_optimizer(configured[0])
+        opts, scheds = (list(configured) + [[]])[:2]
+        opt = opts[0] if isinstance(opts, (tuple, list)) else opts
+        sched = (scheds[0] if isinstance(scheds, (tuple, list)) and scheds
+                 else None)
+        return opt, sched
+    return configured, None
+
+
+def _train_lightning(spec, store, rank):
+    """Drive the LightningModule protocol (reference:
+    horovod/spark/lightning/estimator.py's trainer loop): the module
+    owns optimizer + loss; batches are (features..., label) tuples."""
+    import torch
+
+    import horovod_tpu.torch as hvd_torch
+
+    model = spec["model"]
+    hvd_torch.init()
+    hvd_torch.broadcast_parameters(model.state_dict(), root_rank=0)
+    optimizer, scheduler = _resolve_lightning_optimizer(
+        model.configure_optimizers()
+    )
+    optimizer = hvd_torch.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters()
+    )
+    reader, manifest = _shard_reader(store, spec, rank)
+    usable = manifest["usable_rows"]
+
+    def to_batch(feats, labels):
+        tf, ty = _torch_tensors(feats, labels)
+        return tuple(tf) + (ty,)
+
+    def step_loss(out):
+        if isinstance(out, dict):
+            out = out["loss"]
+        return out
+
+    val = (_load_val(store, spec, manifest)
+           if hvd_torch.cross_rank() == 0 else None)
+    history = {"loss": [], "val_loss": []}
+    model.train()
+    for epoch in range(spec["epochs"]):
+        epoch_rng = np.random.RandomState(spec["seed"] + 1 + epoch)
+        loss = None
+        for bi, (feats, labels) in enumerate(
+            _batches(reader, spec, epoch_rng, usable)
+        ):
+            optimizer.zero_grad()
+            loss = step_loss(model.training_step(to_batch(feats, labels),
+                                                 bi))
+            loss.backward()
+            optimizer.step()
+        if scheduler is not None:
+            scheduler.step()
+        if hasattr(model, "on_train_epoch_end"):
+            model.on_train_epoch_end()
+        if hvd_torch.cross_rank() == 0:
+            history["loss"].append(
+                float(loss) if loss is not None else None
+            )
+            if val is not None:
+                vbatch = to_batch(
+                    [val[c] for c in spec["feature_cols"]],
+                    [val[c] for c in spec["label_cols"]],
+                )
+                model.eval()
+                with torch.no_grad():
+                    vstep = (model.validation_step(vbatch, 0)
+                             if hasattr(model, "validation_step")
+                             else model.training_step(vbatch, 0))
+                    vloss = (vstep.get("val_loss", vstep.get("loss"))
+                             if isinstance(vstep, dict) else vstep)
+                    if vloss is not None:
+                        history["val_loss"].append(float(vloss))
+                model.train()
+
+    if hvd_torch.cross_rank() == 0:
+        _save_torch_checkpoint(store, spec, model, history)
 
 
 def _train_keras(spec, store, rank):
@@ -364,6 +469,8 @@ def main() -> int:
         _train_torch(spec, store, rank)
     elif spec["kind"] == "keras":
         _train_keras(spec, store, rank)
+    elif spec["kind"] == "lightning":
+        _train_lightning(spec, store, rank)
     else:
         raise ValueError(f"unknown estimator kind {spec['kind']!r}")
     hvd.barrier()  # rank 0's checkpoint write completes before exit

@@ -1,0 +1,59 @@
+"""Reference-name surface: ``horovod.spark.lightning`` (SURVEY.md §2.4).
+
+The reference's lightning estimator (spark/lightning/estimator.py,
+also exported as ``TorchEstimator``) takes a ``LightningModule`` —
+optimizer and loss live INSIDE the module (``configure_optimizers()``
+/ ``training_step()``) instead of travelling as estimator params — and
+returns the same fit(df)→Transformer contract over a Store.
+
+TPU-native mapping: the worker drives the LightningModule protocol
+duck-typed (configure_optimizers → wrapped in the torch adapter's
+DistributedOptimizer; training_step per batch; optional
+validation_step / on_train_epoch_end hooks), so any object implementing
+the protocol trains — pytorch-lightning itself is not importable in
+this image (documented), and the estimator is contract-tested against a
+faked ``pytorch_lightning`` module whose ``LightningModule`` is a thin
+``torch.nn.Module`` (tests/_fake_modules/pytorch_lightning), the same
+technique as the pyspark/ray/mxnet surfaces.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from .estimator import TorchModel, _EstimatorBase
+
+
+class TorchEstimator(_EstimatorBase):
+    """Reference: horovod/spark/lightning/estimator.py TorchEstimator —
+    fit a ``LightningModule`` data-parallel over the Store.
+
+    The module must be picklable (defined at module level) and implement
+    ``configure_optimizers()`` and ``training_step(batch, batch_idx)``;
+    ``validation_step`` and ``on_train_epoch_end`` are honored when
+    present.  Batches arrive as ``(features..., label)`` tuples, the
+    shape a ``TensorDataset``-backed DataLoader would yield.
+    """
+
+    def fit(self, df: Any) -> "LightningModel":
+        info = self._fit(df, kind="lightning")
+        state_bytes = self.store.read_bytes(info["checkpoint"])
+        model = LightningModel(
+            self.model, state_bytes, self.feature_cols, self.label_cols,
+            run_id=info["run_id"],
+        )
+        model.history = self._history(info["run_id"])
+        return model
+
+
+#: the reference exports the lightning estimator under both names
+LightningEstimator = TorchEstimator
+
+
+class LightningModel(TorchModel):
+    """Transformer for a fit LightningModule (reference:
+    spark/lightning TorchModel) — identical load/transform semantics to
+    the plain torch transformer; a LightningModule IS a nn.Module."""
+
+
+__all__ = ["TorchEstimator", "LightningEstimator", "LightningModel"]

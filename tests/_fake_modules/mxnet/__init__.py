@@ -1,0 +1,247 @@
+"""Minimal fake `mxnet` for contract-testing horovod_tpu.mxnet.
+
+Real mxnet is not installable in this image (archived upstream; no
+wheel for this python).  This fake implements just enough of the
+NDArray / gluon.Trainer / optimizer.Optimizer surface for the adapter's
+real code paths to execute: NDArray wraps a numpy array with
+``asnumpy()`` and in-place ``t[:] = ...`` assignment (the two bridge
+primitives), gluon exposes Parameter/Trainer with the ``_allreduce_grads``
+hook the DistributedTrainer overrides, and optimizer.Optimizer is the
+delegation base DistributedOptimizer wraps.
+"""
+
+import numpy as np
+
+
+class Context:
+    def __init__(self, device_type="cpu", device_id=0):
+        self.device_type = device_type
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"{self.device_type}({self.device_id})"
+
+    def __eq__(self, other):
+        return (isinstance(other, Context)
+                and other.device_type == self.device_type
+                and other.device_id == self.device_id)
+
+
+def cpu(device_id=0):
+    return Context("cpu", device_id)
+
+
+def gpu(device_id=0):
+    return Context("gpu", device_id)
+
+
+current_context = cpu
+
+
+class _NDArrayModule:
+    """Stands in for the `mxnet.nd` / `mxnet.ndarray` namespace."""
+
+    class NDArray:
+        def __init__(self, data, ctx=None):
+            self._data = np.asarray(data)
+            self.context = ctx or cpu()
+
+        # -- the two primitives the horovod_tpu bridge relies on --------
+        def asnumpy(self):
+            return self._data.copy()
+
+        def __setitem__(self, key, value):
+            if isinstance(value, _NDArrayModule.NDArray):
+                value = value._data
+            self._data[key] = np.asarray(value, dtype=self._data.dtype)
+
+        # -- conveniences used by tests / the fake trainer ---------------
+        def __getitem__(self, key):
+            return _NDArrayModule.NDArray(self._data[key], self.context)
+
+        @property
+        def shape(self):
+            return self._data.shape
+
+        @property
+        def dtype(self):
+            return self._data.dtype
+
+        @property
+        def size(self):
+            return self._data.size
+
+        @property
+        def ctx(self):
+            return self.context
+
+        def copy(self):
+            return _NDArrayModule.NDArray(self._data.copy(), self.context)
+
+        def astype(self, dtype):
+            return _NDArrayModule.NDArray(self._data.astype(dtype),
+                                          self.context)
+
+        def __repr__(self):
+            return f"FakeNDArray({self._data!r})"
+
+    def array(self, obj, ctx=None, dtype=None):
+        a = np.asarray(obj, dtype=dtype)
+        return self.NDArray(a, ctx)
+
+    def zeros(self, shape, ctx=None, dtype="float32"):
+        return self.NDArray(np.zeros(shape, dtype=dtype), ctx)
+
+    def ones(self, shape, ctx=None, dtype="float32"):
+        return self.NDArray(np.ones(shape, dtype=dtype), ctx)
+
+
+nd = _NDArrayModule()
+ndarray = nd
+NDArray = nd.NDArray
+
+
+class _OptimizerModule:
+    class Optimizer:
+        def __init__(self, learning_rate=0.01, rescale_grad=1.0, **kwargs):
+            self.learning_rate = learning_rate
+            self.rescale_grad = rescale_grad
+
+        def update(self, index, weight, grad, state):
+            raise NotImplementedError
+
+        def update_multi_precision(self, index, weight, grad, state):
+            self.update(index, weight, grad, state)
+
+        def create_state(self, index, weight):
+            return None
+
+        def create_state_multi_precision(self, index, weight):
+            return self.create_state(index, weight)
+
+    class SGD(Optimizer):
+        def update(self, index, weight, grad, state):
+            weight[:] = (weight.asnumpy()
+                         - self.learning_rate
+                         * self.rescale_grad * grad.asnumpy())
+
+    @staticmethod
+    def create(name, **kwargs):
+        if isinstance(name, _OptimizerModule.Optimizer):
+            return name
+        table = {"sgd": _OptimizerModule.SGD}
+        return table[str(name).lower()](**kwargs)
+
+
+optimizer = _OptimizerModule()
+
+
+class DeferredInitializationError(Exception):
+    """mx.gluon.parameter.DeferredInitializationError: parameter shape
+    unknown until the first forward pass."""
+
+
+class _GluonParameterNamespace:
+    DeferredInitializationError = DeferredInitializationError
+
+
+class _GluonModule:
+    parameter = _GluonParameterNamespace()
+
+    class Parameter:
+        def __init__(self, name, shape=None, grad_req="write",
+                     dtype="float32"):
+            self.name = name
+            self.grad_req = grad_req
+            self.dtype = dtype
+            self._deferred = shape is None or 0 in tuple(shape)
+            if self._deferred:
+                self._data, self._grad = None, None
+            else:
+                self._data = [nd.zeros(shape, dtype=dtype)]
+                self._grad = ([nd.zeros(shape, dtype=dtype)]
+                              if grad_req != "null" else [])
+
+        def _init_impl(self, data, ctx_list=None):
+            """Shape-resolved initialization (what mxnet calls after the
+            first forward infers the shape)."""
+            self._data = [nd.array(np.asarray(data), dtype=self.dtype)]
+            self._grad = ([nd.zeros(self._data[0].shape, dtype=self.dtype)]
+                          if self.grad_req != "null" else [])
+            self._deferred = False
+
+        def _check_init(self):
+            if self._deferred:
+                raise DeferredInitializationError(
+                    f"Parameter {self.name} has not been initialized yet"
+                )
+
+        def data(self, ctx=None):
+            self._check_init()
+            return self._data[0]
+
+        def grad(self, ctx=None):
+            self._check_init()
+            return self._grad[0]
+
+        def list_data(self):
+            self._check_init()
+            return list(self._data)
+
+        def list_grad(self):
+            self._check_init()
+            return list(self._grad)
+
+        def zero_grad(self):
+            for g in self._grad or []:
+                g[:] = 0
+
+    class Trainer:
+        """Subset of mx.gluon.Trainer: ordered `_params`, an
+        `_allreduce_grads` hook between backward and update, and a
+        `_scale` folded into the effective gradient."""
+
+        def __init__(self, params, optimizer_, optimizer_params=None,
+                     kvstore="device"):
+            if hasattr(params, "values"):
+                params = list(params.values())
+            self._params = list(params)
+            opt_params = dict(optimizer_params or {})
+            self._optimizer = _OptimizerModule.create(optimizer_,
+                                                      **opt_params)
+            self._scale = self._optimizer.rescale_grad
+            self._kvstore = kvstore
+            self._states = [
+                self._optimizer.create_state(i, p.data())
+                for i, p in enumerate(self._params)
+            ]
+
+        @property
+        def learning_rate(self):
+            return self._optimizer.learning_rate
+
+        def step(self, batch_size, ignore_stale_grad=False):
+            self._optimizer.rescale_grad = self._scale / batch_size
+            self._allreduce_grads()
+            self._update()
+
+        def allreduce_grads(self):
+            self._allreduce_grads()
+
+        def _allreduce_grads(self):
+            pass  # kvstore sync point; overridden by DistributedTrainer
+
+        def update(self, batch_size, ignore_stale_grad=False):
+            self._optimizer.rescale_grad = self._scale / batch_size
+            self._update()
+
+        def _update(self):
+            for i, p in enumerate(self._params):
+                if p.grad_req != "null":
+                    self._optimizer.update(i, p.data(), p.grad(),
+                                           self._states[i])
+
+
+gluon = _GluonModule()
+
+__version__ = "1.9.1-fake"

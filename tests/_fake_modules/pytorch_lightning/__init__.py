@@ -1,0 +1,28 @@
+"""Minimal fake `pytorch_lightning` for contract-testing the lightning
+estimator.
+
+lightning is not installable in this image; the estimator worker drives
+the LightningModule protocol duck-typed, so all the fake must provide
+is the base class users subclass: a ``torch.nn.Module`` with the
+protocol hooks and a no-op ``log``.  Real torch (installed) supplies
+autograd.
+"""
+
+import torch
+
+
+class LightningModule(torch.nn.Module):
+    def log(self, name, value, **kwargs):
+        pass
+
+    def log_dict(self, metrics, **kwargs):
+        pass
+
+    def configure_optimizers(self):
+        raise NotImplementedError
+
+    def training_step(self, batch, batch_idx):
+        raise NotImplementedError
+
+
+__version__ = "2.4.0-fake"

@@ -1,0 +1,46 @@
+"""Module-level LightningModule for the lightning-estimator contract
+tests (pickled into worker subprocesses, so it must be importable there
+— workers get tests/_fake_modules on PYTHONPATH from the test).
+
+Kept separate from estimator_models.py: importing this module requires
+`pytorch_lightning` (the fake) on sys.path.
+"""
+
+import pytorch_lightning as pl
+import torch
+
+
+class LitRegression(pl.LightningModule):
+    """y = w·x regression; loss and optimizer live inside the module,
+    per the lightning contract."""
+
+    def __init__(self):
+        super().__init__()
+        self.fc = torch.nn.Linear(4, 1)
+        self.epoch_end_calls = 0
+
+    def forward(self, x):
+        return self.fc(x).squeeze(-1)
+
+    def training_step(self, batch, batch_idx):
+        x, y = batch
+        loss = torch.nn.functional.mse_loss(self(x), y)
+        self.log("train_loss", loss)
+        return loss
+
+    def validation_step(self, batch, batch_idx):
+        x, y = batch
+        return {"val_loss": torch.nn.functional.mse_loss(self(x), y)}
+
+    def on_train_epoch_end(self):
+        self.epoch_end_calls += 1
+
+    def configure_optimizers(self):
+        return torch.optim.SGD(self.parameters(), lr=0.05)
+
+
+class LitDictOptimizer(LitRegression):
+    """configure_optimizers returning the dict shape."""
+
+    def configure_optimizers(self):
+        return {"optimizer": torch.optim.SGD(self.parameters(), lr=0.05)}

@@ -153,6 +153,10 @@ def main():
     np.testing.assert_allclose(
         np.asarray(chunk), nproc * np.arange(me * 3, me * 3 + 3)
     )
+    # op=None is what the torch/tf/mxnet adapters pass by default; it
+    # must normalize to Sum on the native path (int(op) crash regression)
+    chunk_none = hvd.reducescatter(full, op=None, name="rs_none_op")
+    np.testing.assert_allclose(np.asarray(chunk_none), np.asarray(chunk))
 
     # grouped reducescatter: atomic group release, per-entry chunks
     ra, rb = hvd.grouped_reducescatter(

@@ -1,0 +1,90 @@
+"""Per-rank MXNet-adapter worker for launcher integration tests.
+
+Reference analog: test/parallel/test_mxnet.py under ``horovodrun -np 2``
+(SURVEY.md §4).  Real mxnet is not installable in this image, so the
+faked mxnet (tests/_fake_modules) provides NDArray storage while every
+collective below crosses real process boundaries through the native
+controller — the same split the single-process contract tests use.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir, "_fake_modules"))
+
+import numpy as np  # noqa: E402
+
+import mxnet as mx  # noqa: E402  (the fake)
+
+import horovod_tpu.mxnet as hvd  # noqa: E402
+
+
+def main():
+    hvd.init()
+    nproc = hvd.cross_size()
+    assert nproc == int(sys.argv[1]), (nproc, sys.argv)
+    me = hvd.cross_rank()
+
+    # average across ranks
+    out = hvd.allreduce(mx.nd.array(np.array([float(me)], dtype=np.float32)))
+    np.testing.assert_allclose(out.asnumpy(), [np.mean(np.arange(nproc))])
+
+    # in-place sum
+    t = mx.nd.array(np.ones(3, dtype=np.float32) * (me + 1))
+    hvd.allreduce_(t, op=hvd.Sum, name="mx_sum")
+    np.testing.assert_allclose(
+        t.asnumpy(), np.full(3, nproc * (nproc + 1) / 2))
+
+    # grouped in-place average
+    a = mx.nd.array(np.full(2, float(me), dtype=np.float32))
+    b = mx.nd.array(np.full(4, float(2 * me), dtype=np.float32))
+    hvd.grouped_allreduce_([a, b], name="mx_grouped")
+    np.testing.assert_allclose(a.asnumpy(),
+                               np.full(2, np.mean(np.arange(nproc))))
+    np.testing.assert_allclose(b.asnumpy(),
+                               np.full(4, 2 * np.mean(np.arange(nproc))))
+
+    # broadcast: non-root values overwritten in place
+    w = mx.nd.array(np.full(3, float(me + 7), dtype=np.float32))
+    hvd.broadcast_(w, root_rank=0, name="mx_bcast")
+    np.testing.assert_allclose(w.asnumpy(), np.full(3, 7.0))
+
+    # reducescatter with the adapter's default op=None (must normalize
+    # to Sum on the native path — int(op) crash regression)
+    full = mx.nd.array(np.arange(nproc * 2, dtype=np.float32))
+    chunk = hvd.reducescatter(full, name="mx_rs")
+    np.testing.assert_allclose(
+        chunk.asnumpy(), nproc * np.arange(me * 2, me * 2 + 2))
+
+    # broadcast_parameters over a gluon collection with divergent values
+    p = mx.gluon.Parameter("w0", shape=(2,))
+    p.data()[:] = np.full(2, float(me + 1))
+    hvd.broadcast_parameters({"w0": p}, root_rank=0)
+    np.testing.assert_allclose(p.data().asnumpy(), np.full(2, 1.0))
+
+    # DistributedTrainer: divergent grads -> averaged update
+    p.grad()[:] = np.full(2, float(me))  # avg grad = mean(0..n-1)
+    trainer = hvd.DistributedTrainer({"w0": p}, "sgd",
+                                     {"learning_rate": 1.0})
+    trainer.step(batch_size=1)
+    expect = 1.0 - np.mean(np.arange(nproc))
+    np.testing.assert_allclose(p.data().asnumpy(), np.full(2, expect),
+                               rtol=1e-6)
+
+    # DistributedOptimizer: same math through the update() hook
+    sgd = mx.optimizer.SGD(learning_rate=1.0)
+    opt = hvd.DistributedOptimizer(sgd)
+    w2 = mx.nd.array(np.zeros(2, dtype=np.float32))
+    g2 = mx.nd.array(np.full(2, float(me), dtype=np.float32))
+    opt.update(0, w2, g2, None)
+    np.testing.assert_allclose(w2.asnumpy(),
+                               np.full(2, -np.mean(np.arange(nproc))),
+                               rtol=1e-6)
+
+    print(f"MXNET_WORKER_OK rank={me} nproc={nproc} "
+          f"native={hvd.native_built()}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

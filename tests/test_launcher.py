@@ -162,6 +162,19 @@ def test_tpurun_pytorch_synthetic_example():
 
 
 @pytest.mark.integration
+def test_tpurun_mxnet_adapter():
+    """MXNet adapter under 2 real processes (faked-mxnet NDArray storage,
+    real cross-process collectives): in-place/grouped ops, default-op
+    reducescatter, broadcast_parameters, DistributedTrainer/Optimizer
+    averaging (reference analog: test/parallel/test_mxnet.py)."""
+    worker = os.path.join(REPO, "tests", "integration", "mxnet_worker.py")
+    res = _run_tpurun(2, timeout=420, target=worker, target_args=["2"])
+    assert res.returncode == 0, \
+        f"stdout:\n{res.stdout[-2000:]}\nstderr:\n{res.stderr[-3000:]}"
+    assert res.stdout.count("MXNET_WORKER_OK") == 2
+
+
+@pytest.mark.integration
 def test_tpurun_torch_adapter():
     """Torch adapter under 2 real processes: grouped ops, uneven
     alltoall, SyncBatchNorm global stats + gradient flow (reference

@@ -1,0 +1,232 @@
+"""Contract tests for the MXNet adapter with a faked mxnet module.
+
+Reference analog: test/parallel/test_mxnet.py (SURVEY.md §4).  Real
+mxnet is not installable in this image (archived upstream), so — like
+the pyspark/ray launch paths (VERDICT r3 item 5 technique) — these
+tests inject a minimal fake `mxnet` (tests/_fake_modules/mxnet) and run
+the REAL adapter bodies: the NDArray→numpy bridge, in-place writeback,
+DistributedOptimizer's update hook, DistributedTrainer's
+_allreduce_grads override, and broadcast_parameters.  Only NDArray
+storage is faked; every collective goes through the shared eager
+engine (single-process world: identity).
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+FAKES = os.path.join(os.path.dirname(__file__), "_fake_modules")
+
+
+def _purge():
+    for name in list(sys.modules):
+        if name == "mxnet" or name.startswith("mxnet.") \
+                or name == "horovod_tpu.mxnet" \
+                or name.startswith("horovod_tpu.mxnet."):
+            del sys.modules[name]
+
+
+@pytest.fixture
+def hvd_mx(monkeypatch):
+    monkeypatch.syspath_prepend(FAKES)
+    _purge()
+    import mxnet as mx
+    import horovod_tpu.mxnet as hvd
+
+    yield mx, hvd
+    _purge()
+
+
+def test_allreduce_roundtrip_and_dtype(hvd_mx):
+    mx, hvd = hvd_mx
+    t = mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    out = hvd.allreduce(t)
+    assert isinstance(out, mx.nd.NDArray)
+    assert out.dtype == np.float32 and out.shape == (2, 3)
+    np.testing.assert_allclose(out.asnumpy(), t.asnumpy())
+
+
+def test_allreduce_inplace_writes_back(hvd_mx):
+    mx, hvd = hvd_mx
+    t = mx.nd.array(np.ones(4, dtype=np.float32))
+    ret = hvd.allreduce_(t, op=hvd.Sum)
+    assert ret is t
+    np.testing.assert_allclose(t.asnumpy(), np.ones(4))
+
+
+def test_allreduce_prescale(hvd_mx):
+    mx, hvd = hvd_mx
+    t = mx.nd.array(np.ones(3, dtype=np.float32))
+    out = hvd.allreduce(t, op=hvd.Sum, prescale_factor=2.0)
+    np.testing.assert_allclose(out.asnumpy(), np.full(3, 2.0))
+
+
+def test_grouped_allreduce_inplace(hvd_mx):
+    mx, hvd = hvd_mx
+    ts = [mx.nd.array(np.ones(2, dtype=np.float32)),
+          mx.nd.array(np.full(3, 2.0, dtype=np.float32))]
+    outs = hvd.grouped_allreduce_(ts)
+    assert outs[0] is ts[0] and outs[1] is ts[1]
+    np.testing.assert_allclose(ts[1].asnumpy(), np.full(3, 2.0))
+
+
+def test_allgather_broadcast_alltoall_reducescatter(hvd_mx):
+    mx, hvd = hvd_mx
+    t = mx.nd.array(np.arange(4, dtype=np.float32))
+    np.testing.assert_allclose(hvd.allgather(t).asnumpy(), t.asnumpy())
+    np.testing.assert_allclose(
+        hvd.broadcast(t, root_rank=0).asnumpy(), t.asnumpy())
+    received, splits = hvd.alltoall(t)
+    np.testing.assert_allclose(received.asnumpy(), t.asnumpy())
+    assert int(splits.asnumpy().sum()) == 4
+    np.testing.assert_allclose(
+        hvd.reducescatter(t, op=hvd.Sum).asnumpy(), t.asnumpy())
+
+
+def test_non_ndarray_rejected(hvd_mx):
+    mx, hvd = hvd_mx
+    with pytest.raises(ValueError, match="NDArray"):
+        hvd.allreduce(np.ones(3))
+
+
+def test_broadcast_parameters_dict_and_gluon(hvd_mx):
+    mx, hvd = hvd_mx
+    # plain dict of NDArrays (module get_params shape)
+    params = {"w": mx.nd.array(np.ones(3, dtype=np.float32)),
+              "b": mx.nd.array(np.zeros(2, dtype=np.float32))}
+    hvd.broadcast_parameters(params, root_rank=0)
+    np.testing.assert_allclose(params["w"].asnumpy(), np.ones(3))
+    # gluon parameter collection (name -> Parameter with list_data)
+    p = mx.gluon.Parameter("dense0_weight", shape=(2, 2))
+    p.data()[:] = np.full((2, 2), 3.0)
+    hvd.broadcast_parameters({"dense0_weight": p}, root_rank=0)
+    np.testing.assert_allclose(p.data().asnumpy(), np.full((2, 2), 3.0))
+    with pytest.raises(ValueError, match="dict"):
+        hvd.broadcast_parameters([1, 2, 3])
+
+
+def test_broadcast_parameters_deferred_init_hooks_init_impl(hvd_mx,
+                                                            monkeypatch):
+    """Deferred-shape gluon params broadcast right after their deferred
+    init runs (reference: _append_broadcast_init wrapping _init_impl)."""
+    mx, hvd = hvd_mx
+    p = mx.gluon.Parameter("dense0_weight", shape=None)  # shape unknown
+    names = []
+    real = hvd.mpi_ops.broadcast_
+
+    def recording(tensor, root_rank, **kw):
+        names.append(kw.get("name"))
+        return real(tensor, root_rank, **kw)
+
+    monkeypatch.setattr("horovod_tpu.mxnet.functions.mpi_ops.broadcast_",
+                        recording)
+    hvd.broadcast_parameters({"dense0_weight": p}, root_rank=0)
+    assert names == []  # nothing broadcast yet — shape still unknown
+    p._init_impl(np.full((3, 2), 5.0))  # first forward resolves the shape
+    assert len(names) == 1 and "dense0_weight" in names[0]
+    np.testing.assert_allclose(p.data().asnumpy(), np.full((3, 2), 5.0))
+
+
+def test_distributed_trainer_num_groups_batches_allreduces(hvd_mx,
+                                                           monkeypatch):
+    mx, hvd = hvd_mx
+    params = {}
+    for k in range(5):
+        p = mx.gluon.Parameter(f"w{k}", shape=(2,))
+        p.grad()[:] = np.ones(2)
+        params[f"w{k}"] = p
+    trainer = hvd.DistributedTrainer(params, "sgd", {"learning_rate": 0.1},
+                                     num_groups=2)
+    groups = []
+    real = hvd.mpi_ops.grouped_allreduce_
+
+    def recording(tensors, **kw):
+        groups.append(len(tensors))
+        return real(tensors, **kw)
+
+    monkeypatch.setattr("horovod_tpu.mxnet.mpi_ops.grouped_allreduce_",
+                        recording)
+    monkeypatch.setattr(hvd.mpi_ops, "grouped_allreduce_", recording)
+    trainer._allreduce_grads()
+    assert sorted(groups) == [2, 3]  # 5 grads split across 2 groups
+
+
+def test_distributed_optimizer_update_averages_then_applies(hvd_mx):
+    mx, hvd = hvd_mx
+    sgd = mx.optimizer.SGD(learning_rate=0.5)
+    opt = hvd.DistributedOptimizer(sgd)
+    w = mx.nd.array(np.full(3, 10.0, dtype=np.float32))
+    g = mx.nd.array(np.full(3, 2.0, dtype=np.float32))
+    opt.update(0, w, g, opt.create_state(0, w))
+    # single-process world: averaged grad == grad; w -= lr * grad
+    np.testing.assert_allclose(w.asnumpy(), np.full(3, 9.0))
+    # delegation to the wrapped optimizer's attributes
+    assert opt.learning_rate == 0.5
+    with pytest.raises(ValueError, match="already"):
+        hvd.DistributedOptimizer(opt)
+
+
+def test_distributed_optimizer_wire_contract(hvd_mx, monkeypatch):
+    """Pin the wire semantics: AVERAGE op with prescale 1/f on the
+    collective, rescale_grad absorbing f (the ADVICE-r3 topology-safe
+    recipe) — recorded by faking the engine-level call."""
+    mx, hvd = hvd_mx
+    from horovod_tpu.ops import collective_ops as _ops
+
+    calls = []
+
+    def fake_allreduce(tensor, average=None, name=None, op=None,
+                       prescale_factor=1.0, postscale_factor=1.0,
+                       process_set=None):
+        calls.append(dict(average=average, op=op, name=name,
+                          prescale=prescale_factor))
+        return tensor
+
+    monkeypatch.setattr(hvd.mpi_ops._ops, "allreduce", fake_allreduce)
+    sgd = mx.optimizer.SGD(learning_rate=1.0)
+    opt = hvd.DistributedOptimizer(sgd, gradient_predivide_factor=4.0)
+    assert sgd.rescale_grad == pytest.approx(4.0)
+    w = mx.nd.array(np.ones(2, dtype=np.float32))
+    g = mx.nd.array(np.ones(2, dtype=np.float32))
+    opt.update(7, w, g, None)
+    assert len(calls) == 1
+    assert calls[0]["average"] is True
+    assert calls[0]["prescale"] == pytest.approx(0.25)
+    assert "7" in calls[0]["name"]
+
+
+def test_distributed_trainer_step(hvd_mx):
+    mx, hvd = hvd_mx
+    p = mx.gluon.Parameter("w", shape=(2,))
+    p.data()[:] = np.full(2, 4.0)
+    p.grad()[:] = np.full(2, 1.0)
+    trainer = hvd.DistributedTrainer(
+        {"w": p}, "sgd", {"learning_rate": 1.0})
+    trainer.step(batch_size=1)
+    # single-process: avg grad = 1.0; w -= lr * scale * grad, scale = 1
+    np.testing.assert_allclose(p.data().asnumpy(), np.full(2, 3.0))
+    with pytest.raises(ValueError, match="bare optimizer"):
+        hvd.DistributedTrainer(
+            {"w": p}, hvd.DistributedOptimizer(mx.optimizer.SGD()))
+
+
+def test_distributed_trainer_skips_null_grads(hvd_mx, monkeypatch):
+    mx, hvd = hvd_mx
+    frozen = mx.gluon.Parameter("frozen", shape=(2,), grad_req="null")
+    live = mx.gluon.Parameter("live", shape=(2,))
+    live.grad()[:] = np.ones(2)
+    trainer = hvd.DistributedTrainer(
+        {"frozen": frozen, "live": live}, "sgd", {"learning_rate": 0.1})
+    names = []
+    real = hvd.mpi_ops.allreduce_
+
+    def recording(tensor, **kw):
+        names.append(kw.get("name"))
+        return real(tensor, **kw)
+
+    monkeypatch.setattr(hvd.mpi_ops, "allreduce_", recording)
+    monkeypatch.setattr(hvd, "allreduce_", recording)
+    trainer._allreduce_grads()
+    assert len(names) == 1  # only the live param reduced
