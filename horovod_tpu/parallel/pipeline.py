@@ -15,10 +15,18 @@ Design — the SPMD circular-pipeline formulation that fits shard_map:
   * after ``M + n - 1`` ticks all microbatches have exited the last
     stage; outputs are collected on their home microbatch slots.
 
-This is the inference/forward scheduling core; for training, wrap the
-whole pipelined forward in ``jax.grad`` — XLA derives the reverse
-schedule (backward ppermutes) automatically, which is the compiler-native
-replacement for hand-written 1F1B schedules.
+This is the inference/forward scheduling core; for training, put
+``jax.grad`` OUTSIDE the ``shard_map`` enclosing :func:`pipeline_apply`
+(grad of loss-of-shard_map) — XLA derives the reverse schedule
+(backward ppermutes) automatically, the compiler-native replacement for
+hand-written 1F1B schedules, and shard_map's transpose rules account
+for the replicated output correctly.  Taking ``jax.grad`` INSIDE the
+shard_map instead yields INCORRECT stage gradients — each rank seeds
+its own cotangent into the closing broadcast, and the observed
+corruption varies by configuration (uniformly axis_size-inflated in
+one, zero on non-first stages in another) — so there is no valid
+rescaling workaround; use grad-outside (parity pinned by
+tests/test_parallel_strategies.py::test_pipeline_gradients_match_sequential).
 """
 
 from __future__ import annotations
