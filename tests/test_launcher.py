@@ -162,6 +162,26 @@ def test_tpurun_pytorch_synthetic_example():
 
 
 @pytest.mark.integration
+def test_jax_pipeline_example():
+    """The GPipe example trains (8 virtual devices, loss halves — the
+    script asserts it) with grad-outside-shard_map over the pp axis."""
+    example = os.path.join(REPO, "examples", "jax", "jax_pipeline_mlp.py")
+    env = os.environ.copy()
+    env.update({
+        "PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    res = subprocess.run(
+        [sys.executable, example, "--steps", "20"],
+        env=env, capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert res.returncode == 0, \
+        f"stdout:\n{res.stdout[-2000:]}\nstderr:\n{res.stderr[-3000:]}"
+    assert "pp=8 stages" in res.stdout
+
+
+@pytest.mark.integration
 def test_tpurun_mxnet_adapter():
     """MXNet adapter under 2 real processes (faked-mxnet NDArray storage,
     real cross-process collectives): in-place/grouped ops, default-op
