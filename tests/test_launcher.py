@@ -149,6 +149,21 @@ def test_tpurun_keras_mnist_example():
 
 
 @pytest.mark.integration
+def test_tpurun_elastic_pretrain_example():
+    """The elastic LM-pretrain example (BASELINE's elastic-Llama-pretrain
+    analog at toy scale) trains under 2 real processes: elastic
+    commit/restore wrapper + ElasticSampler + DistributedOptimizer grad
+    averaging on the negotiated path; the script asserts the loss fell."""
+    example = os.path.join(REPO, "examples", "jax",
+                           "jax_elastic_pretrain.py")
+    res = _run_tpurun(2, timeout=420, target=example,
+                      target_args=["--epochs", "2", "--docs", "128"])
+    assert res.returncode == 0, \
+        f"stdout:\n{res.stdout[-2000:]}\nstderr:\n{res.stderr[-3000:]}"
+    assert "ELASTIC_PRETRAIN_OK" in res.stdout, res.stdout[-2000:]
+
+
+@pytest.mark.integration
 def test_tpurun_pytorch_synthetic_example():
     """The torch synthetic benchmark example runs under 2 real processes
     (grad-hook DistributedOptimizer + state broadcasts end to end)."""
