@@ -105,6 +105,7 @@ class NativeController:
         self._entries_lock = threading.Lock()
         self._name_counter = 0
         self._auto_counters: Dict[int, int] = {}
+        self._auto_group_counters: Dict[int, int] = {}
         self._lib = ctypes.CDLL(lib_path)
         self._declare(self._lib)
         # the callback object must outlive the native thread: keep the ref
@@ -156,11 +157,10 @@ class NativeController:
         lib.hvdtpu_enqueue.argtypes = [
             ctypes.c_longlong, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
             ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_int,
-            ctypes.c_int, ctypes.c_int, ctypes.c_double, ctypes.c_double,
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+            ctypes.c_double, ctypes.c_double,
             ctypes.POINTER(ctypes.c_longlong), ctypes.c_int,
         ]
-        lib.hvdtpu_register_group.restype = ctypes.c_int
-        lib.hvdtpu_register_group.argtypes = [ctypes.c_int]
         lib.hvdtpu_register_process_set.restype = ctypes.c_int
         lib.hvdtpu_register_process_set.argtypes = [
             ctypes.c_int, ctypes.POINTER(ctypes.c_int), ctypes.c_int,
@@ -239,8 +239,16 @@ class NativeController:
     def pending_count(self) -> int:
         return int(self._lib.hvdtpu_pending_count())
 
-    def register_group(self, size: int) -> int:
-        return int(self._lib.hvdtpu_register_group(size))
+    def auto_group_name(self, op_type: int) -> str:
+        """Symmetric base name for an unnamed grouped call (the group key
+        must match across ranks; see group_table.h).  Same contract as the
+        per-op unnamed counters in enqueue(): unnamed grouped calls must
+        happen in the same order on every rank (reference semantics for
+        unnamed ops)."""
+        with self._entries_lock:
+            n = self._auto_group_counters.get(op_type, 0) + 1
+            self._auto_group_counters[op_type] = n
+            return f"op{op_type}.group.auto.{n}"
 
     def register_process_set(self, set_id: int, member_procs) -> None:
         """Mirror a process set's member *process* ranks into the C++
@@ -280,7 +288,8 @@ class NativeController:
         reduce_op: int = 0,
         name: Optional[str] = None,
         process_set_id: int = 0,
-        group_id: int = -1,
+        group_key: str = "",
+        group_size: int = 0,
         root_rank: int = 0,
         prescale: float = 1.0,
         postscale: float = 1.0,
@@ -336,7 +345,8 @@ class NativeController:
                 c_splits, n_splits = None, 0
             rc = self._lib.hvdtpu_enqueue(
                 ctypes.c_longlong(entry_id), name.encode(), op_type,
-                dtype_enum, shape, arr.ndim, process_set_id, group_id,
+                dtype_enum, shape, arr.ndim, process_set_id,
+                group_key.encode(), group_size,
                 root_rank if op_type == OP_BROADCAST else int(reduce_op),
                 prescale, postscale, c_splits, n_splits,
             )

@@ -18,7 +18,6 @@
 
 #include "common.h"
 #include "controller.h"
-#include "group_table.h"
 #include "parameter_manager.h"
 #include "response_cache.h"
 #include "stall_inspector.h"
@@ -46,7 +45,6 @@ typedef void (*ExecCallback)(void* user, int op, int dtype, int process_set,
 struct GlobalState {
   // Reference analog: horovod/common/global_state.h HorovodGlobalState.
   std::unique_ptr<TensorQueue> queue;
-  std::unique_ptr<GroupTable> groups;
   std::unique_ptr<ResponseCache> cache;
   std::unique_ptr<StallInspector> stall;
   std::unique_ptr<Timeline> timeline;
@@ -122,7 +120,6 @@ int hvdtpu_init(int rank, int size, const char* coord_host, int coord_port,
   std::lock_guard<std::mutex> lk(s->init_mu);
   if (s->initialized.load()) return 0;
   s->queue = std::make_unique<hvdtpu::TensorQueue>();
-  s->groups = std::make_unique<hvdtpu::GroupTable>();
   // 0 disables the cache (HOROVOD_CACHE_CAPACITY=0 semantics); negative
   // means "unset" -> reference default 1024
   s->cache = std::make_unique<hvdtpu::ResponseCache>(
@@ -187,7 +184,7 @@ int hvdtpu_init(int rank, int size, const char* coord_host, int coord_port,
     transport = std::make_unique<hvdtpu::LoopbackTransport>();
   }
   s->controller = std::make_unique<hvdtpu::Controller>(
-      std::move(transport), s->queue.get(), s->groups.get(), s->cache.get(),
+      std::move(transport), s->queue.get(), s->cache.get(),
       s->stall.get(), s->timeline.get(), s->params.get(), executor,
       hvdtpu::DefaultLog);
   s->shutdown.store(false);
@@ -224,7 +221,8 @@ int hvdtpu_remove_process_set(int set_id) {
 
 long long hvdtpu_enqueue(long long entry_id, const char* name, int op,
                          int dtype, const long long* shape, int ndim,
-                         int process_set, int group_id, int root_rank,
+                         int process_set, const char* group_key,
+                         int group_size, int root_rank,
                          double prescale, double postscale,
                          const long long* splits, int n_splits) {
   // entry_id is caller-assigned so the Python side can register its future
@@ -249,7 +247,8 @@ long long hvdtpu_enqueue(long long entry_id, const char* name, int op,
   e.dtype = static_cast<DataType>(dtype);
   e.shape.assign(shape, shape + ndim);
   e.process_set_id = process_set;
-  e.group_id = group_id;
+  e.group_key = group_key ? group_key : "";
+  e.group_size = group_size;
   e.root_rank = root_rank;
   e.prescale = prescale;
   e.postscale = postscale;
@@ -258,10 +257,6 @@ long long hvdtpu_enqueue(long long entry_id, const char* name, int op,
   int64_t id = e.id;
   if (!s->queue->Add(std::move(e))) return -1;  // duplicate name pending
   return id;
-}
-
-int hvdtpu_register_group(int group_size) {
-  return hvdtpu::g()->groups->RegisterGroup(group_size);
 }
 
 void hvdtpu_shutdown() {

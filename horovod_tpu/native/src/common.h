@@ -81,7 +81,15 @@ struct TensorTableEntry {
   DataType dtype = DataType::FLOAT32;
   std::vector<int64_t> shape;
   int32_t process_set_id = 0;
-  int32_t group_id = -1;    // -1: ungrouped (GroupTable parity)
+  // Grouped collectives (GroupTable parity): every entry of one grouped
+  // call carries the call's base name as its key plus the member count;
+  // empty key = ungrouped.  The key is cross-rank stable BY CONSTRUCTION
+  // (member names must already match across ranks to negotiate at all);
+  // per-process numeric group ids are NOT — when ranks submit groups in
+  // different orders the ids diverge and an id-keyed atomicity check on
+  // the coordinator deadlocks (caught by tests/integration/stress_worker.py).
+  std::string group_key;
+  int32_t group_size = 0;
   int32_t root_rank = 0;    // broadcast only
   double prescale = 1.0;
   double postscale = 1.0;

@@ -2,6 +2,7 @@
 
 #include <algorithm>
 
+#include "group_table.h"
 #include "message.h"
 
 namespace hvdtpu {
@@ -293,6 +294,8 @@ void Controller::AccountReport(PendingCoord* pc, int32_t r,
   if (e.root_rank != first.root_rank) mismatch("root_rank");
   if (e.prescale != first.prescale || e.postscale != first.postscale)
     mismatch("prescale/postscale factors");
+  if (e.group_key != first.group_key || e.group_size != first.group_size)
+    mismatch("grouped-call membership");
   pc->reported.insert(r);
 }
 
@@ -318,15 +321,54 @@ std::vector<int32_t> Controller::SetMembers(int32_t set_id) const {
   return all;
 }
 
+void Controller::RememberErroredGroup(const std::string& group_key) {
+  if (errored_groups_.insert(group_key).second) {
+    errored_groups_fifo_.push_back(group_key);
+    if (errored_groups_fifo_.size() > 64) {
+      errored_groups_.erase(errored_groups_fifo_.front());
+      errored_groups_fifo_.pop_front();
+    }
+  }
+}
+
 std::vector<Response> Controller::BuildResponses() {
+  // Grouped-call error propagation: a group whose membership mismatched
+  // across ranks can NEVER complete, so every member must fail — the
+  // already-reported siblings now, and members that arrive later via the
+  // errored_groups_ memory.  Without this, an errored member withheld by
+  // the completeness filter (or an orphan member only some ranks submit)
+  // hangs the fleet instead of raising.
+  for (auto& [key, pc] : coord_table_) {
+    if (!pc.meta.group_key.empty() && !pc.error.empty())
+      RememberErroredGroup(
+          Key(pc.meta.group_key, pc.meta.process_set_id));
+  }
+  for (auto& [key, pc] : coord_table_) {
+    if (!pc.meta.group_key.empty() && pc.error.empty() &&
+        errored_groups_.count(
+            Key(pc.meta.group_key, pc.meta.process_set_id)))
+      pc.error = "member of a grouped call whose membership mismatched "
+                 "across ranks";
+  }
+
   // Ready = reported by all non-joined member ranks of the entry's
   // process set (reference: per-ProcessSet controllers count readiness
   // against their own membership).  Deterministic order: FIFO by
   // coordinator first-sight (responses preserve request arrival order
   // before fusion).  When every member has joined, remaining reported
   // entries flush with zero contributions from the joined ranks.
+  // Errored GROUPED entries are always ready: an orphan member may never
+  // be reported by every rank, so waiting could be forever (ranks that
+  // never submitted it ignore the error response).  Ungrouped errors
+  // keep the wait-for-all-reporters rule: every rank holds the entry, so
+  // full reporting is guaranteed and failing everyone at once is cleaner
+  // than leaving a late submitter to renegotiate against failed peers.
   std::vector<const PendingCoord*> ready;
   for (auto& [name, pc] : coord_table_) {
+    if (!pc.error.empty() && !pc.meta.group_key.empty()) {
+      ready.push_back(&pc);
+      continue;
+    }
     auto members = SetMembers(pc.meta.process_set_id);
     size_t need = 0;
     std::set<int32_t> effective;
@@ -341,18 +383,25 @@ std::vector<Response> Controller::BuildResponses() {
     if (is_ready) ready.push_back(&pc);
   }
   // group atomicity (reference: GroupTable): only emit a group's entries
-  // when the whole group is ready
-  std::unordered_map<int32_t, int32_t> group_ready;
+  // when the whole group is ready.  Keyed by the wire-carried group_key
+  // (cross-rank stable) + process set — see group_table.h for why local
+  // numeric ids cannot work here.  The table is per-cycle local state:
+  // readiness is a function of THIS cycle's ready set only.  Errored
+  // entries bypass the filter (they emit as errors regardless).
+  GroupTable groups;
   for (auto* pc : ready)
-    if (pc->meta.group_id >= 0) ++group_ready[pc->meta.group_id];
+    if (!pc->meta.group_key.empty() && pc->error.empty())
+      groups.Observe(Key(pc->meta.group_key, pc->meta.process_set_id));
   ready.erase(
       std::remove_if(ready.begin(), ready.end(),
                      [&](const PendingCoord* pc) {
-                       if (pc->meta.group_id < 0) return false;
-                       auto expected =
-                           groups_->ExpectedSize(pc->meta.group_id);
-                       return expected > 0 &&
-                              group_ready[pc->meta.group_id] < expected;
+                       if (pc->meta.group_key.empty() ||
+                           !pc->error.empty())
+                         return false;
+                       return !groups.Complete(
+                           Key(pc->meta.group_key,
+                               pc->meta.process_set_id),
+                           pc->meta.group_size);
                      }),
       ready.end());
   std::sort(ready.begin(), ready.end(),
@@ -386,7 +435,6 @@ std::vector<Response> Controller::BuildResponses() {
       r.error = pc->error;
       out.push_back(std::move(r));
       emitted.push_back(Key(e.name, e.process_set_id));
-      if (e.group_id >= 0) groups_->Forget(e.group_id);
       continue;
     }
     int64_t threshold = params_->fusion_threshold();
@@ -428,9 +476,6 @@ std::vector<Response> Controller::BuildResponses() {
       bucket_bytes = e.NumBytes();
     }
     emitted.push_back(Key(e.name, e.process_set_id));
-    // a group's members emit atomically in one cycle, so the group id is
-    // dead after emission — free it (GroupTable otherwise grows per step)
-    if (e.group_id >= 0) groups_->Forget(e.group_id);
   }
   for (const auto& key : emitted) coord_table_.erase(key);
 

@@ -13,16 +13,17 @@
 #pragma once
 
 #include <atomic>
+#include <deque>
 #include <functional>
 #include <map>
 #include <memory>
 #include <mutex>
 #include <set>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 #include "common.h"
-#include "group_table.h"
 #include "parameter_manager.h"
 #include "response_cache.h"
 #include "stall_inspector.h"
@@ -42,12 +43,11 @@ using Logger = std::function<void(int level, const std::string&)>;
 class Controller {
  public:
   Controller(std::unique_ptr<Transport> transport, TensorQueue* queue,
-             GroupTable* groups, ResponseCache* cache,
+             ResponseCache* cache,
              StallInspector* stall, Timeline* timeline,
              ParameterManager* params, Executor executor, Logger logger)
       : transport_(std::move(transport)),
         queue_(queue),
-        groups_(groups),
         cache_(cache),
         stall_(stall),
         timeline_(timeline),
@@ -96,6 +96,7 @@ class Controller {
 
   std::vector<Response> BuildResponses();
   void AccountReport(PendingCoord* pc, int32_t r, const TensorTableEntry& e);
+  void RememberErroredGroup(const std::string& group_key);
 
   std::atomic<int64_t> last_request_bytes_{0};
   std::atomic<bool> last_cycle_progress_{false};
@@ -105,7 +106,6 @@ class Controller {
 
   std::unique_ptr<Transport> transport_;
   TensorQueue* queue_;
-  GroupTable* groups_;
   ResponseCache* cache_;
   StallInspector* stall_;
   Timeline* timeline_;
@@ -117,6 +117,12 @@ class Controller {
   std::unordered_map<std::string, TensorTableEntry> pending_;
   // coordinator state (rank 0 only)
   std::map<std::string, PendingCoord> coord_table_;
+  // groups whose membership mismatched across ranks: an errored group can
+  // never complete, so EVERY member — including ones that arrive after
+  // the error emitted — must fail instead of waiting on the completeness
+  // filter (bounded FIFO memory; see BuildResponses)
+  std::unordered_set<std::string> errored_groups_;
+  std::deque<std::string> errored_groups_fifo_;
   std::set<int32_t> joined_ranks_;
   int32_t last_join_rank_ = -1;
   int64_t order_counter_ = 0;

@@ -1,42 +1,44 @@
 // Grouped-collective bookkeeping.
 //
 // Reference parity: horovod/common/group_table.h/.cc (SURVEY.md §2.1) —
-// entries sharing a group id must execute atomically: none is eligible for
-// fusion/execution until every member of the group is pending, and they
-// fuse together.
+// entries of one grouped call must execute atomically: none is eligible
+// for emission until every member of the group is ready on every rank,
+// and they emit together in one cycle.
+//
+// Redesign note: groups are identified by the grouped call's BASE NAME
+// (carried on the wire in every member entry, TensorTableEntry::group_key)
+// plus the member count (group_size) — NOT by per-process numeric ids.
+// Numeric ids from a local counter diverge across ranks as soon as ranks
+// submit groups in different orders (gradient-readiness order is not
+// deterministic), and an id-keyed completeness check on the coordinator
+// then consults the wrong expectation and deadlocks; found by the
+// randomized schedule in tests/integration/stress_worker.py.
+//
+// Lifetime: one instance per coordination cycle, local to
+// Controller::BuildResponses — group readiness is a function of that
+// cycle's ready set only, so no state may survive the cycle (a stale
+// count could release an incomplete group).
 #pragma once
 
 #include <cstdint>
-#include <mutex>
+#include <string>
 #include <unordered_map>
 
 namespace hvdtpu {
 
 class GroupTable {
  public:
-  // Register a group of `size` members; returns the group id.
-  int32_t RegisterGroup(int32_t size) {
-    std::lock_guard<std::mutex> lk(mu_);
-    int32_t id = next_id_++;
-    expected_[id] = size;
-    return id;
-  }
+  // One ready member entry of `key` observed this cycle.
+  void Observe(const std::string& key) { ++ready_[key]; }
 
-  int32_t ExpectedSize(int32_t group_id) const {
-    std::lock_guard<std::mutex> lk(mu_);
-    auto it = expected_.find(group_id);
-    return it == expected_.end() ? -1 : it->second;
-  }
-
-  void Forget(int32_t group_id) {
-    std::lock_guard<std::mutex> lk(mu_);
-    expected_.erase(group_id);
+  // All `expected` members ready => the group may emit (atomically).
+  bool Complete(const std::string& key, int32_t expected) const {
+    auto it = ready_.find(key);
+    return it != ready_.end() && it->second >= expected;
   }
 
  private:
-  mutable std::mutex mu_;
-  std::unordered_map<int32_t, int32_t> expected_;
-  int32_t next_id_ = 0;
+  std::unordered_map<std::string, int32_t> ready_;
 };
 
 }  // namespace hvdtpu

@@ -13,7 +13,8 @@ std::string EncodeEntry(const TensorTableEntry& e) {
   w.I32(static_cast<int32_t>(e.shape.size()));
   for (auto d : e.shape) w.I64(d);
   w.I32(e.process_set_id);
-  w.I32(e.group_id);
+  w.Str(e.group_key);
+  w.I32(e.group_size);
   w.I32(e.root_rank);
   w.F64(e.prescale);
   w.F64(e.postscale);
@@ -34,8 +35,9 @@ bool DecodeEntry(Reader& r, TensorTableEntry* e) {
   e->shape.resize(ndim);
   for (auto& d : e->shape)
     if (!r.I64(&d)) return false;
-  if (!r.I32(&e->process_set_id) || !r.I32(&e->group_id) ||
-      !r.I32(&e->root_rank) || !r.F64(&e->prescale) || !r.F64(&e->postscale))
+  if (!r.I32(&e->process_set_id) || !r.Str(&e->group_key) ||
+      !r.I32(&e->group_size) || !r.I32(&e->root_rank) ||
+      !r.F64(&e->prescale) || !r.F64(&e->postscale))
     return false;
   int32_t nsplits;
   if (!r.I32(&nsplits) || nsplits < 0 || nsplits > (1 << 20)) return false;

@@ -17,7 +17,7 @@
 namespace hvdtpu {
 namespace wire {
 
-constexpr uint8_t kWireVersion = 2;
+constexpr uint8_t kWireVersion = 3;
 
 class Writer {
  public:

@@ -13,9 +13,10 @@
 // rank receives in the same broadcast, in the same order — so inserts,
 // LRU touches, evictions and therefore position assignment are replicated
 // state transitions.  Query() at submit time is read-only.  Grouped
-// entries (group_id >= 0) are never cached: their group ids are
-// per-submission and would poison the signature (the Response carries a
-// per-entry cacheable flag so all ranks agree).
+// entries (non-empty group_key) are never cached: a cache bypass would
+// skip the coordinator's group-completeness accounting and could release
+// members non-atomically (the Response carries a per-entry cacheable
+// flag so all ranks agree).
 //
 // TPU-native reinterpretation per SURVEY.md §7.1: a hit also means the XLA
 // executable for that signature is warm — the Python engine keys its
@@ -59,7 +60,7 @@ class ResponseCache {
   // (not part of the signature) and join markers (coordinator state, not
   // negotiated tensors) can't be replayed from the cache.
   static bool Cacheable(const TensorTableEntry& e) {
-    return e.group_id < 0 && e.splits.empty() && e.op != OpType::JOIN;
+    return e.group_key.empty() && e.splits.empty() && e.op != OpType::JOIN;
   }
 
   // Read-only lookup at submit time: position or -1.  Never mutates the

@@ -245,7 +245,8 @@ def grouped_allreduce(
 ) -> List[Any]:
     """Reference: grouped_allreduce (horovod/torch/mpi_ops.py +
     common/group_table.cc): the group executes atomically — on the native
-    path via a registered GroupTable id, on the fallback path because the
+    path every member entry carries the call's base name as its group key
+    (see native/src/group_table.h), on the fallback path because the
     list *is* one pytree and fuses together."""
     return list(
         grouped_allreduce_async(
@@ -261,17 +262,19 @@ def grouped_allreduce_async(
 ) -> Handle:
     ctrl = _native(list(tensors))
     if ctrl is not None:
-        # native atomicity: register the group so the controller only
-        # releases these entries together (reference: GroupTable semantics)
+        # native atomicity: every member entry carries the call's base
+        # name as its group key so the controller only releases them
+        # together (reference: GroupTable semantics; see group_table.h for
+        # why the key is the name, not a numeric id)
         n_leaves = len(jax.tree_util.tree_leaves(list(tensors)))
-        gid = ctrl.register_group(n_leaves)
         rop = _normalize_op(kwargs.pop("op", None), kwargs.pop("average", None))
         ps = kwargs.pop("process_set", None)
         from ..native.controller import OP_ALLREDUCE
 
+        name = kwargs.pop("name", None) or ctrl.auto_group_name(OP_ALLREDUCE)
         return _native_submit(
-            list(tensors), OP_ALLREDUCE, kwargs.pop("name", None),
-            reduce_op=int(rop), group_id=gid,
+            list(tensors), OP_ALLREDUCE, name,
+            reduce_op=int(rop), group_key=name, group_size=n_leaves,
             prescale=kwargs.pop("prescale_factor", 1.0),
             postscale=kwargs.pop("postscale_factor", 1.0),
             process_set_id=ps.process_set_id if ps is not None else 0,
@@ -494,8 +497,9 @@ def grouped_reducescatter(
     process_set: Optional[ProcessSet] = None,
 ) -> List[Any]:
     """Reference: grouped_reducescatter (torch/mpi_ops.py) — the group
-    executes atomically via a GroupTable id on the native path; the
-    fallback path treats the list as one pytree."""
+    executes atomically on the native path (name-keyed group, see
+    native/src/group_table.h); the fallback path treats the list as one
+    pytree."""
     return list(
         grouped_reducescatter_async(
             tensors, op=op, name=name, process_set=process_set
@@ -511,18 +515,17 @@ def grouped_reducescatter_async(
 ) -> Handle:
     op = Sum if op is None else op
     if not tensors:
-        # before register_group: a size-0 group would enqueue no entries
-        # and its GroupTable entry would never be forgotten
+        # a size-0 group enqueues no entries; short-circuit
         return Handle([])
     ctrl = _native(list(tensors))
     if ctrl is not None:
         n_leaves = len(jax.tree_util.tree_leaves(list(tensors)))
-        gid = ctrl.register_group(n_leaves)
         from ..native.controller import OP_REDUCESCATTER
 
+        name = name or ctrl.auto_group_name(OP_REDUCESCATTER)
         return _native_submit(
             list(tensors), OP_REDUCESCATTER, name,
-            reduce_op=int(op), group_id=gid,
+            reduce_op=int(op), group_key=name, group_size=n_leaves,
             process_set_id=(
                 process_set.process_set_id if process_set is not None
                 else 0

@@ -1,0 +1,191 @@
+"""Randomized negotiation stress worker.
+
+Reference analog: the controller's job is to make progress when ranks
+submit the same set of collectives in DIFFERENT orders with skewed
+timing (gradients become ready in autograd order, which differs across
+ranks) — SURVEY.md §3.2 and §5.2 (the StallInspector's "distributed
+race" is exactly cross-rank submission divergence).  This worker builds
+one shared schedule of mixed collectives from a fixed seed, then each
+rank submits it asynchronously in its OWN shuffled order with random
+delays,
+synchronizes in yet another order, and checks every result against a
+locally computed expectation.  Two rounds reuse the same tensor names so
+round 2 runs entirely on the ResponseCache bit-vector bypass.
+
+NATIVE PATH ONLY: out-of-order submission tolerance is exactly what the
+C++ negotiation controller provides.  Under ``--disable-native`` eager
+collectives execute in SPMD program order and this schedule would (by
+design) deadlock — see docs/running.md.
+"""
+
+import random
+import sys
+import time
+
+import jax.numpy as jnp
+import numpy as np
+
+import horovod_tpu as hvd
+
+N_OPS = 40
+SEED = 1234
+
+
+def payload(i, r, shape, dtype, rnd):
+    base = (np.arange(int(np.prod(shape))).reshape(shape) + 1.0) * (r + 1)
+    return (base + i + 1000.0 * rnd).astype(dtype)
+
+
+def build_schedule(world):
+    rng = random.Random(SEED)
+    sched = []
+    for i in range(N_OPS):
+        kind = rng.choice(
+            ["allreduce", "allreduce", "allreduce", "grouped",
+             "broadcast", "allgather", "reducescatter", "ps_allreduce"]
+        )
+        shape = tuple(rng.choice([1, 2, 3, 5]) for _ in range(rng.randint(1, 2)))
+        dtype = rng.choice(["float32", "int32"])
+        op = rng.choice(["sum", "avg", "min", "max"])
+        if dtype == "int32" and op == "avg":
+            op = "sum"
+        root = rng.randrange(world)
+        k = rng.randint(2, 3)
+        m = rng.randint(1, 2)
+        sched.append(dict(i=i, kind=kind, shape=shape, dtype=dtype,
+                          op=op, root=root, k=k, m=m))
+    return sched
+
+
+def reduce_expected(arrs, op):
+    stack = np.stack(arrs)
+    if op == "sum":
+        return stack.sum(axis=0)
+    if op == "avg":
+        return stack.mean(axis=0)
+    if op == "min":
+        return stack.min(axis=0)
+    return stack.max(axis=0)
+
+
+OPS = {"sum": None, "avg": None, "min": None, "max": None}
+
+
+def hvd_op(op):
+    return {"sum": hvd.Sum, "avg": hvd.Average,
+            "min": hvd.Min, "max": hvd.Max}[op]
+
+
+def submit(entry, rank, world, members, ps, rnd):
+    """Submit one schedule entry asynchronously; returns
+    (handle, expected, kind) or None if this rank doesn't participate."""
+    i, kind, shape, dtype = (entry["i"], entry["kind"], entry["shape"],
+                             entry["dtype"])
+    name = f"stress.{i}"
+    if kind == "allreduce":
+        x = jnp.asarray(payload(i, rank, shape, dtype, rnd))
+        h = hvd.allreduce_async(x, op=hvd_op(entry["op"]), name=name)
+        exp = reduce_expected(
+            [payload(i, r, shape, dtype, rnd) for r in range(world)],
+            entry["op"])
+        return h, exp, kind
+    if kind == "grouped":
+        xs = [jnp.asarray(payload(i, rank, shape, dtype, rnd) + j)
+              for j in range(entry["k"])]
+        h = hvd.grouped_allreduce_async(xs, op=hvd_op(entry["op"]),
+                                        name=name)
+        exp = [reduce_expected(
+            [payload(i, r, shape, dtype, rnd) + j for r in range(world)],
+            entry["op"]) for j in range(entry["k"])]
+        return h, exp, kind
+    if kind == "broadcast":
+        x = jnp.asarray(payload(i, rank, shape, dtype, rnd))
+        h = hvd.broadcast_async(x, root_rank=entry["root"], name=name)
+        exp = payload(i, entry["root"], shape, dtype, rnd)
+        return h, exp, kind
+    if kind == "allgather":
+        rows = 1 + (i + rank) % 3  # uneven dim0 across ranks
+        x = jnp.asarray(
+            np.full((rows, 2), i + rank + rnd, dtype=dtype))
+        h = hvd.allgather_async(x, name=name)
+        exp = np.concatenate([
+            np.full((1 + (i + r) % 3, 2), i + r + rnd, dtype=dtype)
+            for r in range(world)])
+        return h, exp, kind
+    if kind == "reducescatter":
+        shape2 = (world * entry["m"], 3)
+        x = jnp.asarray(payload(i, rank, shape2, dtype, rnd))
+        h = hvd.reducescatter_async(x, op=hvd.Sum, name=name)
+        total = reduce_expected(
+            [payload(i, r, shape2, dtype, rnd) for r in range(world)],
+            "sum")
+        exp = total[rank * entry["m"]:(rank + 1) * entry["m"]]
+        return h, exp, kind
+    # ps_allreduce: only the subset's members participate
+    if rank not in members:
+        return None
+    x = jnp.asarray(payload(i, rank, shape, "float32", rnd))
+    h = hvd.allreduce_async(x, op=hvd.Sum, name=name, process_set=ps)
+    exp = reduce_expected(
+        [payload(i, r, shape, "float32", rnd) for r in members], "sum")
+    return h, exp, kind
+
+
+def main():
+    hvd.init()
+    world = hvd.cross_size()
+    rank = hvd.rank()
+    assert world == int(sys.argv[1]), (world, sys.argv)
+    assert hvd.size() == world, "stress worker expects 1 device/process"
+
+    members = sorted({0, world - 1})
+    # a subset equal to the world is the global set (np=1 smoke runs)
+    ps = (hvd.add_process_set(members) if len(members) < world
+          else hvd.global_process_set)
+    sched = build_schedule(world)
+
+    for rnd in range(2):  # round 2 = steady-state ResponseCache bypass
+        order = list(sched)
+        random.Random(SEED * 31 + rank * 7 + rnd).shuffle(order)
+        jitter = random.Random(SEED * 101 + rank * 13 + rnd)
+        pending = []
+        for entry in order:
+            got = submit(entry, rank, world, members, ps, rnd)
+            if got is not None:
+                pending.append((entry["i"], got))
+            if jitter.random() < 0.3:
+                time.sleep(jitter.random() * 0.003)
+        # synchronize in yet another per-rank order
+        random.Random(SEED * 977 + rank * 3 + rnd).shuffle(pending)
+        for i, (h, exp, kind) in pending:
+            out = hvd.synchronize(h)
+            if kind == "grouped":
+                for o, e in zip(out, exp):
+                    np.testing.assert_allclose(
+                        np.asarray(o), e, rtol=1e-5, err_msg=f"op {i}")
+            else:
+                np.testing.assert_allclose(
+                    np.asarray(out), exp, rtol=1e-5, err_msg=f"op {i}")
+
+    if ps is not hvd.global_process_set:
+        hvd.remove_process_set(ps)
+
+    if world > 1:
+        # negative leg: a grouped call whose MEMBERSHIP disagrees across
+        # ranks (2 members on rank 0, 3 elsewhere) must raise cleanly on
+        # every rank — including the orphan member only some ranks hold —
+        # instead of deadlocking the completeness filter
+        k = 2 if rank == 0 else 3
+        xs = [jnp.ones((2,)) for _ in range(k)]
+        try:
+            hvd.grouped_allreduce(xs, name="bad_group")
+        except hvd.HorovodInternalError:
+            pass
+        else:
+            raise AssertionError("mismatched grouped call did not raise")
+
+    print(f"STRESS_OK rank={rank}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

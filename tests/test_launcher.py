@@ -149,6 +149,20 @@ def test_tpurun_keras_mnist_example():
 
 
 @pytest.mark.integration
+def test_tpurun_negotiation_stress():
+    """Randomized mixed-collective schedule, submitted async in a
+    DIFFERENT order on every rank with timing jitter (the cross-rank
+    readiness skew of SURVEY §3.2/§5.2).  Caught a real deadlock: the
+    coordinator's group-atomicity check keyed on per-process group ids,
+    which diverge under out-of-order submission (see group_table.h)."""
+    worker = os.path.join(REPO, "tests", "integration", "stress_worker.py")
+    res = _run_tpurun(3, timeout=300, target=worker, target_args=["3"])
+    assert res.returncode == 0, \
+        f"stdout:\n{res.stdout[-2000:]}\nstderr:\n{res.stderr[-3000:]}"
+    assert res.stdout.count("STRESS_OK") == 3
+
+
+@pytest.mark.integration
 def test_tpurun_elastic_pretrain_example():
     """The elastic LM-pretrain example (BASELINE's elastic-Llama-pretrain
     analog at toy scale) trains under 2 real processes: elastic
