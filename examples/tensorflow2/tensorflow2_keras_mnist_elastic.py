@@ -1,0 +1,92 @@
+"""Elastic Keras MNIST-style training.
+
+Reference analog: examples/elastic/tensorflow2/tensorflow2_keras_mnist_elastic.py
+— model.fit inside an ``hvd.elastic.run`` wrapper with KerasState and the
+commit/epoch-tracking callbacks; membership changes keep state and resume
+from ``state.epoch``.  Synthetic MNIST-shaped data (no downloads).
+
+Run:  tpurun -np 2 --min-np 1 --max-np 4 \
+          --host-discovery-script ./discover.sh \
+          python examples/tensorflow2/tensorflow2_keras_mnist_elastic.py
+"""
+
+import argparse
+import os
+
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+
+import numpy as np  # noqa: E402
+import keras  # noqa: E402
+
+import horovod_tpu.keras as hvd  # noqa: E402
+
+
+def synthetic_mnist(n, seed):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 28, 28, 1).astype(np.float32)
+    y = rng.randint(0, 10, size=(n,))
+    for i, label in enumerate(y):
+        x[i, 2 * label: 2 * label + 3, :5] += 2.0
+    return x, y
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--epochs", type=int, default=3)
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--lr", type=float, default=0.05)
+    args = parser.parse_args()
+
+    hvd.init()
+    x, y = synthetic_mnist(2048, seed=hvd.cross_rank())
+
+    keras.utils.set_random_seed(42)
+    model = keras.Sequential([
+        keras.Input(shape=(28, 28, 1)),
+        keras.layers.Conv2D(16, 3, activation="relu"),
+        keras.layers.MaxPooling2D(2),
+        keras.layers.Flatten(),
+        keras.layers.Dense(64, activation="relu"),
+        keras.layers.Dense(10),
+    ])
+    opt = hvd.DistributedOptimizer(
+        keras.optimizers.SGD(args.lr * hvd.cross_size(), momentum=0.9)
+    )
+    model.compile(
+        optimizer=opt,
+        loss=keras.losses.SparseCategoricalCrossentropy(from_logits=True),
+        metrics=["accuracy"],
+    )
+
+    # KerasState captures model + optimizer; sync() broadcasts them from
+    # rank 0 after every (re-)rendezvous, so no broadcast callback needed
+    state = hvd.elastic.KerasState(model, batch=0, epoch=0)
+
+    callbacks = [
+        hvd.elastic.CommitStateCallback(state, batches_per_commit=8),
+        hvd.elastic.UpdateBatchStateCallback(state),
+        hvd.elastic.UpdateEpochStateCallback(state),
+    ]
+
+    @hvd.elastic.run
+    def train(state):
+        model.fit(
+            x, y,
+            batch_size=args.batch_size,
+            epochs=args.epochs,
+            initial_epoch=state.epoch,  # resume where the commit left off
+            callbacks=callbacks,
+            verbose=2 if hvd.rank() == 0 else 0,
+        )
+
+    train(state)
+
+    if hvd.rank() == 0:
+        _, acc = model.evaluate(x, y, verbose=0)
+        print(f"final accuracy: {acc:.4f}")
+        assert acc > 0.8, acc
+        print("KERAS_ELASTIC_OK")
+
+
+if __name__ == "__main__":
+    main()

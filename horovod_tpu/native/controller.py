@@ -106,6 +106,7 @@ class NativeController:
         self._name_counter = 0
         self._auto_counters: Dict[int, int] = {}
         self._auto_group_counters: Dict[int, int] = {}
+        self._group_call_seqs: Dict[str, int] = {}
         self._lib = ctypes.CDLL(lib_path)
         self._declare(self._lib)
         # the callback object must outlive the native thread: keep the ref
@@ -249,6 +250,19 @@ class NativeController:
             n = self._auto_group_counters.get(op_type, 0) + 1
             self._auto_group_counters[op_type] = n
             return f"op{op_type}.group.auto.{n}"
+
+    def group_call_seq(self, name: str) -> int:
+        """Per-name grouped-call sequence number, appended to the wire
+        group key (``name#seq``).  Distinguishes a RETRY of a grouped call
+        (fresh key — never poisoned by a previous call's membership error)
+        from a late straggler member of the errored call itself (old key —
+        fails via the coordinator's errored-group memory).  Symmetric
+        across ranks by the same argument names are: every rank makes the
+        same sequence of grouped calls per name."""
+        with self._entries_lock:
+            n = self._group_call_seqs.get(name, 0)
+            self._group_call_seqs[name] = n + 1
+            return n
 
     def register_process_set(self, set_id: int, member_procs) -> None:
         """Mirror a process set's member *process* ranks into the C++

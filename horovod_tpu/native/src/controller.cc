@@ -321,14 +321,18 @@ std::vector<int32_t> Controller::SetMembers(int32_t set_id) const {
   return all;
 }
 
+namespace {
+// Group keys carry a per-call sequence nonce (name#seq, controller.py
+// group_call_seq), so a RETRY of a corrected group never matches an
+// errored key — the memory only needs to outlive the slowest plausible
+// straggler member of the errored call itself.  60 s matches the stall
+// inspector's default warning horizon; the map stays bounded because
+// entries expire and errors are rare.
+constexpr auto kErroredGroupMemory = std::chrono::seconds(60);
+}
+
 void Controller::RememberErroredGroup(const std::string& group_key) {
-  if (errored_groups_.insert(group_key).second) {
-    errored_groups_fifo_.push_back(group_key);
-    if (errored_groups_fifo_.size() > 64) {
-      errored_groups_.erase(errored_groups_fifo_.front());
-      errored_groups_fifo_.pop_front();
-    }
-  }
+  errored_groups_[group_key] = Clock::now();
 }
 
 std::vector<Response> Controller::BuildResponses() {
@@ -342,6 +346,13 @@ std::vector<Response> Controller::BuildResponses() {
     if (!pc.meta.group_key.empty() && !pc.error.empty())
       RememberErroredGroup(
           Key(pc.meta.group_key, pc.meta.process_set_id));
+  }
+  auto now = Clock::now();
+  for (auto it = errored_groups_.begin(); it != errored_groups_.end();) {
+    if (now - it->second > kErroredGroupMemory)
+      it = errored_groups_.erase(it);
+    else
+      ++it;
   }
   for (auto& [key, pc] : coord_table_) {
     if (!pc.meta.group_key.empty() && pc.error.empty() &&

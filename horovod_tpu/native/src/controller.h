@@ -13,14 +13,12 @@
 #pragma once
 
 #include <atomic>
-#include <deque>
 #include <functional>
 #include <map>
 #include <memory>
 #include <mutex>
 #include <set>
 #include <unordered_map>
-#include <unordered_set>
 #include <vector>
 
 #include "common.h"
@@ -117,12 +115,14 @@ class Controller {
   std::unordered_map<std::string, TensorTableEntry> pending_;
   // coordinator state (rank 0 only)
   std::map<std::string, PendingCoord> coord_table_;
-  // groups whose membership mismatched across ranks: an errored group can
-  // never complete, so EVERY member — including ones that arrive after
-  // the error emitted — must fail instead of waiting on the completeness
-  // filter (bounded FIFO memory; see BuildResponses)
-  std::unordered_set<std::string> errored_groups_;
-  std::deque<std::string> errored_groups_fifo_;
+  // Groups whose membership mismatched across ranks: an errored group can
+  // never complete, so every member — including a straggler that lands
+  // cycles AFTER the error emitted (enqueue loop straddling a cycle
+  // boundary, or a briefly frozen peer) — must fail instead of waiting on
+  // the completeness filter.  Keys carry a per-call nonce (name#seq), so
+  // a corrected RETRY under the same user name has a fresh key and can
+  // never be poisoned; the time bound only caps memory.
+  std::unordered_map<std::string, Clock::time_point> errored_groups_;
   std::set<int32_t> joined_ranks_;
   int32_t last_join_rank_ = -1;
   int64_t order_counter_ = 0;

@@ -272,9 +272,10 @@ def grouped_allreduce_async(
         from ..native.controller import OP_ALLREDUCE
 
         name = kwargs.pop("name", None) or ctrl.auto_group_name(OP_ALLREDUCE)
+        group_key = f"{name}#{ctrl.group_call_seq(name)}"
         return _native_submit(
             list(tensors), OP_ALLREDUCE, name,
-            reduce_op=int(rop), group_key=name, group_size=n_leaves,
+            reduce_op=int(rop), group_key=group_key, group_size=n_leaves,
             prescale=kwargs.pop("prescale_factor", 1.0),
             postscale=kwargs.pop("postscale_factor", 1.0),
             process_set_id=ps.process_set_id if ps is not None else 0,
@@ -523,9 +524,10 @@ def grouped_reducescatter_async(
         from ..native.controller import OP_REDUCESCATTER
 
         name = name or ctrl.auto_group_name(OP_REDUCESCATTER)
+        group_key = f"{name}#{ctrl.group_call_seq(name)}"
         return _native_submit(
             list(tensors), OP_REDUCESCATTER, name,
-            reduce_op=int(op), group_key=name, group_size=n_leaves,
+            reduce_op=int(op), group_key=group_key, group_size=n_leaves,
             process_set_id=(
                 process_set.process_set_id if process_set is not None
                 else 0

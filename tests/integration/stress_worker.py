@@ -42,7 +42,8 @@ def build_schedule(world):
     for i in range(N_OPS):
         kind = rng.choice(
             ["allreduce", "allreduce", "allreduce", "grouped",
-             "broadcast", "allgather", "reducescatter", "ps_allreduce"]
+             "broadcast", "allgather", "reducescatter", "ps_allreduce",
+             "alltoall"]
         )
         shape = tuple(rng.choice([1, 2, 3, 5]) for _ in range(rng.randint(1, 2)))
         dtype = rng.choice(["float32", "int32"])
@@ -66,9 +67,6 @@ def reduce_expected(arrs, op):
     if op == "min":
         return stack.min(axis=0)
     return stack.max(axis=0)
-
-
-OPS = {"sum": None, "avg": None, "min": None, "max": None}
 
 
 def hvd_op(op):
@@ -121,6 +119,21 @@ def submit(entry, rank, world, members, ps, rnd):
             "sum")
         exp = total[rank * entry["m"]:(rank + 1) * entry["m"]]
         return h, exp, kind
+    if kind == "alltoall":
+        # per-rank uneven splits: the coordinator negotiates the full
+        # send matrix, so skewed submission stresses that exchange too
+        splits = [1 + (i + rank + d) % 2 for d in range(world)]
+        rows = []
+        for d, s in enumerate(splits):
+            rows += [[float(i + rank + 3 * d + rnd)] * 2] * s
+        x = jnp.asarray(np.asarray(rows, dtype="float32"))
+        h = hvd.alltoall_async(x, splits=splits, name=name)
+        exp_rows = []
+        for src in range(world):
+            s_src = 1 + (i + src + rank) % 2
+            exp_rows += [[float(i + src + 3 * rank + rnd)] * 2] * s_src
+        exp = np.asarray(exp_rows, dtype="float32")
+        return h, exp, kind
     # ps_allreduce: only the subset's members participate
     if rank not in members:
         return None
@@ -159,6 +172,8 @@ def main():
         random.Random(SEED * 977 + rank * 3 + rnd).shuffle(pending)
         for i, (h, exp, kind) in pending:
             out = hvd.synchronize(h)
+            if kind == "alltoall" and isinstance(out, tuple):
+                out = out[0]  # (received, recv_splits)
             if kind == "grouped":
                 for o, e in zip(out, exp):
                     np.testing.assert_allclose(
@@ -183,6 +198,16 @@ def main():
             pass
         else:
             raise AssertionError("mismatched grouped call did not raise")
+        # ...and an IMMEDIATE retry of the corrected group under the SAME
+        # name must succeed: the per-call nonce in the group key means the
+        # old error cannot poison it (no sleep needed)
+        outs = hvd.grouped_allreduce(
+            [jnp.ones((2,)) * (rank + 1), jnp.ones((2,)) * 10.0],
+            op=hvd.Sum, name="bad_group")
+        np.testing.assert_allclose(
+            np.asarray(outs[0]), np.full(2, world * (world + 1) / 2))
+        np.testing.assert_allclose(
+            np.asarray(outs[1]), np.full(2, 10.0 * world))
 
     print(f"STRESS_OK rank={rank}", flush=True)
 

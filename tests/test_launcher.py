@@ -149,6 +149,21 @@ def test_tpurun_keras_mnist_example():
 
 
 @pytest.mark.integration
+def test_tpurun_keras_elastic_example():
+    """The elastic Keras example (reference:
+    tensorflow2_keras_mnist_elastic.py) trains under 2 real processes:
+    KerasState sync, commit/epoch callbacks inside model.fit, resume via
+    initial_epoch; the script asserts final accuracy."""
+    example = os.path.join(REPO, "examples", "tensorflow2",
+                           "tensorflow2_keras_mnist_elastic.py")
+    res = _run_tpurun(2, timeout=420, target=example,
+                      target_args=["--epochs", "2"])
+    assert res.returncode == 0, \
+        f"stdout:\n{res.stdout[-2000:]}\nstderr:\n{res.stderr[-3000:]}"
+    assert "KERAS_ELASTIC_OK" in res.stdout, res.stdout[-2000:]
+
+
+@pytest.mark.integration
 def test_tpurun_negotiation_stress():
     """Randomized mixed-collective schedule, submitted async in a
     DIFFERENT order on every rank with timing jitter (the cross-rank
