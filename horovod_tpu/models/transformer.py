@@ -45,23 +45,15 @@ class TransformerConfig:
     # shard_map with the sequence sharded over that axis.
     attention_impl: str = "dot"
     seq_axis_name: Optional[str] = None
-    # False = bidirectional (encoder / BERT-family) attention; only the
-    # 'dot' impl supports it — the flash/ring kernels are causal by
-    # construction (their block-skipping IS the causal mask)
+    # False = bidirectional (encoder / BERT-family) attention; supported
+    # by every impl — dot, the pallas flash kernel, and both ring modes
+    # (the causal block-skipping simply switches off)
     causal: bool = True
     # rematerialize each decoder block in the backward pass: activation
     # memory drops from O(layers) to O(1) blocks at ~1/3 extra FLOPs —
     # the standard TPU memory/compute trade (jax.checkpoint) that lets
     # long-context and large-batch configs fit HBM
     remat: bool = False
-
-    def __post_init__(self):
-        if not self.causal and self.attention_impl != "dot":
-            raise ValueError(
-                "bidirectional attention (causal=False) supports only "
-                "attention_impl='dot': the flash/ring kernels' block "
-                "skipping is the causal mask itself"
-            )
 
     @property
     def d_model(self) -> int:
@@ -120,11 +112,12 @@ class Attention(nn.Module):
                 q, k, v, axis_name=cfg.seq_axis_name,
                 impl="flash" if cfg.attention_impl == "ring_flash"
                 else "dense",
+                causal=cfg.causal,
             )
         elif cfg.attention_impl == "flash":
             from ..ops.flash_attention import flash_attention
 
-            out = flash_attention(q, k, v)
+            out = flash_attention(q, k, v, causal=cfg.causal)
         else:
             out = causal_dot_attention(q, k, v, causal=cfg.causal)
         return nn.DenseGeneral(

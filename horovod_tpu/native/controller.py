@@ -256,9 +256,13 @@ class NativeController:
         group key (``name#seq``).  Distinguishes a RETRY of a grouped call
         (fresh key — never poisoned by a previous call's membership error)
         from a late straggler member of the errored call itself (old key —
-        fails via the coordinator's errored-group memory).  Symmetric
-        across ranks by the same argument names are: every rank makes the
-        same sequence of grouped calls per name."""
+        fails via the coordinator's errored-group memory).
+
+        INVARIANT: every rank must make the same sequence of grouped
+        calls per name (the same SPMD-symmetry contract tensor names
+        already rely on); a rank that conditionally skips a grouped call
+        desynchronizes the per-name counter and every later same-name
+        group errors with a membership mismatch."""
         with self._entries_lock:
             n = self._group_call_seqs.get(name, 0)
             self._group_call_seqs[name] = n + 1

@@ -321,14 +321,16 @@ std::vector<int32_t> Controller::SetMembers(int32_t set_id) const {
   return all;
 }
 
-namespace {
 // Group keys carry a per-call sequence nonce (name#seq, controller.py
 // group_call_seq), so a RETRY of a corrected group never matches an
 // errored key — the memory only needs to outlive the slowest plausible
-// straggler member of the errored call itself.  60 s matches the stall
-// inspector's default warning horizon; the map stays bounded because
-// entries expire and errors are rare.
-constexpr auto kErroredGroupMemory = std::chrono::seconds(60);
+// straggler member of the errored call itself.  Tied to the stall
+// inspector's configured warning horizon (by then a straggler is loudly
+// named anyway), floored at 60 s; bounded because entries expire and
+// errors are rare.
+std::chrono::duration<double> Controller::ErroredGroupMemory() const {
+  return std::chrono::duration<double>(
+      std::max(60.0, stall_ ? stall_->warn_seconds() : 0.0));
 }
 
 void Controller::RememberErroredGroup(const std::string& group_key) {
@@ -349,7 +351,7 @@ std::vector<Response> Controller::BuildResponses() {
   }
   auto now = Clock::now();
   for (auto it = errored_groups_.begin(); it != errored_groups_.end();) {
-    if (now - it->second > kErroredGroupMemory)
+    if (now - it->second > ErroredGroupMemory())
       it = errored_groups_.erase(it);
     else
       ++it;

@@ -95,6 +95,7 @@ class Controller {
   std::vector<Response> BuildResponses();
   void AccountReport(PendingCoord* pc, int32_t r, const TensorTableEntry& e);
   void RememberErroredGroup(const std::string& group_key);
+  std::chrono::duration<double> ErroredGroupMemory() const;
 
   std::atomic<int64_t> last_request_bytes_{0};
   std::atomic<bool> last_cycle_progress_{false};

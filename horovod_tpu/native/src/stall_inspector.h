@@ -53,6 +53,9 @@ class StallInspector {
     return shutdown;
   }
 
+  // Warning horizon (seconds); <= 0 when stall checking is disabled.
+  double warn_seconds() const { return warn_seconds_; }
+
   size_t PendingCount() const {
     std::lock_guard<std::mutex> lk(mu_);
     return pending_.size();

@@ -245,9 +245,9 @@ def grouped_allreduce(
 ) -> List[Any]:
     """Reference: grouped_allreduce (horovod/torch/mpi_ops.py +
     common/group_table.cc): the group executes atomically — on the native
-    path every member entry carries the call's base name as its group key
-    (see native/src/group_table.h), on the fallback path because the
-    list *is* one pytree and fuses together."""
+    path every member entry carries a name-derived group key
+    (``name#seq``, see native/src/group_table.h), on the fallback path
+    because the list *is* one pytree and fuses together."""
     return list(
         grouped_allreduce_async(
             tensors, average=average, name=name, op=op,
@@ -262,10 +262,10 @@ def grouped_allreduce_async(
 ) -> Handle:
     ctrl = _native(list(tensors))
     if ctrl is not None:
-        # native atomicity: every member entry carries the call's base
-        # name as its group key so the controller only releases them
-        # together (reference: GroupTable semantics; see group_table.h for
-        # why the key is the name, not a numeric id)
+        # native atomicity: every member entry carries the call's group
+        # key (base name + per-call sequence nonce) so the controller only
+        # releases them together (reference: GroupTable semantics; see
+        # group_table.h for why the key is name-derived, not a numeric id)
         n_leaves = len(jax.tree_util.tree_leaves(list(tensors)))
         rop = _normalize_op(kwargs.pop("op", None), kwargs.pop("average", None))
         ps = kwargs.pop("process_set", None)

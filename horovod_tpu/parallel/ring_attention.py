@@ -1,4 +1,5 @@
-"""Ring attention: exact causal attention over a sequence-sharded mesh axis.
+"""Ring attention: exact attention (causal or bidirectional) over a
+sequence-sharded mesh axis.
 
 No reference analog — the reference is data-parallel only and explicitly
 lacks sequence/context parallelism (SURVEY.md §5.7); it ships only the
@@ -28,26 +29,29 @@ from ..common.topology import WORLD_AXIS
 _NEG_INF = -1e30
 
 
-def _block_update(o, l, m, q, k, v, q_offset, k_offset):
+def _block_update(o, l, m, q, k, v, q_offset, k_offset, causal=True):
     """One online-softmax accumulation step over a K/V block.
 
     o: (B,H,Sq,D) f32 accumulator; l: (B,H,Sq) row sums; m: (B,H,Sq) row
-    maxes; q: (B,Sq,H,D); k,v: (B,Sk,H,D).
+    maxes; q: (B,Sq,H,D); k,v: (B,Sk,H,D).  ``causal=False`` attends the
+    whole block (encoder/bidirectional mode).
     """
     d = q.shape[-1]
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
     logits = logits / jnp.sqrt(d)
-    q_pos = q_offset + jnp.arange(q.shape[1])
-    k_pos = k_offset + jnp.arange(k.shape[1])
-    mask = q_pos[:, None] >= k_pos[None, :]  # (Sq, Sk)
-    logits = jnp.where(mask[None, None], logits, _NEG_INF)
+    if causal:
+        q_pos = q_offset + jnp.arange(q.shape[1])
+        k_pos = k_offset + jnp.arange(k.shape[1])
+        mask = q_pos[:, None] >= k_pos[None, :]  # (Sq, Sk)
+        logits = jnp.where(mask[None, None], logits, _NEG_INF)
     block_max = jnp.max(logits, axis=-1)  # (B,H,Sq)
     new_m = jnp.maximum(m, block_max)
-    # exp of masked entries is zeroed explicitly so fully-masked blocks
-    # contribute nothing even when new_m is still the -inf sentinel.
-    p = jnp.where(
-        mask[None, None], jnp.exp(logits - new_m[..., None]), 0.0
-    )
+    p = jnp.exp(logits - new_m[..., None])
+    if causal:
+        # exp of masked entries is zeroed explicitly so fully-masked
+        # blocks contribute nothing even when new_m is still the -inf
+        # sentinel.
+        p = jnp.where(mask[None, None], p, 0.0)
     corr = jnp.exp(m - new_m)
     new_l = l * corr + jnp.sum(p, axis=-1)
     pv = jnp.einsum("bhqk,bkhd->bhqd", p.astype(v.dtype), v)
@@ -61,8 +65,9 @@ def ring_attention(
     v: jax.Array,
     axis_name: Optional[str] = None,
     impl: str = "dense",
+    causal: bool = True,
 ) -> jax.Array:
-    """Exact causal attention with K/V rotating around the mesh axis.
+    """Exact attention with K/V rotating around the mesh axis.
 
     Args:
       q, k, v: (B, S_local, H, D) — this chip's sequence shard; global
@@ -76,11 +81,14 @@ def ring_attention(
         logits tile ever hits HBM — per-chip attention memory is O(S/n)
         even inside a block, which is what lets block sizes grow with
         long contexts.
+      causal: True = decoder (causal mask over GLOBAL positions); False =
+        encoder/bidirectional (every shard attends every other — the
+        long-context BERT-family mode).
     Returns:
       (B, S_local, H, D) attention output for the local Q shard.
     """
     if impl == "flash":
-        return ring_flash_attention(q, k, v, axis_name)
+        return ring_flash_attention(q, k, v, axis_name, causal=causal)
     if impl != "dense":
         raise ValueError(f"unknown ring attention impl {impl!r}")
     axis = axis_name or WORLD_AXIS
@@ -90,7 +98,7 @@ def ring_attention(
     if n == 1:
         from ..models.transformer import causal_dot_attention
 
-        return causal_dot_attention(q, k, v)
+        return causal_dot_attention(q, k, v, causal=causal)
 
     q_offset = idx * s_local
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -98,7 +106,8 @@ def ring_attention(
     def step(t, carry):
         o, l, m, kk, vv = carry
         src = (idx - t) % n  # which shard's K/V we currently hold
-        o, l, m = _block_update(o, l, m, q, kk, vv, q_offset, src * s_local)
+        o, l, m = _block_update(o, l, m, q, kk, vv, q_offset,
+                                src * s_local, causal=causal)
         kk = jax.lax.ppermute(kk, axis, perm)
         vv = jax.lax.ppermute(vv, axis, perm)
         return o, l, m, kk, vv
@@ -107,7 +116,8 @@ def ring_attention(
     l = jnp.zeros((b, h, s_local), jnp.float32)
     m = jnp.full((b, h, s_local), _NEG_INF, jnp.float32)
     o, l, m, _, _ = jax.lax.fori_loop(0, n, step, (o, l, m, k, v))
-    # causal rows always see at least the diagonal, so l > 0 everywhere
+    # every row sees at least the diagonal (causal) or everything
+    # (bidirectional), so l > 0 everywhere
     out = o / l[..., None]
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
 
@@ -124,27 +134,29 @@ def ring_attention(
 # their K/V block, arriving home after a full revolution.
 
 
-def _ring_flash_fwd(q, k, v, axis, block_q, block_k):
+def _ring_flash_fwd(q, k, v, axis, block_q, block_k, causal):
     from ..ops.flash_attention import flash_block_forward
 
     n = jax.lax.axis_size(axis)
     idx = jax.lax.axis_index(axis)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
+    # own block: diagonal-masked in causal mode, full in encoder mode
     o0, lse0 = flash_block_forward(
-        q, k, v, causal=True, block_q=block_q, block_k=block_k
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k
     )
 
     def step(t, carry):
         o, lse, kk, vv = carry
         kk = jax.lax.ppermute(kk, axis, perm)
         vv = jax.lax.ppermute(vv, axis, perm)
-        src = (idx - t) % n  # whose K/V block this chip now holds
-        past = src < idx  # strictly-past blocks attend fully; future: none
         o_t, lse_t = flash_block_forward(
             q, kk, vv, causal=False, block_q=block_q, block_k=block_k
         )
-        lse_t = jnp.where(past, lse_t, _NEG_INF)
+        if causal:
+            src = (idx - t) % n  # whose K/V block this chip now holds
+            past = src < idx  # strictly-past blocks attend fully
+            lse_t = jnp.where(past, lse_t, _NEG_INF)
         new_lse = jnp.logaddexp(lse, lse_t)
         a = jnp.exp(lse - new_lse)[..., None]
         c = jnp.exp(lse_t - new_lse)[..., None]
@@ -157,7 +169,8 @@ def _ring_flash_fwd(q, k, v, axis, block_q, block_k):
     return o.astype(q.dtype), lse
 
 
-def _ring_flash_bwd_impl(q, k, v, out, lse, g, axis, block_q, block_k):
+def _ring_flash_bwd_impl(q, k, v, out, lse, g, axis, block_q, block_k,
+                         causal):
     from ..ops import flash_attention as fa
 
     n = jax.lax.axis_size(axis)
@@ -174,13 +187,13 @@ def _ring_flash_bwd_impl(q, k, v, out, lse, g, axis, block_q, block_k):
     vf = fa._fold(fa._pad_to(v, bk, axis=1), b, h, d)
     s_q, s_k = qf.shape[1], kf.shape[1]
 
-    def block_bwd(kf_, vf_, causal):
+    def block_bwd(kf_, vf_, blk_causal):
         return fa._backward_folded(
-            qf, kf_, vf_, gf, lse_f, delta_f, orig_s=s, causal=causal,
+            qf, kf_, vf_, gf, lse_f, delta_f, orig_s=s, causal=blk_causal,
             block_q=bq, block_k=bk, interpret=None,
         )
 
-    dq0, dk0, dv0 = block_bwd(kf, vf, True)
+    dq0, dk0, dv0 = block_bwd(kf, vf, causal)
 
     def step(t, carry):
         dq, dk_acc, dv_acc, kk, vv = carry
@@ -188,12 +201,16 @@ def _ring_flash_bwd_impl(q, k, v, out, lse, g, axis, block_q, block_k):
         vv = jax.lax.ppermute(vv, axis, perm)
         dk_acc = jax.lax.ppermute(dk_acc, axis, perm)
         dv_acc = jax.lax.ppermute(dv_acc, axis, perm)
-        src = (idx - t) % n
-        past = src < idx
         dq_t, dk_t, dv_t = block_bwd(kk, vv, False)
-        dq = dq + jnp.where(past, dq_t.astype(jnp.float32), 0.0)
-        dk_acc = dk_acc + jnp.where(past, dk_t.astype(jnp.float32), 0.0)
-        dv_acc = dv_acc + jnp.where(past, dv_t.astype(jnp.float32), 0.0)
+        if causal:
+            src = (idx - t) % n
+            past = src < idx
+            dq_t = jnp.where(past, dq_t.astype(jnp.float32), 0.0)
+            dk_t = jnp.where(past, dk_t.astype(jnp.float32), 0.0)
+            dv_t = jnp.where(past, dv_t.astype(jnp.float32), 0.0)
+        dq = dq + dq_t.astype(jnp.float32)
+        dk_acc = dk_acc + dk_t.astype(jnp.float32)
+        dv_acc = dv_acc + dv_t.astype(jnp.float32)
         return dq, dk_acc, dv_acc, kk, vv
 
     dq, dk_acc, dv_acc, _, _ = jax.lax.fori_loop(
@@ -211,21 +228,21 @@ def _ring_flash_bwd_impl(q, k, v, out, lse, g, axis, block_q, block_k):
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _ring_flash(q, k, v, axis, block_q, block_k):
-    out, _ = _ring_flash_fwd(q, k, v, axis, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring_flash(q, k, v, axis, block_q, block_k, causal):
+    out, _ = _ring_flash_fwd(q, k, v, axis, block_q, block_k, causal)
     return out
 
 
-def _ring_flash_fwd_vjp(q, k, v, axis, block_q, block_k):
-    out, lse = _ring_flash_fwd(q, k, v, axis, block_q, block_k)
+def _ring_flash_fwd_vjp(q, k, v, axis, block_q, block_k, causal):
+    out, lse = _ring_flash_fwd(q, k, v, axis, block_q, block_k, causal)
     return out, (q, k, v, out, lse)
 
 
-def _ring_flash_bwd_vjp(axis, block_q, block_k, residuals, g):
+def _ring_flash_bwd_vjp(axis, block_q, block_k, causal, residuals, g):
     q, k, v, out, lse = residuals
     return _ring_flash_bwd_impl(
-        q, k, v, out, lse, g, axis, block_q, block_k
+        q, k, v, out, lse, g, axis, block_q, block_k, causal
     )
 
 
@@ -239,14 +256,16 @@ def ring_flash_attention(
     axis_name: Optional[str] = None,
     block_q: int = 256,
     block_k: int = 256,
+    causal: bool = True,
 ) -> jax.Array:
     """Ring attention whose per-block compute is the pallas flash kernel
     (see module docstring).  Differentiable; numerics match
-    ``ring_attention(..., impl="dense")`` and the single-chip oracle."""
+    ``ring_attention(..., impl="dense")`` and the single-chip oracle.
+    ``causal=False`` = encoder/bidirectional mode."""
     axis = axis_name or WORLD_AXIS
     if jax.lax.axis_size(axis) == 1:
         from ..ops.flash_attention import flash_attention
 
-        return flash_attention(q, k, v, causal=True, block_q=block_q,
+        return flash_attention(q, k, v, causal=causal, block_q=block_q,
                                block_k=block_k)
-    return _ring_flash(q, k, v, axis, block_q, block_k)
+    return _ring_flash(q, k, v, axis, block_q, block_k, causal)

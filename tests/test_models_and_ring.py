@@ -311,6 +311,73 @@ def test_ring_flash_attention_gradients_match_dense():
         )
 
 
+@pytest.mark.parametrize("impl", ["dense", "flash"])
+def test_ring_attention_bidirectional_matches_dense(impl):
+    """Encoder-mode ring attention (causal=False): every shard attends
+    every other, matching the full bidirectional dot oracle."""
+    b, s_global, h, d = 1, 32, 2, 8
+    s_local = s_global // N
+    key = jax.random.PRNGKey(11)
+    q = jax.random.normal(jax.random.fold_in(key, 0), (b, s_global, h, d))
+    k = jax.random.normal(jax.random.fold_in(key, 1), (b, s_global, h, d))
+    v = jax.random.normal(jax.random.fold_in(key, 2), (b, s_global, h, d))
+
+    dense = causal_dot_attention(q, k, v, causal=False)
+
+    def per_rank(r):
+        sl = lambda t: jax.lax.dynamic_slice_in_dim(
+            t, r * s_local, s_local, axis=1
+        )
+        out = ring_attention(sl(q), sl(k), sl(v), impl=impl, causal=False)
+        return jnp.swapaxes(out, 0, 1)
+
+    out = hvd.run_per_rank(per_rank)
+    ring = jnp.moveaxis(out.reshape((s_global,) + out.shape[2:]), 0, 1)
+    np.testing.assert_allclose(
+        np.asarray(ring), np.asarray(dense), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_ring_flash_bidirectional_gradients_match_dense():
+    """Encoder-mode flash-block ring backward parity against autodiff
+    through the bidirectional dot oracle."""
+    b, s_global, h, d = 1, 16, 1, 8
+    s_local = s_global // N
+    key = jax.random.PRNGKey(13)
+    q = jax.random.normal(jax.random.fold_in(key, 0), (b, s_global, h, d))
+    k = jax.random.normal(jax.random.fold_in(key, 1), (b, s_global, h, d))
+    v = jax.random.normal(jax.random.fold_in(key, 2), (b, s_global, h, d))
+    w = jax.random.normal(jax.random.fold_in(key, 3), (b, s_global, h, d))
+
+    def dense_loss(q_, k_, v_):
+        return jnp.sum(causal_dot_attention(q_, k_, v_, causal=False) * w)
+
+    want = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+
+    def per_rank(r):
+        sl = lambda t: jax.lax.dynamic_slice_in_dim(
+            t, r * s_local, s_local, axis=1
+        )
+
+        def loss(q_, k_, v_):
+            out = ring_attention(q_, k_, v_, impl="flash", causal=False)
+            return jnp.sum(out * sl(w))
+
+        gq, gk, gv = jax.grad(loss, argnums=(0, 1, 2))(sl(q), sl(k), sl(v))
+        return jnp.swapaxes(jnp.stack([gq, gk, gv]), 1, 2)
+
+    out = hvd.run_per_rank(per_rank)  # (N, 3, s_local, b, h, d)
+    got = jnp.moveaxis(
+        out.transpose(1, 0, 2, 3, 4, 5).reshape(
+            (3, s_global) + out.shape[3:]
+        ), 1, 2,
+    )
+    for g_got, g_want in zip(got, want):
+        np.testing.assert_allclose(
+            np.asarray(g_got), np.asarray(g_want), rtol=1e-3, atol=1e-4
+        )
+
+
 def test_transformer_remat_matches_no_remat():
     """cfg.remat trades FLOPs for memory; numerics must be identical."""
     import optax
@@ -401,11 +468,27 @@ def test_encoder_attention_is_bidirectional():
     assert not np.allclose(out_at_zero(False, t1), out_at_zero(False, t2))
     np.testing.assert_allclose(out_at_zero(True, t1),
                                out_at_zero(True, t2), rtol=1e-6)
-    # flash/ring reject encoder mode at CONFIG TIME with guidance
-    import pytest
 
-    with pytest.raises(ValueError, match="causal"):
-        TransformerConfig(
+
+def test_encoder_flash_matches_dot():
+    """Encoder mode (causal=False) through the pallas flash kernel gives
+    the same logits as the dot oracle — long-context BERT-family support
+    is not dot-only."""
+    import numpy as np
+    from horovod_tpu.models.transformer import Transformer, \
+        TransformerConfig
+
+    tokens = jnp.asarray([[3, 1, 4, 1, 5, 9, 2, 6]])
+
+    def logits(attention_impl):
+        cfg = TransformerConfig(
             vocab_size=32, num_layers=1, num_heads=2, head_dim=8,
-            max_seq_len=8, causal=False, attention_impl="flash",
+            max_seq_len=8, dtype=jnp.float32, causal=False,
+            attention_impl=attention_impl,
         )
+        model = Transformer(cfg)
+        v = model.init(jax.random.PRNGKey(0), tokens)
+        return np.asarray(model.apply(v, tokens))
+
+    np.testing.assert_allclose(logits("flash"), logits("dot"),
+                               rtol=1e-4, atol=1e-5)
