@@ -350,8 +350,9 @@ std::vector<Response> Controller::BuildResponses() {
           Key(pc.meta.group_key, pc.meta.process_set_id));
   }
   auto now = Clock::now();
+  const auto errored_memory = ErroredGroupMemory();
   for (auto it = errored_groups_.begin(); it != errored_groups_.end();) {
-    if (now - it->second > ErroredGroupMemory())
+    if (now - it->second > errored_memory)
       it = errored_groups_.erase(it);
     else
       ++it;

@@ -17,6 +17,7 @@ the sequence no longer fits even head-sharded.
 
 from __future__ import annotations
 
+import functools
 from typing import Callable, Optional
 
 import jax
@@ -50,6 +51,7 @@ def ulysses_attention(
     axis_name: Optional[str] = None,
     attn_fn: Optional[Callable] = None,
     impl: str = "dense",
+    causal: bool = True,
 ) -> jax.Array:
     """Exact attention over a sequence-sharded axis via two all-to-alls.
 
@@ -59,11 +61,13 @@ def ulysses_attention(
       axis_name: mesh axis the sequence is sharded over (bound inside
         shard_map); defaults to the world axis.
       attn_fn: local attention callable ``(q, k, v) -> out`` on
-        full-sequence, head-sharded tensors; overrides ``impl``.
-      impl: with no ``attn_fn``, ``"dense"`` uses exact causal attention
+        full-sequence, head-sharded tensors; overrides ``impl`` (and
+        ``causal`` — apply your own masking).
+      impl: with no ``attn_fn``, ``"dense"`` uses exact dot attention
         and ``"flash"`` the pallas flash kernel (the local attention runs
         over the FULL sequence with H/n heads, so flash's no-(S×S)-in-HBM
         property matters even more here than per ring block).
+      causal: True = decoder mask; False = encoder/bidirectional.
     Returns:
       (B, S_local, H, D) output, sequence-sharded like the input.
     """
@@ -73,11 +77,11 @@ def ulysses_attention(
         if impl == "flash":
             from ..ops.flash_attention import flash_attention
 
-            attn_fn = flash_attention
+            attn_fn = functools.partial(flash_attention, causal=causal)
         elif impl == "dense":
             from ..models.transformer import causal_dot_attention
 
-            attn_fn = causal_dot_attention
+            attn_fn = functools.partial(causal_dot_attention, causal=causal)
         else:
             raise ValueError(f"unknown ulysses attention impl {impl!r}")
     if n == 1:

@@ -154,6 +154,30 @@ def test_ulysses_matches_full_attention():
                                rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.parametrize("impl", ["dense", "flash"])
+def test_ulysses_bidirectional_matches_full_attention(impl):
+    """Encoder mode (causal=False) through ulysses: same values as the
+    full bidirectional oracle."""
+    rng = np.random.RandomState(17)
+    b, s, heads, dh = 2, 16, 8, 4
+    q = jnp.asarray(rng.randn(b, s, heads, dh).astype(np.float32))
+    k = jnp.asarray(rng.randn(b, s, heads, dh).astype(np.float32))
+    v = jnp.asarray(rng.randn(b, s, heads, dh).astype(np.float32))
+
+    def f(q, k, v):
+        return ulysses_attention(q, k, v, axis_name="sp", impl=impl,
+                                 causal=False)
+
+    out = jax.jit(jax.shard_map(
+        f, mesh=_mesh(axis="sp"),
+        in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
+        out_specs=P(None, "sp"), check_vma=False,
+    ))(q, k, v)
+    ref = causal_dot_attention(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
 def test_ulysses_matches_ring_attention():
     from horovod_tpu.parallel.ring_attention import ring_attention
 
