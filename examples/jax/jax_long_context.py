@@ -3,13 +3,15 @@
 No reference analog (the reference is data-parallel only, SURVEY.md §5.7);
 this demonstrates the framework's first-class long-context pillar: a
 sequence too large for one chip's memory, sharded over the mesh, with
-exact causal attention computed by either strategy.
+exact attention computed by either strategy — causal (decoder) by
+default, bidirectional (encoder / BERT-family) with ``--encoder``.
 
 Run (8 virtual chips):
   JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-      python examples/jax/jax_long_context.py
+      python examples/jax/jax_long_context.py [--encoder]
 """
 
+import argparse
 import time
 
 import jax
@@ -22,6 +24,12 @@ from horovod_tpu.parallel import ring_attention, ulysses_attention
 
 
 def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--encoder", action="store_true",
+                    help="bidirectional (causal=False) attention")
+    args = ap.parse_args()
+    causal = not args.encoder
+
     hvd.init()
     n = hvd.size()
     mesh = hvd.world_mesh()
@@ -41,30 +49,43 @@ def main():
         out_specs=P(None, axis), check_vma=False,
     )
     ring = jax.jit(jax.shard_map(
-        lambda a, b_, c: ring_attention(a, b_, c, axis_name=axis),
+        lambda a, b_, c: ring_attention(a, b_, c, axis_name=axis,
+                                        causal=causal),
         mesh=mesh, **specs))
     # flash-block ring: the TPU path (pallas kernels; interpret-mode and
     # slow on CPU, so the demo uses it only on real chips)
     ring_flash = jax.jit(jax.shard_map(
         lambda a, b_, c: ring_attention(a, b_, c, axis_name=axis,
-                                        impl="flash"),
+                                        impl="flash", causal=causal),
         mesh=mesh, **specs))
     ulysses = jax.jit(jax.shard_map(
-        lambda a, b_, c: ulysses_attention(a, b_, c, axis_name=axis),
+        lambda a, b_, c: ulysses_attention(a, b_, c, axis_name=axis,
+                                           causal=causal),
         mesh=mesh, **specs))
 
     variants = [("ring", ring), ("ulysses", ulysses)]
     if jax.default_backend() == "tpu":
         variants.insert(1, ("ring_flash", ring_flash))
 
+    outs = {}
     for name, fn in variants:
         out = jax.block_until_ready(fn(q, k, v))  # compile + run
         t0 = time.perf_counter()
         for _ in range(3):
             out = jax.block_until_ready(fn(q, k, v))
         dt = (time.perf_counter() - t0) / 3
+        outs[name] = np.asarray(out)
         print(f"{name:8s}: {dt * 1e3:8.1f} ms/step  "
-              f"out[0,0,0,:3]={np.asarray(out)[0, 0, 0, :3]}")
+              f"out[0,0,0,:3]={outs[name][0, 0, 0, :3]}")
+
+    # the strategies compute the SAME mathematical attention — cross-check
+    # every variant that ran (incl. ring_flash on real chips)
+    names = [n for n in outs if n != "ring"]
+    for name in names:
+        np.testing.assert_allclose(outs["ring"], outs[name],
+                                   rtol=1e-4, atol=1e-5)
+    mode = "causal" if causal else "encoder"
+    print(f"ring/{'/'.join(names)} agree ({mode} mode)")
 
 
 if __name__ == "__main__":

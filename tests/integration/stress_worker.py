@@ -186,6 +186,41 @@ def main():
         hvd.remove_process_set(ps)
 
     if world > 1:
+        # process-set churn under traffic: add/use/remove sets repeatedly
+        # while world-set ops are in flight — registration is symmetric
+        # but interleaves arbitrarily with negotiation cycles
+        churn_jitter = random.Random(SEED * 7 + rank)
+        for c in range(6):
+            pending = [
+                hvd.allreduce_async(
+                    jnp.full((3,), float(rank + c)), op=hvd.Sum,
+                    name=f"churn.bg.{c}")
+            ]
+            churn_members = sorted({c % world, world - 1})
+            if len(churn_members) == world:  # dup of the global set
+                churn_members = [world - 1]
+            sub = hvd.add_process_set(churn_members)
+            if churn_jitter.random() < 0.5:
+                time.sleep(churn_jitter.random() * 0.002)
+            if sub.included(rank):
+                got = hvd.allreduce(
+                    jnp.full((2,), float(rank + 1)), op=hvd.Sum,
+                    name=f"churn.ps.{c}", process_set=sub)
+                exp = sum(r + 1 for r in churn_members)
+                np.testing.assert_allclose(np.asarray(got),
+                                           np.full(2, float(exp)))
+            for h in pending:
+                out = hvd.synchronize(h)
+                exp_bg = sum(r + c for r in range(world))
+                np.testing.assert_allclose(np.asarray(out),
+                                           np.full(3, float(exp_bg)))
+            # remove-after-quiesce contract (docs/process_sets.md): a set
+            # may only be removed once no member still has ops in flight
+            # on it — removal mid-negotiation reverts membership to the
+            # world and the op would wait on non-members forever
+            hvd.barrier()
+            hvd.remove_process_set(sub)
+
         # negative leg: a grouped call whose MEMBERSHIP disagrees across
         # ranks (2 members on rank 0, 3 elsewhere) must raise cleanly on
         # every rank — including the orphan member only some ranks hold —
