@@ -31,9 +31,20 @@ N_OPS = 40
 SEED = 1234
 
 
+# comparison tolerance per wire dtype (low-precision sums accumulate
+# rounding; min/max/broadcast/gather values are chosen exactly
+# representable, but the engine may accumulate in the wire dtype)
+TOL = {"float32": 1e-5, "int32": 0.0, "bfloat16": 0.05, "float16": 0.02}
+
+
 def payload(i, r, shape, dtype, rnd):
     base = (np.arange(int(np.prod(shape))).reshape(shape) + 1.0) * (r + 1)
-    return (base + i + 1000.0 * rnd).astype(dtype)
+    base = base + i + 1000.0 * rnd
+    if dtype in ("bfloat16", "float16"):
+        # round to the wire dtype's grid, represented in f32, so local
+        # expectations start from the exact values the wire carries
+        return np.asarray(jnp.asarray(base, dtype).astype(jnp.float32))
+    return base.astype(dtype)
 
 
 def build_schedule(world):
@@ -46,7 +57,8 @@ def build_schedule(world):
              "alltoall"]
         )
         shape = tuple(rng.choice([1, 2, 3, 5]) for _ in range(rng.randint(1, 2)))
-        dtype = rng.choice(["float32", "int32"])
+        dtype = rng.choice(
+            ["float32", "int32", "float32", "bfloat16", "float16"])
         op = rng.choice(["sum", "avg", "min", "max"])
         if dtype == "int32" and op == "avg":
             op = "sum"
@@ -76,49 +88,58 @@ def hvd_op(op):
 
 def submit(entry, rank, world, members, ps, rnd):
     """Submit one schedule entry asynchronously; returns
-    (handle, expected, kind) or None if this rank doesn't participate."""
+    (handle, expected, kind, tol) or None if this rank doesn't
+    participate.  Low-precision entries travel as bf16/fp16 on the wire;
+    expectations are computed from the rounded values."""
     i, kind, shape, dtype = (entry["i"], entry["kind"], entry["shape"],
                              entry["dtype"])
+    tol = TOL[dtype]
     name = f"stress.{i}"
+
+    def wire(arr):
+        return jnp.asarray(arr).astype(dtype)
+
     if kind == "allreduce":
-        x = jnp.asarray(payload(i, rank, shape, dtype, rnd))
-        h = hvd.allreduce_async(x, op=hvd_op(entry["op"]), name=name)
+        h = hvd.allreduce_async(wire(payload(i, rank, shape, dtype, rnd)),
+                                op=hvd_op(entry["op"]), name=name)
         exp = reduce_expected(
             [payload(i, r, shape, dtype, rnd) for r in range(world)],
             entry["op"])
-        return h, exp, kind
+        return h, exp, kind, tol
     if kind == "grouped":
-        xs = [jnp.asarray(payload(i, rank, shape, dtype, rnd) + j)
+        xs = [wire(payload(i, rank, shape, dtype, rnd) + j)
               for j in range(entry["k"])]
         h = hvd.grouped_allreduce_async(xs, op=hvd_op(entry["op"]),
                                         name=name)
         exp = [reduce_expected(
             [payload(i, r, shape, dtype, rnd) + j for r in range(world)],
             entry["op"]) for j in range(entry["k"])]
-        return h, exp, kind
+        return h, exp, kind, tol
     if kind == "broadcast":
-        x = jnp.asarray(payload(i, rank, shape, dtype, rnd))
-        h = hvd.broadcast_async(x, root_rank=entry["root"], name=name)
+        h = hvd.broadcast_async(wire(payload(i, rank, shape, dtype, rnd)),
+                                root_rank=entry["root"], name=name)
         exp = payload(i, entry["root"], shape, dtype, rnd)
-        return h, exp, kind
+        return h, exp, kind, 0.0  # broadcast is bit-exact in any dtype
     if kind == "allgather":
         rows = 1 + (i + rank) % 3  # uneven dim0 across ranks
-        x = jnp.asarray(
-            np.full((rows, 2), i + rank + rnd, dtype=dtype))
+        x = wire(np.full((rows, 2), float(i + rank + rnd), np.float32)
+                 if dtype != "int32"
+                 else np.full((rows, 2), i + rank + rnd, np.int32))
         h = hvd.allgather_async(x, name=name)
         exp = np.concatenate([
-            np.full((1 + (i + r) % 3, 2), i + r + rnd, dtype=dtype)
-            for r in range(world)])
-        return h, exp, kind
+            np.full((1 + (i + r) % 3, 2), i + r + rnd, np.float64)
+            for r in range(world)])  # small ints: exact in every dtype
+        return h, exp, kind, 0.0
     if kind == "reducescatter":
         shape2 = (world * entry["m"], 3)
-        x = jnp.asarray(payload(i, rank, shape2, dtype, rnd))
-        h = hvd.reducescatter_async(x, op=hvd.Sum, name=name)
+        h = hvd.reducescatter_async(
+            wire(payload(i, rank, shape2, dtype, rnd)), op=hvd.Sum,
+            name=name)
         total = reduce_expected(
             [payload(i, r, shape2, dtype, rnd) for r in range(world)],
             "sum")
         exp = total[rank * entry["m"]:(rank + 1) * entry["m"]]
-        return h, exp, kind
+        return h, exp, kind, tol
     if kind == "alltoall":
         # per-rank uneven splits: the coordinator negotiates the full
         # send matrix, so skewed submission stresses that exchange too
@@ -133,7 +154,7 @@ def submit(entry, rank, world, members, ps, rnd):
             s_src = 1 + (i + src + rank) % 2
             exp_rows += [[float(i + src + 3 * rank + rnd)] * 2] * s_src
         exp = np.asarray(exp_rows, dtype="float32")
-        return h, exp, kind
+        return h, exp, kind, 0.0
     # ps_allreduce: only the subset's members participate
     if rank not in members:
         return None
@@ -141,7 +162,7 @@ def submit(entry, rank, world, members, ps, rnd):
     h = hvd.allreduce_async(x, op=hvd.Sum, name=name, process_set=ps)
     exp = reduce_expected(
         [payload(i, r, shape, "float32", rnd) for r in members], "sum")
-    return h, exp, kind
+    return h, exp, kind, TOL["float32"]
 
 
 def main():
@@ -170,17 +191,21 @@ def main():
                 time.sleep(jitter.random() * 0.003)
         # synchronize in yet another per-rank order
         random.Random(SEED * 977 + rank * 3 + rnd).shuffle(pending)
-        for i, (h, exp, kind) in pending:
+        for i, (h, exp, kind, tol) in pending:
             out = hvd.synchronize(h)
             if kind == "alltoall" and isinstance(out, tuple):
                 out = out[0]  # (received, recv_splits)
+
+            def check(o, e):
+                np.testing.assert_allclose(
+                    np.asarray(o, dtype=np.float64), np.asarray(e, np.float64),
+                    rtol=max(tol, 1e-6), atol=tol, err_msg=f"op {i}")
+
             if kind == "grouped":
                 for o, e in zip(out, exp):
-                    np.testing.assert_allclose(
-                        np.asarray(o), e, rtol=1e-5, err_msg=f"op {i}")
+                    check(o, e)
             else:
-                np.testing.assert_allclose(
-                    np.asarray(out), exp, rtol=1e-5, err_msg=f"op {i}")
+                check(out, exp)
 
     if ps is not hvd.global_process_set:
         hvd.remove_process_set(ps)
