@@ -142,18 +142,26 @@ def submit(entry, rank, world, members, ps, rnd):
         return h, exp, kind, tol
     if kind == "alltoall":
         # per-rank uneven splits: the coordinator negotiates the full
-        # send matrix, so skewed submission stresses that exchange too
+        # send matrix, so skewed submission stresses that exchange too.
+        # Low-precision dtypes ride it too: alltoall is pure data
+        # movement, so wire-rounded values come back bit-exact.
+        def rounded(val):
+            return float(np.asarray(
+                jnp.asarray(float(val), dtype).astype(jnp.float32)))
+
+        if dtype == "int32":
+            rounded = float  # noqa: F811 — ints are exact
         splits = [1 + (i + rank + d) % 2 for d in range(world)]
         rows = []
         for d, s in enumerate(splits):
-            rows += [[float(i + rank + 3 * d + rnd)] * 2] * s
-        x = jnp.asarray(np.asarray(rows, dtype="float32"))
+            rows += [[rounded(i + rank + 3 * d + rnd)] * 2] * s
+        x = wire(np.asarray(rows, dtype="float32"))
         h = hvd.alltoall_async(x, splits=splits, name=name)
         exp_rows = []
         for src in range(world):
             s_src = 1 + (i + src + rank) % 2
-            exp_rows += [[float(i + src + 3 * rank + rnd)] * 2] * s_src
-        exp = np.asarray(exp_rows, dtype="float32")
+            exp_rows += [[rounded(i + src + 3 * rank + rnd)] * 2] * s_src
+        exp = np.asarray(exp_rows, dtype="float64")
         return h, exp, kind, 0.0
     # ps_allreduce: only the subset's members participate
     if rank not in members:
