@@ -47,7 +47,20 @@ bool Controller::RunLoopOnce() {
     for (int32_t r = 0; r < static_cast<int32_t>(gathered.size()); ++r) {
       std::vector<int64_t> positions;
       std::vector<TensorTableEntry> reqs;
-      if (!wire::DecodeCycleRequest(gathered[r], &positions, &reqs)) continue;
+      if (!wire::DecodeCycleRequest(gathered[r], &positions, &reqs)) {
+        if (!gathered[r].empty() && protocol_error_.empty()) {
+          // a non-empty payload that fails to decode means the peer
+          // speaks a different wire version (processes built from
+          // different sources) or sent garbage — silently skipping it
+          // would strand that rank's collectives until stall shutdown;
+          // fail the fleet loudly instead
+          protocol_error_ =
+              "failed to decode rank " + std::to_string(r) +
+              "'s negotiation payload (wire-version mismatch — were all "
+              "processes built from the same sources?)";
+        }
+        continue;
+      }
       // reconstruct position-only reports from the replicated cache
       // (reference: Controller::ComputeResponseList cache-hit path)
       for (auto pos : positions) {
@@ -102,18 +115,9 @@ bool Controller::RunLoopOnce() {
   if (transport_->failed()) {
     // peer died mid-negotiation: fail every pending entry so waiters get
     // HorovodInternalError — the elastic recovery signal (SURVEY.md §5.3)
-    Response err;
-    err.error = "negotiation transport failed (peer died or disconnected)";
-    std::vector<int64_t> ids;
-    for (auto& [key, e] : pending_) {
-      err.names.push_back(e.name);
-      err.shapes.push_back(e.shape);
-      ids.push_back(e.id);
-      stall_->RecordDone(e.name);
-    }
-    pending_.clear();
-    if (!ids.empty()) {
-      executor_(err, ids);
+    size_t n = FailAllPending(
+        "negotiation transport failed (peer died or disconnected)", "");
+    if (n) {
       logger_(2, "negotiation transport failed with collectives in flight; "
                  "background loop stopping");
     } else {
@@ -124,24 +128,24 @@ bool Controller::RunLoopOnce() {
     return false;
   }
   std::vector<Response> responses;
-  wire::DecodeResponseList(payload, &responses);
+  if (!wire::DecodeResponseList(payload, &responses) && !payload.empty()) {
+    // same failure class as the coordinator-side decode guard: a
+    // response broadcast this process cannot parse (wire-version
+    // mismatch between differently built processes) — fail loudly
+    // instead of spinning idle until stall shutdown
+    const std::string msg =
+        "failed to decode the coordinator's response broadcast "
+        "(wire-version mismatch — were all processes built from the "
+        "same sources?)";
+    FailAllPending(msg, msg + "; background loop stopping");
+    return false;
+  }
 
   // global protocol failure (no-names error response): fail everything
   // in flight on every rank and stop the loop
   for (const auto& resp : responses) {
     if (resp.names.empty() && !resp.error.empty()) {
-      Response err;
-      err.error = resp.error;
-      std::vector<int64_t> ids;
-      for (auto& [key, e] : pending_) {
-        err.names.push_back(e.name);
-        err.shapes.push_back(e.shape);
-        ids.push_back(e.id);
-        stall_->RecordDone(e.name);
-      }
-      pending_.clear();
-      if (!ids.empty()) executor_(err, ids);
-      logger_(2, "fatal negotiation error: " + resp.error);
+      FailAllPending(resp.error, "fatal negotiation error: " + resp.error);
       return false;
     }
   }
@@ -217,21 +221,29 @@ bool Controller::RunLoopOnce() {
                    "(waiting on peers?)");
   if (shutdown) {
     // fail everything in flight so waiters raise instead of hanging
-    Response err;
-    err.error = "stall shutdown threshold exceeded";
-    std::vector<int64_t> ids;
-    for (auto& [key, e] : pending_) {
-      err.names.push_back(e.name);
-      err.shapes.push_back(e.shape);
-      ids.push_back(e.id);
-      stall_->RecordDone(e.name);
-    }
-    pending_.clear();
-    if (!ids.empty()) executor_(err, ids);
-    logger_(2, "stall shutdown threshold exceeded; aborting background loop");
+    FailAllPending("stall shutdown threshold exceeded",
+                   "stall shutdown threshold exceeded; "
+                   "aborting background loop");
     return false;
   }
   return true;
+}
+
+size_t Controller::FailAllPending(const std::string& error,
+                                  const std::string& log_msg) {
+  Response err;
+  err.error = error;
+  std::vector<int64_t> ids;
+  for (auto& [key, e] : pending_) {
+    err.names.push_back(e.name);
+    err.shapes.push_back(e.shape);
+    ids.push_back(e.id);
+    stall_->RecordDone(e.name);
+  }
+  pending_.clear();
+  if (!ids.empty()) executor_(err, ids);
+  if (!log_msg.empty()) logger_(2, log_msg);
+  return ids.size();
 }
 
 void Controller::AccountReport(PendingCoord* pc, int32_t r,

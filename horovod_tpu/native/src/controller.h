@@ -95,6 +95,13 @@ class Controller {
   std::vector<Response> BuildResponses();
   void AccountReport(PendingCoord* pc, int32_t r, const TensorTableEntry& e);
   void RememberErroredGroup(const std::string& group_key);
+  // Fail every in-flight entry with `error` (waiters raise
+  // HorovodInternalError) and log `log_msg` at error level (skipped when
+  // empty); returns how many entries were failed.  Every unrecoverable
+  // negotiation exit shares this so the bookkeeping (stall RecordDone,
+  // pending_ clear) cannot drift between copies.
+  size_t FailAllPending(const std::string& error,
+                        const std::string& log_msg);
   std::chrono::duration<double> ErroredGroupMemory() const;
 
   std::atomic<int64_t> last_request_bytes_{0};
