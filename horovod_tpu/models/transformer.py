@@ -37,6 +37,13 @@ class TransformerConfig:
     vocab_size: int = 32000
     num_layers: int = 12
     num_heads: int = 12
+    # GQA (Ainslie et al., 2023; the Llama-2-70B/Llama-3 layout): K/V
+    # projections produce this many heads, shared by num_heads/num_kv_heads
+    # query heads each.  None (default) = MHA.  K/V heads are repeated to
+    # num_heads before attention, so every attention_impl (dot, flash,
+    # ring, ring_flash) works unchanged; the savings are in the K/V
+    # projection FLOPs/params and any KV cache, exactly as in the paper.
+    num_kv_heads: Optional[int] = None
     head_dim: int = 64
     mlp_ratio: int = 4
     max_seq_len: int = 2048
@@ -100,11 +107,24 @@ class Attention(nn.Module):
         dense = functools.partial(
             nn.DenseGeneral, dtype=cfg.dtype, use_bias=False
         )
+        kv_heads = (cfg.num_heads if cfg.num_kv_heads is None
+                    else cfg.num_kv_heads)
+        if kv_heads <= 0 or cfg.num_heads % kv_heads:
+            raise ValueError(
+                f"num_heads ({cfg.num_heads}) must be a multiple of "
+                f"num_kv_heads ({kv_heads})"
+            )
         q = dense(features=(cfg.num_heads, cfg.head_dim), name="q")(x)
-        k = dense(features=(cfg.num_heads, cfg.head_dim), name="k")(x)
-        v = dense(features=(cfg.num_heads, cfg.head_dim), name="v")(x)
+        k = dense(features=(kv_heads, cfg.head_dim), name="k")(x)
+        v = dense(features=(kv_heads, cfg.head_dim), name="v")(x)
         q = rope(q, positions)
         k = rope(k, positions)
+        if kv_heads != cfg.num_heads:
+            # GQA: each K/V head serves num_heads/kv_heads query heads;
+            # repeat on the head axis so the attention kernels see MHA
+            rep = cfg.num_heads // kv_heads
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
         if cfg.attention_impl in ("ring", "ring_flash"):
             from ..parallel.ring_attention import ring_attention
 
@@ -210,4 +230,12 @@ def llama_7b(**kw) -> TransformerConfig:
     return TransformerConfig(
         vocab_size=32000, num_layers=32, num_heads=32, head_dim=128,
         max_seq_len=4096, **kw,
+    )
+
+
+def llama3_8b(**kw) -> TransformerConfig:
+    """Llama-3-8B layout: GQA with 8 K/V heads over 32 query heads."""
+    return TransformerConfig(
+        vocab_size=128256, num_layers=32, num_heads=32, num_kv_heads=8,
+        head_dim=128, max_seq_len=8192, **kw,
     )
