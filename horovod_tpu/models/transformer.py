@@ -56,6 +56,12 @@ class TransformerConfig:
     # by every impl — dot, the pallas flash kernel, and both ring modes
     # (the causal block-skipping simply switches off)
     causal: bool = True
+    # Mistral-style sliding-window attention: each token attends the last
+    # `window` positions, itself included (q_pos - k_pos < window, the
+    # Mistral/HF convention; symmetric reach when causal=False).  Exact
+    # mask-level support on the 'dot' and dense 'ring' impls; the flash
+    # kernels have no windowed block-skip yet and reject it with guidance.
+    window: Optional[int] = None
     # rematerialize each decoder block in the backward pass: activation
     # memory drops from O(layers) to O(1) blocks at ~1/3 extra FLOPs —
     # the standard TPU memory/compute trade (jax.checkpoint) that lets
@@ -79,20 +85,44 @@ def rope(x: jax.Array, positions: jax.Array) -> jax.Array:
     return out.astype(x.dtype)
 
 
-def causal_dot_attention(q, k, v, *, q_offset=0, k_offset=0, causal=True):
+def sliding_mask(q_pos, k_pos, causal=True, window=None):
+    """(Sq, Sk) bool attention mask shared by the dot oracle and the
+    ring path (the two must stay exactly equivalent).  Causal:
+    ``q_pos >= k_pos``; window (Mistral/HF convention): each query
+    attends the last ``window`` positions, ITSELF INCLUDED
+    (``q_pos - k_pos < window``; symmetric |Δ| < window when
+    bidirectional).  ``window`` must be >= 1: a non-positive window
+    would mask every entry and silently degrade to uniform attention
+    (dot) or NaN (ring online-softmax)."""
+    if window is not None and window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
+    delta = q_pos[:, None] - k_pos[None, :]
+    mask = (delta >= 0) if causal else jnp.ones_like(delta, bool)
+    if window is not None:
+        reach = delta if causal else jnp.abs(delta)
+        mask = mask & (reach < window)
+    return mask
+
+
+def causal_dot_attention(q, k, v, *, q_offset=0, k_offset=0, causal=True,
+                         window=None):
     """Standard attention; offsets support sequence-sharded blocks.
 
     q, k, v: (B, S, H, D).  Softmax in float32 (TPU numerics), matmuls in
     the input dtype so they hit the MXU in bf16.  ``causal=False`` is
     the bidirectional (encoder / BERT-family) form — no mask at all.
+    ``window``: Mistral-style sliding window — each token attends the
+    last ``window`` positions, itself included (see ``sliding_mask``).
     """
     d = q.shape[-1]
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(d).astype(q.dtype)
     logits = logits.astype(jnp.float32)
-    if causal:
-        q_pos = q_offset + jnp.arange(q.shape[1])
-        k_pos = k_offset + jnp.arange(k.shape[1])
-        mask = q_pos[:, None] >= k_pos[None, :]
+    if causal or window is not None:
+        mask = sliding_mask(
+            q_offset + jnp.arange(q.shape[1]),
+            k_offset + jnp.arange(k.shape[1]),
+            causal=causal, window=window,
+        )
         logits = jnp.where(mask[None, None], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
@@ -125,6 +155,13 @@ class Attention(nn.Module):
             rep = cfg.num_heads // kv_heads
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
+        if cfg.window is not None and cfg.attention_impl in (
+                "flash", "ring_flash"):
+            raise ValueError(
+                "sliding-window attention (cfg.window) is exact on the "
+                "'dot' and 'ring' impls; the flash kernels have no "
+                "windowed block-skip yet"
+            )
         if cfg.attention_impl in ("ring", "ring_flash"):
             from ..parallel.ring_attention import ring_attention
 
@@ -133,13 +170,15 @@ class Attention(nn.Module):
                 impl="flash" if cfg.attention_impl == "ring_flash"
                 else "dense",
                 causal=cfg.causal,
+                window=cfg.window,
             )
         elif cfg.attention_impl == "flash":
             from ..ops.flash_attention import flash_attention
 
             out = flash_attention(q, k, v, causal=cfg.causal)
         else:
-            out = causal_dot_attention(q, k, v, causal=cfg.causal)
+            out = causal_dot_attention(q, k, v, causal=cfg.causal,
+                                       window=cfg.window)
         return nn.DenseGeneral(
             features=cfg.d_model, axis=(-2, -1), dtype=cfg.dtype,
             use_bias=False, name="o",

@@ -29,25 +29,33 @@ from ..common.topology import WORLD_AXIS
 _NEG_INF = -1e30
 
 
-def _block_update(o, l, m, q, k, v, q_offset, k_offset, causal=True):
+def _block_update(o, l, m, q, k, v, q_offset, k_offset, causal=True,
+                  window=None):
     """One online-softmax accumulation step over a K/V block.
 
     o: (B,H,Sq,D) f32 accumulator; l: (B,H,Sq) row sums; m: (B,H,Sq) row
     maxes; q: (B,Sq,H,D); k,v: (B,Sk,H,D).  ``causal=False`` attends the
-    whole block (encoder/bidirectional mode).
+    whole block (encoder/bidirectional mode); ``window`` restricts reach
+    to GLOBAL positions within the sliding window (the offsets make the
+    mask exact across shards).
     """
     d = q.shape[-1]
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
     logits = logits / jnp.sqrt(d)
-    if causal:
-        q_pos = q_offset + jnp.arange(q.shape[1])
-        k_pos = k_offset + jnp.arange(k.shape[1])
-        mask = q_pos[:, None] >= k_pos[None, :]  # (Sq, Sk)
+    masked = causal or window is not None
+    if masked:
+        from ..models.transformer import sliding_mask
+
+        mask = sliding_mask(
+            q_offset + jnp.arange(q.shape[1]),
+            k_offset + jnp.arange(k.shape[1]),
+            causal=causal, window=window,
+        )  # (Sq, Sk) — shared with the dot oracle so the two stay exact
         logits = jnp.where(mask[None, None], logits, _NEG_INF)
     block_max = jnp.max(logits, axis=-1)  # (B,H,Sq)
     new_m = jnp.maximum(m, block_max)
     p = jnp.exp(logits - new_m[..., None])
-    if causal:
+    if masked:
         # exp of masked entries is zeroed explicitly so fully-masked
         # blocks contribute nothing even when new_m is still the -inf
         # sentinel.
@@ -66,6 +74,7 @@ def ring_attention(
     axis_name: Optional[str] = None,
     impl: str = "dense",
     causal: bool = True,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Exact attention with K/V rotating around the mesh axis.
 
@@ -84,10 +93,21 @@ def ring_attention(
       causal: True = decoder (causal mask over GLOBAL positions); False =
         encoder/bidirectional (every shard attends every other — the
         long-context BERT-family mode).
+      window: Mistral-style sliding window over GLOBAL positions —
+        each token attends the last ``window`` positions, itself
+        included (``q_pos - k_pos < window``; symmetric |Δ| < window
+        when bidirectional).  Dense impl only — the flash-block path
+        has no windowed kernel yet and rejects it with guidance.
     Returns:
       (B, S_local, H, D) attention output for the local Q shard.
     """
     if impl == "flash":
+        if window is not None:
+            raise ValueError(
+                "sliding-window attention is not supported by the "
+                "flash-block ring path yet; use impl='dense' (exact, "
+                "windowed) or window=None"
+            )
         return ring_flash_attention(q, k, v, axis_name, causal=causal)
     if impl != "dense":
         raise ValueError(f"unknown ring attention impl {impl!r}")
@@ -98,7 +118,7 @@ def ring_attention(
     if n == 1:
         from ..models.transformer import causal_dot_attention
 
-        return causal_dot_attention(q, k, v, causal=causal)
+        return causal_dot_attention(q, k, v, causal=causal, window=window)
 
     q_offset = idx * s_local
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -107,7 +127,8 @@ def ring_attention(
         o, l, m, kk, vv = carry
         src = (idx - t) % n  # which shard's K/V we currently hold
         o, l, m = _block_update(o, l, m, q, kk, vv, q_offset,
-                                src * s_local, causal=causal)
+                                src * s_local, causal=causal,
+                                window=window)
         kk = jax.lax.ppermute(kk, axis, perm)
         vv = jax.lax.ppermute(vv, axis, perm)
         return o, l, m, kk, vv
