@@ -58,15 +58,26 @@ class TransformerConfig:
     causal: bool = True
     # Mistral-style sliding-window attention: each token attends the last
     # `window` positions, itself included (q_pos - k_pos < window, the
-    # Mistral/HF convention; symmetric reach when causal=False).  Exact
-    # mask-level support on the 'dot' and dense 'ring' impls; the flash
-    # kernels have no windowed block-skip yet and reject it with guidance.
+    # Mistral/HF convention; symmetric reach when causal=False).  Exact on
+    # 'dot' and dense 'ring' (mask-level) and on 'flash', where
+    # out-of-window blocks are SKIPPED — compute O(S·window), the real
+    # Mistral training path.  'ring_flash' has no windowed merge yet and
+    # rejects it with guidance.
     window: Optional[int] = None
+    # (window support is validated at construction — see __post_init__)
     # rematerialize each decoder block in the backward pass: activation
     # memory drops from O(layers) to O(1) blocks at ~1/3 extra FLOPs —
     # the standard TPU memory/compute trade (jax.checkpoint) that lets
     # long-context and large-batch configs fit HBM
     remat: bool = False
+
+    def __post_init__(self):
+        if self.window is not None and self.attention_impl == "ring_flash":
+            raise ValueError(
+                "sliding-window attention (window=) is supported by "
+                "'dot', 'flash' (windowed block-skip) and dense 'ring'; "
+                "the flash-block ring path has no windowed merge yet"
+            )
 
     @property
     def d_model(self) -> int:
@@ -155,13 +166,6 @@ class Attention(nn.Module):
             rep = cfg.num_heads // kv_heads
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
-        if cfg.window is not None and cfg.attention_impl in (
-                "flash", "ring_flash"):
-            raise ValueError(
-                "sliding-window attention (cfg.window) is exact on the "
-                "'dot' and 'ring' impls; the flash kernels have no "
-                "windowed block-skip yet"
-            )
         if cfg.attention_impl in ("ring", "ring_flash"):
             from ..parallel.ring_attention import ring_attention
 
@@ -175,7 +179,8 @@ class Attention(nn.Module):
         elif cfg.attention_impl == "flash":
             from ..ops.flash_attention import flash_attention
 
-            out = flash_attention(q, k, v, causal=cfg.causal)
+            out = flash_attention(q, k, v, causal=cfg.causal,
+                                  window=cfg.window)
         else:
             out = causal_dot_attention(q, k, v, causal=cfg.causal,
                                        window=cfg.window)

@@ -437,7 +437,8 @@ def test_ring_attention_windowed_matches_dense():
 
 def test_window_config_plumbing():
     """TransformerConfig.window reaches the mask (windowed logits differ
-    from unwindowed) and the flash impls reject it with guidance."""
+    from unwindowed), the flash impl agrees with dot under a window, and
+    the unsupported ring_flash path rejects it with guidance."""
     import pytest as _pytest
     from horovod_tpu.models.transformer import TransformerConfig
 
@@ -452,9 +453,15 @@ def test_window_config_plumbing():
         return np.asarray(model.apply(v, tokens))
 
     assert not np.allclose(logits(window=2), logits())
+    np.testing.assert_allclose(
+        logits(window=2, attention_impl="flash"), logits(window=2),
+        rtol=1e-4, atol=1e-5)
 
     with _pytest.raises(ValueError, match="window"):
-        logits(window=2, attention_impl="flash")
+        TransformerConfig(
+            vocab_size=32, num_layers=1, num_heads=2, head_dim=8,
+            max_seq_len=8, window=2, attention_impl="ring_flash",
+            seq_axis_name="hvd")
 
 
 def test_gqa_attention():
