@@ -1,0 +1,89 @@
+"""A reference-STYLE torch training script with UNCHANGED imports.
+
+This file is written the way a Horovod user writes theirs (reference:
+the horovod examples' pytorch_mnist.py pattern — SURVEY.md §2.3 public
+surface): ``import horovod.torch as hvd``, ``hvd.init()``,
+``hvd.DistributedOptimizer``, ``broadcast_parameters``/
+``broadcast_optimizer_state``, metric averaging via ``hvd.allreduce`` —
+and it must run under ``horovodrun -np N python <this file>`` with ZERO
+edits on the TPU backend (the ``horovod`` alias package +
+``horovodrun`` console script make that literal; BASELINE.md north
+star).  The model/data are synthetic so the script is self-contained.
+"""
+
+import argparse
+
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+import torch.utils.data
+
+import horovod.torch as hvd
+
+
+class Net(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(784, 128)
+        self.fc2 = nn.Linear(128, 10)
+
+    def forward(self, x):
+        x = F.relu(self.fc1(x.view(x.shape[0], -1)))
+        return F.log_softmax(self.fc2(x), dim=1)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--epochs", type=int, default=2)
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--lr", type=float, default=0.05)
+    args = parser.parse_args()
+
+    hvd.init()
+    torch.manual_seed(1234)
+
+    # synthetic separable "MNIST": class k lights up pixel block k
+    n = 512
+    labels = torch.randint(0, 10, (n,))
+    data = 0.05 * torch.randn(n, 1, 28, 28)
+    for i in range(n):
+        k = int(labels[i])
+        data[i, 0, k * 2:(k + 1) * 2, :] += 1.0
+
+    dataset = torch.utils.data.TensorDataset(data, labels)
+    sampler = torch.utils.data.distributed.DistributedSampler(
+        dataset, num_replicas=hvd.size(), rank=hvd.rank())
+    loader = torch.utils.data.DataLoader(
+        dataset, batch_size=args.batch_size, sampler=sampler)
+
+    model = Net()
+    optimizer = torch.optim.SGD(model.parameters(),
+                                lr=args.lr * hvd.size())
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters())
+
+    for epoch in range(args.epochs):
+        sampler.set_epoch(epoch)
+        model.train()
+        for batch, target in loader:
+            optimizer.zero_grad()
+            loss = F.nll_loss(model(batch), target)
+            loss.backward()
+            optimizer.step()
+
+    model.eval()
+    with torch.no_grad():
+        pred = model(data).argmax(dim=1)
+        acc = (pred == labels).float().mean()
+    # metric averaging across ranks, the reference idiom
+    acc = hvd.allreduce(acc, name="avg_accuracy")
+    if hvd.rank() == 0:
+        print(f"UNMODIFIED_OK accuracy={float(acc):.3f} "
+              f"world={hvd.size()}")
+        assert float(acc) > 0.85, float(acc)
+
+
+if __name__ == "__main__":
+    main()

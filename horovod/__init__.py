@@ -1,0 +1,70 @@
+"""``horovod`` compatibility alias for ``horovod_tpu``.
+
+Reference parity: the reference's public import surface itself
+(horovod/__init__.py and its framework submodules — SURVEY.md §2.3/§2.4).
+This thin distribution makes the north-star sentence literally true: a
+reference-style script with UNCHANGED imports (``import horovod.torch as
+hvd``, ``import horovod.tensorflow``, ``from horovod import run``, ...)
+runs on the TPU backend, and ``horovodrun`` delegates to ``tpurun``.
+
+Mechanism: a meta-path finder redirects every ``horovod.X`` import to
+the already-packaged ``horovod_tpu.X`` module — the SAME module object
+is registered under both names, so there is no duplicated module state
+(singletons like the controller, handle tables, and process-set
+registries stay unique).  No code is copied; this package is one file.
+"""
+
+from __future__ import annotations
+
+import importlib
+import importlib.abc
+import importlib.util
+import sys
+
+from horovod_tpu import *  # noqa: F401,F403 — the reference's flat surface
+from horovod_tpu import __version__  # noqa: F401
+
+
+class _AliasLoader(importlib.abc.Loader):
+    """Loader that materializes ``horovod.X`` as ``horovod_tpu.X``."""
+
+    def __init__(self, real_name: str):
+        self._real_name = real_name
+
+    def create_module(self, spec):
+        # returning the real (possibly cached) module makes both names
+        # share one module object
+        return importlib.import_module(self._real_name)
+
+    def exec_module(self, module):
+        pass  # already executed under its real name
+
+
+class _AliasFinder(importlib.abc.MetaPathFinder):
+    def find_spec(self, fullname, path=None, target=None):
+        if not fullname.startswith("horovod."):
+            return None
+        real_name = "horovod_tpu." + fullname[len("horovod."):]
+        try:
+            real_spec = importlib.util.find_spec(real_name)
+        except (ImportError, AttributeError, ValueError):
+            return None
+        if real_spec is None:
+            return None
+        return importlib.util.spec_from_loader(
+            fullname,
+            _AliasLoader(real_name),
+            is_package=real_spec.submodule_search_locations is not None,
+        )
+
+
+# idempotent: re-imports (or importlib.reload) must not stack finders
+if not any(isinstance(f, _AliasFinder) for f in sys.meta_path):
+    sys.meta_path.insert(0, _AliasFinder())
+
+# the reference exposes horovod.run (the launcher package, providing
+# horovod.run.run_commandline) under a name that does not textually map
+# to horovod_tpu.run — pre-register the alias
+sys.modules.setdefault(
+    "horovod.run", importlib.import_module("horovod_tpu.runner")
+)

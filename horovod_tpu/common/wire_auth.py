@@ -1,0 +1,70 @@
+"""HMAC signing for the Python control-plane messages.
+
+Reference parity: horovod/runner/common/util/secret.py +
+network.py (SURVEY.md §2.4) — the reference signs every pickled
+driver/task RPC message with a per-job shared secret and rejects
+messages whose digest does not verify.  Here the analogous channels are
+the elastic driver <-> worker JSON-line sockets; the native negotiation
+star authenticates separately with a challenge-response hello
+(native/src/secret.h).
+
+The secret is the launcher-generated per-job nonce in ``HVD_TPU_SECRET``
+(tpurun exports it to every worker).  Signing is per-message (no
+sequence numbers): replay within one job's lifetime is accepted, exactly
+the reference's HMAC-of-payload property — the fresh per-job secret
+kills cross-job replay.  When no secret is set (bare single-host runs
+outside the launcher) messages pass unsigned, matching the reference's
+behavior when run without horovodrun.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import json
+import os
+from typing import Optional
+
+SECRET_ENV = "HVD_TPU_SECRET"
+
+
+def make_secret() -> str:
+    """Fresh per-job secret (reference: secret.make_secret_key)."""
+    return os.urandom(32).hex()
+
+
+def job_secret() -> Optional[str]:
+    return os.environ.get(SECRET_ENV) or None
+
+
+def _mac(secret: str, payload: str) -> str:
+    return hmac.new(secret.encode(), payload.encode(),
+                    hashlib.sha256).hexdigest()
+
+
+def sign_message(obj: dict, secret: Optional[str]) -> dict:
+    """Return a copy of ``obj`` carrying an ``hmac`` field over its
+    canonical JSON encoding; identity when no secret is configured."""
+    if not secret:
+        return obj
+    body = {k: v for k, v in obj.items() if k != "hmac"}
+    payload = json.dumps(body, sort_keys=True, separators=(",", ":"))
+    out = dict(body)
+    out["hmac"] = _mac(secret, payload)
+    return out
+
+
+def verify_message(obj: dict, secret: Optional[str]) -> Optional[dict]:
+    """Verify and strip the ``hmac`` field.  Returns the payload dict, or
+    None when a secret is configured and the signature is missing/wrong
+    (callers must drop the message / close the peer)."""
+    if not secret:
+        return obj
+    mac = obj.get("hmac")
+    if not isinstance(mac, str):
+        return None
+    body = {k: v for k, v in obj.items() if k != "hmac"}
+    payload = json.dumps(body, sort_keys=True, separators=(",", ":"))
+    if not hmac.compare_digest(mac, _mac(secret, payload)):
+        return None
+    return body

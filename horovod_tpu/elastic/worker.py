@@ -30,6 +30,7 @@ import socket
 import threading
 from typing import Optional
 
+from ..common import wire_auth
 from ..common.exceptions import HorovodInternalError, HostsUpdatedInterrupt
 from ..utils.logging import get_logger
 
@@ -106,6 +107,9 @@ def _free_local_port() -> int:
 
 
 def _send_line(sock: socket.socket, obj: dict) -> None:
+    # every control message carries the per-job HMAC (reference:
+    # secret.py-signed driver/task RPC; common/wire_auth.py)
+    obj = wire_auth.sign_message(obj, wire_auth.job_secret())
     sock.sendall((json.dumps(obj) + "\n").encode())
 
 
@@ -113,7 +117,15 @@ def _recv_line(f) -> Optional[dict]:
     line = f.readline()
     if not line:
         return None
-    return json.loads(line)
+    msg = wire_auth.verify_message(json.loads(line),
+                                   wire_auth.job_secret())
+    if msg is None:
+        # unsigned/forged message on an authenticated job: treat the
+        # peer as gone (same handling as EOF) rather than act on it
+        get_logger().warning(
+            "elastic: dropping control message with missing/invalid "
+            "signature")
+    return msg
 
 
 class WorkerNotificationManager:

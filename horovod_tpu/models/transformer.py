@@ -78,6 +78,12 @@ class TransformerConfig:
                 "'dot', 'flash' (windowed block-skip) and dense 'ring'; "
                 "the flash-block ring path has no windowed merge yet"
             )
+        kv = self.num_kv_heads
+        if kv is not None and (kv <= 0 or self.num_heads % kv):
+            raise ValueError(
+                f"num_heads ({self.num_heads}) must be a multiple of "
+                f"num_kv_heads ({kv})"
+            )
 
     @property
     def d_model(self) -> int:
@@ -148,13 +154,9 @@ class Attention(nn.Module):
         dense = functools.partial(
             nn.DenseGeneral, dtype=cfg.dtype, use_bias=False
         )
+        # divisibility/positivity validated in TransformerConfig.__post_init__
         kv_heads = (cfg.num_heads if cfg.num_kv_heads is None
                     else cfg.num_kv_heads)
-        if kv_heads <= 0 or cfg.num_heads % kv_heads:
-            raise ValueError(
-                f"num_heads ({cfg.num_heads}) must be a multiple of "
-                f"num_kv_heads ({kv_heads})"
-            )
         q = dense(features=(cfg.num_heads, cfg.head_dim), name="q")(x)
         k = dense(features=(kv_heads, cfg.head_dim), name="k")(x)
         v = dense(features=(kv_heads, cfg.head_dim), name="v")(x)

@@ -8,6 +8,7 @@
 // (native/controller.py), the pybind11-free binding path.
 #include <atomic>
 #include <chrono>
+#include <condition_variable>
 #include <cstdio>
 #include <cstring>
 #include <memory>
@@ -65,6 +66,12 @@ struct GlobalState {
   // just the queue window).
   std::mutex names_mu;
   std::set<std::string> active_names;
+  // enqueue -> background-loop wakeup: the idle sleep is a CV wait so a
+  // new submission is picked up immediately instead of waiting out the
+  // remainder of the cycle interval (up to cycle_time_ms of pure
+  // latency on every cold submission; PERF.md r5)
+  std::mutex wake_mu;
+  std::condition_variable wake_cv;
 };
 
 GlobalState* g() {
@@ -91,8 +98,13 @@ void BackgroundThreadLoop() {
     if (s->queue->Size() > 0 || s->controller->last_cycle_progress())
       continue;
     auto ms = s->params->cycle_time_ms();
-    std::this_thread::sleep_for(
-        std::chrono::duration<double, std::milli>(ms));
+    // interruptible idle wait: hvdtpu_enqueue* notifies, so a fresh
+    // submission starts negotiating immediately; peers' cycles align via
+    // the blocking GatherRequests/Bcast transport either way
+    std::unique_lock<std::mutex> lk(s->wake_mu);
+    s->wake_cv.wait_for(
+        lk, std::chrono::duration<double, std::milli>(ms),
+        [s] { return s->queue->Size() > 0 || s->shutdown.load(); });
   }
 }
 
@@ -256,7 +268,76 @@ long long hvdtpu_enqueue(long long entry_id, const char* name, int op,
   e.enqueued_at = hvdtpu::Clock::now();
   int64_t id = e.id;
   if (!s->queue->Add(std::move(e))) return -1;  // duplicate name pending
+  {
+    // lock-then-notify: without the lock the wake can land between the
+    // loop's predicate check and its block and be lost — the submission
+    // would wait out the full cycle interval again
+    std::lock_guard<std::mutex> wk(s->wake_mu);
+  }
+  s->wake_cv.notify_one();
   return id;
+}
+
+long long hvdtpu_enqueue_n(int n, const long long* entry_ids,
+                           const char* const* names, int op,
+                           const int* dtypes, const long long* shapes_flat,
+                           const int* ndims, int process_set,
+                           const char* group_key, int group_size,
+                           const int* root_or_rops, double prescale,
+                           double postscale) {
+  // Batched enqueue: one GIL release, one names check, one queue lock for
+  // the whole batch — the entries become visible to the background loop
+  // atomically, so a grouped call or an optimizer's backward-burst of
+  // gradients negotiates in ONE cycle (see TensorQueue::AddN).
+  // All-or-nothing: on any duplicate name nothing is enqueued.
+  auto* s = hvdtpu::g();
+  if (!s->initialized.load()) return -2;
+  if (s->loop_dead.load()) return -3;
+  std::vector<std::string> inserted;
+  inserted.reserve(n);
+  {
+    std::lock_guard<std::mutex> lk(s->names_mu);
+    for (int i = 0; i < n; ++i) {
+      std::string key =
+          std::string(names[i]) + "\x1f" + std::to_string(process_set);
+      if (!s->active_names.insert(key).second) {
+        for (const auto& k : inserted) s->active_names.erase(k);
+        return -1;  // duplicate (incl. within the batch)
+      }
+      inserted.push_back(std::move(key));
+    }
+  }
+  std::vector<hvdtpu::TensorTableEntry> batch;
+  batch.reserve(n);
+  size_t shape_off = 0;
+  auto now = hvdtpu::Clock::now();
+  for (int i = 0; i < n; ++i) {
+    hvdtpu::TensorTableEntry e;
+    e.id = entry_ids[i] > 0 ? entry_ids[i] : s->next_id.fetch_add(1);
+    e.name = names[i];
+    e.op = static_cast<hvdtpu::OpType>(op);
+    e.dtype = static_cast<hvdtpu::DataType>(dtypes[i]);
+    e.shape.assign(shapes_flat + shape_off, shapes_flat + shape_off + ndims[i]);
+    shape_off += ndims[i];
+    e.process_set_id = process_set;
+    e.group_key = group_key ? group_key : "";
+    e.group_size = group_size;
+    e.root_rank = root_or_rops[i];
+    e.prescale = prescale;
+    e.postscale = postscale;
+    e.enqueued_at = now;
+    batch.push_back(std::move(e));
+  }
+  if (!s->queue->AddN(std::move(batch))) {
+    std::lock_guard<std::mutex> lk(s->names_mu);
+    for (const auto& k : inserted) s->active_names.erase(k);
+    return -1;  // duplicate pending entry
+  }
+  {
+    std::lock_guard<std::mutex> wk(s->wake_mu);  // see hvdtpu_enqueue
+  }
+  s->wake_cv.notify_one();
+  return 0;
 }
 
 void hvdtpu_shutdown() {
@@ -269,6 +350,10 @@ void hvdtpu_shutdown() {
   // replaces them.
   s->initialized.store(false);
   s->shutdown.store(true);
+  {
+    std::lock_guard<std::mutex> wk(s->wake_mu);
+  }
+  s->wake_cv.notify_one();  // wake an idle loop so join() is immediate
   if (s->background.joinable()) s->background.join();
   if (s->timeline) s->timeline->Close();
   s->loop_dead.store(false);

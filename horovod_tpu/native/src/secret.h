@@ -1,0 +1,188 @@
+// Shared-secret authentication for the negotiation channel.
+//
+// Reference parity: horovod/runner/common/util/secret.py +
+// network.py's HMAC-signed driver/task RPC (SURVEY.md §2.4): the launcher
+// generates a per-job secret, hands it to workers out of band (env), and
+// every control-plane peer must prove possession before being admitted.
+// Here the proof is a mutual challenge-response on the TCP star's hello
+// (tcp_transport.h): both sides HMAC a fresh random challenge, so a
+// recorded hello cannot be replayed and neither a rogue worker nor a
+// port-squatting rogue coordinator is accepted.
+//
+// SHA-256 per FIPS 180-4, HMAC per RFC 2104.  Self-contained (no OpenSSL
+// dependency — the toolchain image carries none).
+#pragma once
+
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+namespace hvdtpu {
+namespace secret {
+
+class Sha256 {
+ public:
+  Sha256() { Reset(); }
+
+  void Reset() {
+    static const uint32_t init[8] = {0x6a09e667u, 0xbb67ae85u, 0x3c6ef372u,
+                                     0xa54ff53au, 0x510e527fu, 0x9b05688cu,
+                                     0x1f83d9abu, 0x5be0cd19u};
+    std::memcpy(h_, init, sizeof(h_));
+    len_ = 0;
+    buf_len_ = 0;
+  }
+
+  void Update(const void* data, size_t n) {
+    const uint8_t* p = static_cast<const uint8_t*>(data);
+    len_ += n;
+    while (n > 0) {
+      size_t take = 64 - buf_len_;
+      if (take > n) take = n;
+      std::memcpy(buf_ + buf_len_, p, take);
+      buf_len_ += take;
+      p += take;
+      n -= take;
+      if (buf_len_ == 64) {
+        Block(buf_);
+        buf_len_ = 0;
+      }
+    }
+  }
+
+  // 32-byte digest
+  std::string Final() {
+    uint64_t bits = len_ * 8;
+    uint8_t pad = 0x80;
+    Update(&pad, 1);
+    uint8_t zero = 0;
+    while (buf_len_ != 56) Update(&zero, 1);
+    uint8_t lenb[8];
+    for (int i = 0; i < 8; ++i)
+      lenb[i] = static_cast<uint8_t>(bits >> (56 - 8 * i));
+    Update(lenb, 8);
+    std::string out(32, '\0');
+    for (int i = 0; i < 8; ++i) {
+      out[4 * i] = static_cast<char>(h_[i] >> 24);
+      out[4 * i + 1] = static_cast<char>(h_[i] >> 16);
+      out[4 * i + 2] = static_cast<char>(h_[i] >> 8);
+      out[4 * i + 3] = static_cast<char>(h_[i]);
+    }
+    return out;
+  }
+
+ private:
+  static uint32_t Rotr(uint32_t x, int n) {
+    return (x >> n) | (x << (32 - n));
+  }
+
+  void Block(const uint8_t* p) {
+    static const uint32_t k[64] = {
+        0x428a2f98u, 0x71374491u, 0xb5c0fbcfu, 0xe9b5dba5u, 0x3956c25bu,
+        0x59f111f1u, 0x923f82a4u, 0xab1c5ed5u, 0xd807aa98u, 0x12835b01u,
+        0x243185beu, 0x550c7dc3u, 0x72be5d74u, 0x80deb1feu, 0x9bdc06a7u,
+        0xc19bf174u, 0xe49b69c1u, 0xefbe4786u, 0x0fc19dc6u, 0x240ca1ccu,
+        0x2de92c6fu, 0x4a7484aau, 0x5cb0a9dcu, 0x76f988dau, 0x983e5152u,
+        0xa831c66du, 0xb00327c8u, 0xbf597fc7u, 0xc6e00bf3u, 0xd5a79147u,
+        0x06ca6351u, 0x14292967u, 0x27b70a85u, 0x2e1b2138u, 0x4d2c6dfcu,
+        0x53380d13u, 0x650a7354u, 0x766a0abbu, 0x81c2c92eu, 0x92722c85u,
+        0xa2bfe8a1u, 0xa81a664bu, 0xc24b8b70u, 0xc76c51a3u, 0xd192e819u,
+        0xd6990624u, 0xf40e3585u, 0x106aa070u, 0x19a4c116u, 0x1e376c08u,
+        0x2748774cu, 0x34b0bcb5u, 0x391c0cb3u, 0x4ed8aa4au, 0x5b9cca4fu,
+        0x682e6ff3u, 0x748f82eeu, 0x78a5636fu, 0x84c87814u, 0x8cc70208u,
+        0x90befffau, 0xa4506cebu, 0xbef9a3f7u, 0xc67178f2u};
+    uint32_t w[64];
+    for (int i = 0; i < 16; ++i)
+      w[i] = (static_cast<uint32_t>(p[4 * i]) << 24) |
+             (static_cast<uint32_t>(p[4 * i + 1]) << 16) |
+             (static_cast<uint32_t>(p[4 * i + 2]) << 8) |
+             static_cast<uint32_t>(p[4 * i + 3]);
+    for (int i = 16; i < 64; ++i) {
+      uint32_t s0 = Rotr(w[i - 15], 7) ^ Rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+      uint32_t s1 = Rotr(w[i - 2], 17) ^ Rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+      w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    uint32_t a = h_[0], b = h_[1], c = h_[2], d = h_[3];
+    uint32_t e = h_[4], f = h_[5], g = h_[6], h = h_[7];
+    for (int i = 0; i < 64; ++i) {
+      uint32_t s1 = Rotr(e, 6) ^ Rotr(e, 11) ^ Rotr(e, 25);
+      uint32_t ch = (e & f) ^ (~e & g);
+      uint32_t t1 = h + s1 + ch + k[i] + w[i];
+      uint32_t s0 = Rotr(a, 2) ^ Rotr(a, 13) ^ Rotr(a, 22);
+      uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+      uint32_t t2 = s0 + maj;
+      h = g;
+      g = f;
+      f = e;
+      e = d + t1;
+      d = c;
+      c = b;
+      b = a;
+      a = t1 + t2;
+    }
+    h_[0] += a;
+    h_[1] += b;
+    h_[2] += c;
+    h_[3] += d;
+    h_[4] += e;
+    h_[5] += f;
+    h_[6] += g;
+    h_[7] += h;
+  }
+
+  uint32_t h_[8];
+  uint64_t len_ = 0;
+  uint8_t buf_[64];
+  size_t buf_len_ = 0;
+};
+
+inline std::string Sha256Digest(const std::string& data) {
+  Sha256 s;
+  s.Update(data.data(), data.size());
+  return s.Final();
+}
+
+// RFC 2104 HMAC-SHA256; returns the 32-byte raw mac.
+inline std::string HmacSha256(const std::string& key,
+                              const std::string& message) {
+  std::string k = key;
+  if (k.size() > 64) k = Sha256Digest(k);
+  k.resize(64, '\0');
+  std::string ipad(64, '\0'), opad(64, '\0');
+  for (int i = 0; i < 64; ++i) {
+    ipad[i] = static_cast<char>(k[i] ^ 0x36);
+    opad[i] = static_cast<char>(k[i] ^ 0x5c);
+  }
+  return Sha256Digest(opad + Sha256Digest(ipad + message));
+}
+
+// constant-time comparison (RFC 2104 verification guidance)
+inline bool MacEqual(const std::string& a, const std::string& b) {
+  if (a.size() != b.size()) return false;
+  unsigned char acc = 0;
+  for (size_t i = 0; i < a.size(); ++i)
+    acc |= static_cast<unsigned char>(a[i]) ^ static_cast<unsigned char>(b[i]);
+  return acc == 0;
+}
+
+// 16 random bytes from /dev/urandom (challenge nonce)
+inline std::string RandomChallenge() {
+  std::string out(16, '\0');
+  std::FILE* f = std::fopen("/dev/urandom", "rb");
+  if (f) {
+    size_t got = std::fread(&out[0], 1, out.size(), f);
+    std::fclose(f);
+    if (got == out.size()) return out;
+  }
+  // degraded fallback (no /dev/urandom): clock entropy — still unique
+  // per process start, and the secret itself remains required
+  uint64_t t = static_cast<uint64_t>(
+      std::chrono::steady_clock::now().time_since_epoch().count());
+  std::memcpy(&out[0], &t, sizeof(t));
+  return out;
+}
+
+}  // namespace secret
+}  // namespace hvdtpu

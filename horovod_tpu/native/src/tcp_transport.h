@@ -20,14 +20,17 @@
 #include <netinet/tcp.h>
 #include <poll.h>
 #include <sys/socket.h>
+#include <sys/time.h>
 #include <unistd.h>
 
 #include <chrono>
+#include <cstdlib>
 #include <cstring>
 #include <string>
 #include <thread>
 #include <vector>
 
+#include "secret.h"
 #include "thread_pool.h"
 #include "transport.h"
 
@@ -37,9 +40,18 @@ class TcpTransport : public Transport {
  public:
   // rank 0 binds+listens on port and accepts size-1 peers; others connect
   // with retry until timeout (rendezvous races with process startup).
+  //
+  // When HVD_TPU_SECRET is set (the tpurun launcher always sets a fresh
+  // per-job nonce), the hello is a mutual HMAC challenge-response
+  // (secret.h) — an unauthenticated peer reaching the port cannot join
+  // or poison negotiation, and a port-squatting rogue coordinator is
+  // rejected by the workers (reference: secret.py's HMAC-signed RPC,
+  // SURVEY.md §2.4).
   TcpTransport(const std::string& host, int port, int rank, int size,
                double timeout_sec = 60.0)
       : rank_(rank), size_(size) {
+    const char* sec = std::getenv("HVD_TPU_SECRET");
+    secret_ = sec ? sec : "";
     if (rank == 0) {
       AcceptPeers(port, timeout_sec);
     } else {
@@ -142,15 +154,62 @@ class TcpTransport : public Transport {
       int fd = ::accept(listen_fd_, nullptr, nullptr);
       if (fd < 0) continue;
       SetNoDelay(fd);
+      // bounded hello: a connector that stalls mid-handshake (slowloris)
+      // must not pin the accept loop past the rendezvous deadline
+      SetRecvTimeout(fd, 5.0);
       int32_t peer_rank = -1;
       if (!ReadAll(fd, &peer_rank, 4) || peer_rank <= 0 ||
           peer_rank >= size_) {
         ::close(fd);
         continue;
       }
+      if (!secret_.empty() && !AuthenticatePeer(fd, peer_rank)) {
+        // unauthenticated peer on the negotiation port: reject the
+        // connection, keep listening for the real rank (the rogue must
+        // not consume the rank slot)
+        ::close(fd);
+        continue;
+      }
+      SetRecvTimeout(fd, 0.0);  // steady state: blocking frame reads
       peer_fds_[peer_rank] = fd;
       ++accepted;
     }
+  }
+
+  // Coordinator side of the mutual handshake; false = reject.
+  // Wire: <- rank(4) already read; <- Cw(16); -> Cr(16) +
+  // HMAC(secret, "coord" + Cw)(32); <- HMAC(secret, "rank" + rank + Cr)(32).
+  bool AuthenticatePeer(int fd, int32_t peer_rank) {
+    std::string cw(16, '\0');
+    if (!ReadAll(fd, &cw[0], cw.size())) return false;
+    std::string cr = secret::RandomChallenge();
+    std::string my_proof = secret::HmacSha256(secret_, "coord" + cw);
+    if (!WriteAll(fd, cr.data(), cr.size()) ||
+        !WriteAll(fd, my_proof.data(), my_proof.size()))
+      return false;
+    std::string proof(32, '\0');
+    if (!ReadAll(fd, &proof[0], proof.size())) return false;
+    std::string want = secret::HmacSha256(
+        secret_, "rank" + std::string(reinterpret_cast<char*>(&peer_rank),
+                                      4) + cr);
+    return secret::MacEqual(proof, want);
+  }
+
+  // Worker side of the mutual handshake; false = tear down and fail.
+  bool AuthenticateToRoot(int fd) {
+    std::string cw = secret::RandomChallenge();
+    if (!WriteAll(fd, cw.data(), cw.size())) return false;
+    std::string cr(16, '\0'), coord_proof(32, '\0');
+    if (!ReadAll(fd, &cr[0], cr.size()) ||
+        !ReadAll(fd, &coord_proof[0], coord_proof.size()))
+      return false;
+    std::string want = secret::HmacSha256(secret_, "coord" + cw);
+    if (!secret::MacEqual(coord_proof, want)) return false;  // rogue root
+    int32_t my_rank = rank_;
+    std::string proof = secret::HmacSha256(
+        secret_, "rank" + std::string(reinterpret_cast<char*>(&my_rank),
+                                      4) + cr);
+    return WriteAll(fd, proof.data(), proof.size());
   }
 
   void ConnectToRoot(const std::string& host, int port, double timeout_sec) {
@@ -171,8 +230,15 @@ class TcpTransport : public Transport {
       if (fd >= 0 && ::connect(fd, res->ai_addr, res->ai_addrlen) == 0) {
         ::freeaddrinfo(res);
         SetNoDelay(fd);
+        // bounded handshake on the worker side too: a port-squatter
+        // that accepts and then sends nothing must not pin the worker
+        // past its rendezvous deadline (mirror of the coordinator's
+        // slowloris guard)
+        SetRecvTimeout(fd, 5.0);
         int32_t my_rank = rank_;
-        if (WriteAll(fd, &my_rank, 4)) {
+        if (WriteAll(fd, &my_rank, 4) &&
+            (secret_.empty() || AuthenticateToRoot(fd))) {
+          SetRecvTimeout(fd, 0.0);  // steady state: blocking reads
           root_fd_ = fd;
           return;
         }
@@ -190,6 +256,13 @@ class TcpTransport : public Transport {
   static void SetNoDelay(int fd) {
     int one = 1;
     ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  }
+
+  static void SetRecvTimeout(int fd, double sec) {
+    timeval tv{};
+    tv.tv_sec = static_cast<time_t>(sec);
+    tv.tv_usec = static_cast<suseconds_t>((sec - tv.tv_sec) * 1e6);
+    ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
   }
 
   static bool ReadAll(int fd, void* buf, size_t n) {
@@ -229,6 +302,7 @@ class TcpTransport : public Transport {
 
   int rank_;
   int size_;
+  std::string secret_;
   int listen_fd_ = -1;
   int root_fd_ = -1;
   std::vector<int> peer_fds_;

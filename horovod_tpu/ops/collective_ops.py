@@ -125,18 +125,33 @@ def _native(tensor=None):
 def _native_submit(tree, op_type, name, builder_extra=None, **enqueue_kw):
     """Route a pytree through the C++ controller: one TensorQueue entry per
     leaf; the background thread negotiates, fuses across entries, and the
-    exec callback launches the compiled XLA collective (§3.2 hot path)."""
+    exec callback launches the compiled XLA collective (§3.2 hot path).
+
+    Multi-leaf named submissions without splits go through the batched C
+    entry point (one GIL release / one queue lock), so the whole pytree
+    lands in a single negotiation cycle — per-entry enqueue measurably
+    trickles entries across cycles (~1 ms each; PERF.md r5)."""
     ctrl = _native()
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     leaves = [jnp.asarray(x) for x in leaves]
-    futures = [
-        ctrl.enqueue(
-            leaf, op_type,
-            name=(f"{name}.{i}" if name else None),
-            **enqueue_kw,
+    if (name and len(leaves) > 1 and ctrl.supports_batch
+            and enqueue_kw.get("splits") is None
+            and enqueue_kw.get("extra") is None):
+        kw = {k: v for k, v in enqueue_kw.items()
+              if k not in ("splits", "extra")}
+        futures = ctrl.enqueue_batch(
+            leaves, [f"{name}.{i}" for i in range(len(leaves))],
+            op_type, **kw,
         )
-        for i, leaf in enumerate(leaves)
-    ]
+    else:
+        futures = [
+            ctrl.enqueue(
+                leaf, op_type,
+                name=(f"{name}.{i}" if name else None),
+                **enqueue_kw,
+            )
+            for i, leaf in enumerate(leaves)
+        ]
     builder = builder_extra or (
         lambda vals: jax.tree_util.tree_unflatten(treedef, vals)
     )
@@ -257,6 +272,58 @@ def grouped_allreduce(
     )
 
 
+def allreduce_multi_async(
+    tensors: Sequence[Any],
+    names: Sequence[str],
+    op: Optional[ReduceOp] = None,
+    average: Optional[bool] = None,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+    process_set: Optional[ProcessSet] = None,
+) -> List[Handle]:
+    """N INDEPENDENT named allreduces submitted in one batched native
+    call, returning one handle per tensor.
+
+    Unlike ``grouped_allreduce`` these are not released atomically — each
+    negotiates under its own name, so rank-varying batch composition is
+    safe (the batching is a submission-side optimization only).  This is
+    the DistributedOptimizer's backward-burst path: the submit worker
+    drains every gradient that became ready and enqueues them in one GIL
+    window, so they ride a single negotiation cycle (reference analog:
+    the reference's background thread naturally coalescing the hooks'
+    EnqueueTensorAllreduce calls into one ComputeResponseList pass)."""
+    assert len(tensors) == len(names)
+    rop = _normalize_op(op, average)
+    arrays = [jnp.asarray(t) for t in tensors]
+    ctrl = _native(arrays)
+    if ctrl is not None and ctrl.supports_batch and len(arrays) > 1:
+        from ..native.controller import OP_ALLREDUCE
+
+        # ".0" leaf suffix: EXACTLY the wire name allreduce_async(name=n)
+        # submits for a single-leaf tree.  Batch composition is timing-
+        # dependent and rank-local, so a rank that drains this tensor in
+        # a 1-element batch (the allreduce_async fallback below) must
+        # produce the same wire name as a rank that batched it — a
+        # mismatch pends both sides forever (caught by the stall
+        # inspector as `name` vs `name.0` during the r5 torch rework).
+        futures = ctrl.enqueue_batch(
+            arrays, [f"{n}.0" for n in names], OP_ALLREDUCE,
+            reduce_op=int(rop),
+            prescale=prescale_factor, postscale=postscale_factor,
+            process_set_id=(process_set.process_set_id
+                            if process_set is not None else 0),
+        )
+        return [Handle(futures=[f], builder=lambda vals: vals[0])
+                for f in futures]
+    return [
+        allreduce_async(a, name=n, op=rop,
+                        prescale_factor=prescale_factor,
+                        postscale_factor=postscale_factor,
+                        process_set=process_set)
+        for a, n in zip(arrays, names)
+    ]
+
+
 def grouped_allreduce_async(
     tensors: Sequence[Any], **kwargs
 ) -> Handle:
@@ -273,8 +340,11 @@ def grouped_allreduce_async(
 
         name = kwargs.pop("name", None) or ctrl.auto_group_name(OP_ALLREDUCE)
         group_key = f"{name}#{ctrl.group_call_seq(name)}"
+        # member entries are named off group_key (not the bare name) so a
+        # late straggler of an errored call and a retry can never share a
+        # coordinator-table key (the retry's seq makes its names fresh)
         return _native_submit(
-            list(tensors), OP_ALLREDUCE, name,
+            list(tensors), OP_ALLREDUCE, group_key,
             reduce_op=int(rop), group_key=group_key, group_size=n_leaves,
             prescale=kwargs.pop("prescale_factor", 1.0),
             postscale=kwargs.pop("postscale_factor", 1.0),
@@ -525,8 +595,9 @@ def grouped_reducescatter_async(
 
         name = name or ctrl.auto_group_name(OP_REDUCESCATTER)
         group_key = f"{name}#{ctrl.group_call_seq(name)}"
+        # entry names off group_key: see grouped_allreduce_async
         return _native_submit(
-            list(tensors), OP_REDUCESCATTER, name,
+            list(tensors), OP_REDUCESCATTER, group_key,
             reduce_op=int(op), group_key=group_key, group_size=n_leaves,
             process_set_id=(
                 process_set.process_set_id if process_set is not None

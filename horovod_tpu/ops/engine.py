@@ -326,6 +326,86 @@ class CollectiveEngine:
         )
         return self._local_view(g)
 
+    def allreduce_multi(
+        self,
+        xs: Sequence[jax.Array],
+        op: ReduceOp = ReduceOp.AVERAGE,
+        prescale_factor: float = 1.0,
+        postscale_factor: float = 1.0,
+        process_set: Optional[ProcessSet] = None,
+        max_signatures: int = 64,
+    ) -> Optional[List[jax.Array]]:
+        """N same-dtype allreduces in ONE compiled program — no host
+        fusion buffer.
+
+        The controller's fused exec path packs multi-entry buckets into a
+        flat host buffer (composition-insensitive, but a measured ~1 ms
+        of memcpy + host sync per response; PERF.md r5).  Training loops
+        re-submit the SAME bucket composition every step, so compiling a
+        multi-argument program keyed on the shape tuple hits the
+        executable cache from step 2 on and keeps the whole response on
+        device.  Returns None when the caller should use the host-pack
+        fallback instead: non-SUM/AVERAGE ops, or more than
+        ``max_signatures`` distinct compositions already compiled (the
+        recompile-churn guard — arrival-timing-dependent compositions
+        must not each compile a fresh executable)."""
+        if op not in (ReduceOp.AVERAGE, ReduceOp.SUM):
+            return None
+        ctx = self._member_ctx(process_set)
+        xs = [jnp.asarray(x) for x in xs]
+        if any(x.dtype == jnp.bool_ for x in xs):
+            # bool has no psum/fill semantics (same guard as the
+            # single-tensor path): host-pack fallback handles it
+            return None
+        if ctx.n == 1:
+            scale = prescale_factor * postscale_factor
+            if scale != 1.0:
+                return [x * jnp.asarray(scale, x.dtype) for x in xs]
+            return list(xs)
+        n = ctx.n
+        key = (
+            "allreduce_multi",
+            tuple((x.shape, str(x.dtype)) for x in xs),
+            int(op),
+        )
+        if key + (ctx.set_id,) not in self._cache:
+            n_sigs = sum(
+                1 for k in self._cache if k[0] == "allreduce_multi"
+            )
+            if n_sigs >= max_signatures:
+                return None
+
+        def make_body():
+            lead = jnp.asarray(ctx.lead_slots)
+
+            def body(pre, post, *aa):
+                idx = jax.lax.axis_index(WORLD_AXIS)
+                is_lead = jnp.any(idx == lead)
+                outs = []
+                for a in aa:
+                    a0 = a[0]
+                    v = jnp.where(is_lead, a0 * pre, jnp.zeros_like(a0))
+                    red = jax.lax.psum(v, WORLD_AXIS)
+                    if op == ReduceOp.AVERAGE:
+                        red = red / jnp.asarray(n, red.dtype)
+                    outs.append(red * post)
+                return tuple(outs)
+
+            return body
+
+        compiled = self._compile_spmd(
+            key, make_body, ctx,
+            in_specs=(P(), P()) + (P(WORLD_AXIS),) * len(xs),
+        )
+        dt = xs[0].dtype
+        g = self._run(
+            compiled,
+            jnp.asarray(prescale_factor, dt),
+            jnp.asarray(postscale_factor, dt),
+            *[self._stacked_global(x, ctx) for x in xs],
+        )
+        return [self._local_view(o) for o in g]
+
     def _exchange_extents(
         self, values: Sequence[int],
         process_set: Optional[ProcessSet] = None,

@@ -51,7 +51,10 @@ def _kb_range(q_off, block_q, block_k, padded_kb, causal, window):
     the causal diagonal / sliding window (this skip is where the windowed
     kernel's compute drops from O(S²) to O(S·W))."""
     if causal:
-        hi = jax.lax.div(q_off + block_q - 1, block_k) + 1
+        # clamp to padded_kb: when block_q > block_k the last Q block's
+        # diagonal bound can point one K block past the padded K extent
+        hi = jnp.minimum(
+            padded_kb, jax.lax.div(q_off + block_q - 1, block_k) + 1)
     elif window is not None:
         hi = jnp.minimum(
             padded_kb,

@@ -33,10 +33,28 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ..common import wire_auth
 from ..elastic.worker import ENV_DRIVER, ENV_ELASTIC, ENV_WORKER_ID
 from ..utils.logging import get_logger
 
 _LOCAL_HOSTS = ("localhost", "127.0.0.1")
+
+
+def _signed_line(obj: dict) -> bytes:
+    """One HMAC-signed JSON line (reference: secret.py-signed RPC)."""
+    return (json.dumps(
+        wire_auth.sign_message(obj, wire_auth.job_secret())
+    ) + "\n").encode()
+
+
+def _verified(msg: dict) -> Optional[dict]:
+    """Verify+strip the signature; None = forged/unsigned (drop peer)."""
+    out = wire_auth.verify_message(msg, wire_auth.job_secret())
+    if out is None:
+        get_logger().warning(
+            "elastic driver: dropping control message with "
+            "missing/invalid signature")
+    return out
 
 
 def _free_port() -> int:
@@ -125,6 +143,10 @@ class ElasticDriver:
             os.environ.get("HVD_TPU_ELASTIC_TIMEOUT", "600")
         )
         self.verbose = verbose
+        # per-job control-plane secret: signs the driver<->worker JSON
+        # lines AND the workers' native-star hello; exported through the
+        # driver's own environ so _spawn's env copies inherit it
+        os.environ.setdefault(wire_auth.SECRET_ENV, wire_auth.make_secret())
 
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
@@ -170,6 +192,10 @@ class ElasticDriver:
         except (OSError, ValueError):
             conn.close()
             return
+        msg = _verified(msg)
+        if msg is None:
+            conn.close()
+            return
         kind = msg.get("type")
         wid = msg.get("worker_id")
         if kind == "register":
@@ -200,15 +226,24 @@ class ElasticDriver:
         if host in _LOCAL_HOSTS:
             proc = subprocess.Popen(self.command, env=env)
         else:
+            # secret via ssh stdin, never the argv (cmdline is world-
+            # readable on both hosts for the job's lifetime)
+            secret = env.get(wire_auth.SECRET_ENV, "")
             env_prefix = " ".join(
                 f"{k}={subprocess.list2cmdline([v])}"
-                for k, v in env.items() if k.startswith("HVD_TPU_")
+                for k, v in env.items()
+                if k.startswith("HVD_TPU_") and k != wire_auth.SECRET_ENV
             )
-            remote = (f"cd {os.getcwd()} && {env_prefix} "
+            remote = (f"IFS= read -r {wire_auth.SECRET_ENV} && "
+                      f"export {wire_auth.SECRET_ENV} && "
+                      f"cd {os.getcwd()} && {env_prefix} "
                       + subprocess.list2cmdline(self.command))
             proc = subprocess.Popen(
-                ["ssh", "-o", "StrictHostKeyChecking=no", host, remote]
+                ["ssh", "-o", "StrictHostKeyChecking=no", host, remote],
+                stdin=subprocess.PIPE,
             )
+            proc.stdin.write((secret + "\n").encode())
+            proc.stdin.close()
         w = _Worker(wid, host, slot, proc)
         self._workers[wid] = w
         if self.verbose:
@@ -271,13 +306,11 @@ class ElasticDriver:
         """Ask the rank-0-elect worker to allocate the epoch's
         coordinator + native ports on its host."""
         try:
-            sock.sendall(
-                (json.dumps({"type": "allocate_ports"}) + "\n").encode()
-            )
+            sock.sendall(_signed_line({"type": "allocate_ports"}))
             sock.settimeout(30)
-            reply = json.loads(sock.makefile("r").readline())
+            reply = _verified(json.loads(sock.makefile("r").readline()))
             sock.settimeout(None)
-            if reply.get("type") != "ports":
+            if reply is None or reply.get("type") != "ports":
                 return None
             return reply
         except (OSError, ValueError):
@@ -290,10 +323,10 @@ class ElasticDriver:
         dead = []
         for wid, sock in self._notify_socks.items():
             try:
-                sock.sendall((json.dumps(
+                sock.sendall(_signed_line(
                     {"type": "hosts_updated", "epoch": self._epoch,
                      "failure": failure}
-                ) + "\n").encode())
+                ))
             except OSError:
                 dead.append(wid)
         for wid in dead:
@@ -357,7 +390,7 @@ class ElasticDriver:
                     "epoch": self._epoch,
                 }
                 try:
-                    sock.sendall((json.dumps(reply) + "\n").encode())
+                    sock.sendall(_signed_line(reply))
                 except OSError:
                     pass
                 sock.close()
@@ -367,9 +400,7 @@ class ElasticDriver:
             for wid, sock in list(self._pending_rendezvous.items()):
                 if wid not in members:
                     try:
-                        sock.sendall(
-                            (json.dumps({"type": "shutdown"}) + "\n").encode()
-                        )
+                        sock.sendall(_signed_line({"type": "shutdown"}))
                     except OSError:
                         pass
                     sock.close()

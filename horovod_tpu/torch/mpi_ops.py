@@ -1,13 +1,31 @@
-"""Torch tensor collectives over the XLA engine.
+"""Torch tensor collectives over the XLA engine — dlpack zero-copy bridge.
 
 Reference parity: horovod/torch/mpi_ops.py + the C++ binding it fronts
 (torch/mpi_ops_v2.cc, adapter_v2.cc, handle_manager.cc — SURVEY.md §2.3).
-The reference wraps ``at::Tensor`` into ``common::Tensor`` and enqueues to
-the background thread; here a CPU torch tensor is viewed as numpy
-(zero-copy), routed through the same eager engine the JAX API uses, and
-the result copied back.  Handles mirror the reference's int-keyed
-HandleManager: ``*_async`` returns a handle consumed by ``synchronize`` /
-``poll``.
+The reference wraps ``at::Tensor`` into ``common::Tensor`` without copying
+and enqueues to the background thread; here a CPU torch tensor crosses
+into the engine via **dlpack** (``jnp.from_dlpack`` — zero-copy aliasing
+on the CPU backend, the exact analog of the reference's TensorAdapter
+wrapping the at::Tensor's storage), is negotiated/fused/executed by the
+same engine the JAX API uses, and the result crosses back as a dlpack
+view of the XLA output buffer.  There is no numpy round-trip on the hot
+path.  Handles mirror the reference's int-keyed HandleManager:
+``*_async`` returns a handle consumed by ``synchronize`` / ``poll``.
+
+Aliasing contracts (both are the reference's own semantics):
+  * input: the engine reads the torch storage when the collective
+    executes, not at call time — mutating the tensor between ``*_async``
+    and ``synchronize`` is a race, exactly as with the reference's NCCL
+    path reading the grad buffer at launch time;
+  * output: XLA result buffers are immutable, so out-of-place ops hand
+    the user a one-memcpy clone they own, and in-place ops ``copy_`` into
+    the caller's buffer (what the reference's memcpyOutOfFusionBuffer
+    does).  The dlpack *view* itself is never exposed writable.
+
+On a non-CPU default backend (running this bridge against the TPU chip)
+dlpack import would pin the array to the CPU platform, so the bridge
+falls back to the host-copy path there — torch has no TPU storage to
+alias; the TPU compute path is the JAX API.
 
 In-place variants (``allreduce_`` etc.) write the result back into the
 input tensor, matching reference semantics.
@@ -18,6 +36,8 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 import torch
 
@@ -26,19 +46,40 @@ from ..ops import collective_ops as _ops
 from ..ops.reduce_ops import ReduceOp
 
 
-def _to_np(t: torch.Tensor) -> np.ndarray:
+def _to_jax(t: torch.Tensor) -> jax.Array:
+    """Torch -> engine, zero-copy when possible (reference: adapter_v2.cc
+    wrapping at::Tensor storage into common::Tensor without a copy)."""
     if t.device.type != "cpu":
         raise ValueError(
             "horovod_tpu.torch bridges CPU tensors; move the tensor to CPU "
             "first (the TPU compute path is the JAX API)"
         )
-    return t.detach().contiguous().numpy()
+    t = t.detach()
+    if not t.is_contiguous():
+        t = t.contiguous()
+    if jax.default_backend() == "cpu":
+        try:
+            return jnp.from_dlpack(t)
+        except Exception:
+            pass  # exotic dtype/layout: host-copy fallback below
+    return jnp.asarray(t.numpy())
 
 
-def _from_np(a, like: torch.Tensor) -> torch.Tensor:
-    # copy: the source is an immutable XLA buffer view; handing torch a
-    # writable alias of it would be undefined behavior
-    return torch.from_numpy(np.array(a, copy=True)).to(like.dtype)
+def _result_view(a) -> torch.Tensor:
+    """Zero-copy torch view of an engine result.  The XLA buffer is
+    immutable — callers must never write through this view; they either
+    ``.to(copy=True)`` it (out-of-place ops) or ``copy_`` FROM it
+    (in-place ops)."""
+    try:
+        return torch.from_dlpack(a)
+    except Exception:
+        return torch.from_numpy(np.array(a, copy=True))
+
+
+def _from_engine(a, like: torch.Tensor) -> torch.Tensor:
+    # exactly one memcpy: the dlpack view aliases the immutable XLA
+    # buffer; the clone is the user-owned, freely mutable result tensor
+    return _result_view(a).to(like.dtype, copy=True)
 
 
 class _HandleManager:
@@ -90,23 +131,37 @@ def allreduce_async(tensor: torch.Tensor, average: Optional[bool] = None,
                     postscale_factor: float = 1.0,
                     process_set: Optional[ProcessSet] = None) -> int:
     inner = _ops.allreduce_async(
-        _to_np(tensor), average=average, name=name, op=op,
+        _to_jax(tensor), average=average, name=name, op=op,
         prescale_factor=prescale_factor, postscale_factor=postscale_factor,
         process_set=process_set,
     )
-    return _handles.allocate(inner, lambda out: _from_np(out, tensor))
+    return _handles.allocate(inner, lambda out: _from_engine(out, tensor))
 
 
 def allreduce(tensor: torch.Tensor, **kwargs) -> torch.Tensor:
     return synchronize(allreduce_async(tensor, **kwargs))
 
 
+def allreduce_multi_async(tensors: Sequence[torch.Tensor],
+                          names: Sequence[str], **kwargs) -> List[int]:
+    """N independent named allreduces, one batched native submission,
+    one handle per tensor (the DistributedOptimizer backward-burst path;
+    see ops.collective_ops.allreduce_multi_async)."""
+    inners = _ops.allreduce_multi_async(
+        [_to_jax(t) for t in tensors], names, **kwargs
+    )
+    return [
+        _handles.allocate(inner, (lambda t: lambda out: _from_engine(out, t))(t))
+        for inner, t in zip(inners, tensors)
+    ]
+
+
 def allreduce_async_(tensor: torch.Tensor, **kwargs) -> int:
     """In-place async allreduce (reference: allreduce_async_)."""
-    inner = _ops.allreduce_async(_to_np(tensor), **kwargs)
+    inner = _ops.allreduce_async(_to_jax(tensor), **kwargs)
 
     def finalize(out):
-        tensor.copy_(_from_np(out, tensor))
+        tensor.copy_(_result_view(out))
         return tensor
 
     return _handles.allocate(inner, finalize)
@@ -119,11 +174,11 @@ def allreduce_(tensor: torch.Tensor, **kwargs) -> torch.Tensor:
 def grouped_allreduce_async(tensors: Sequence[torch.Tensor],
                             **kwargs) -> int:
     inner = _ops.grouped_allreduce_async(
-        [_to_np(t) for t in tensors], **kwargs
+        [_to_jax(t) for t in tensors], **kwargs
     )
 
     def finalize(outs):
-        return [_from_np(o, t) for o, t in zip(outs, tensors)]
+        return [_from_engine(o, t) for o, t in zip(outs, tensors)]
 
     return _handles.allocate(inner, finalize)
 
@@ -135,12 +190,12 @@ def grouped_allreduce(tensors: Sequence[torch.Tensor], **kwargs) -> list:
 def grouped_allreduce_async_(tensors: Sequence[torch.Tensor],
                              **kwargs) -> int:
     inner = _ops.grouped_allreduce_async(
-        [_to_np(t) for t in tensors], **kwargs
+        [_to_jax(t) for t in tensors], **kwargs
     )
 
     def finalize(outs):
         for o, t in zip(outs, tensors):
-            t.copy_(_from_np(o, t))
+            t.copy_(_result_view(o))
         return list(tensors)
 
     return _handles.allocate(inner, finalize)
@@ -155,9 +210,9 @@ def grouped_allreduce_(tensors: Sequence[torch.Tensor], **kwargs) -> list:
 
 def allgather_async(tensor: torch.Tensor, name: Optional[str] = None,
                     process_set: Optional[ProcessSet] = None) -> int:
-    inner = _ops.allgather_async(_to_np(tensor), name=name,
+    inner = _ops.allgather_async(_to_jax(tensor), name=name,
                                  process_set=process_set)
-    return _handles.allocate(inner, lambda out: _from_np(out, tensor))
+    return _handles.allocate(inner, lambda out: _from_engine(out, tensor))
 
 
 def allgather(tensor: torch.Tensor, **kwargs) -> torch.Tensor:
@@ -170,9 +225,9 @@ def grouped_allgather(tensors: Sequence[torch.Tensor],
     """Reference: torch grouped_allgather — one fused dim0-table
     exchange + per-dtype-bucket gather (ops/collective_ops.py)."""
     outs = _ops.grouped_allgather(
-        [_to_np(t) for t in tensors], name=name, process_set=process_set
+        [_to_jax(t) for t in tensors], name=name, process_set=process_set
     )
-    return [_from_np(o, t) for o, t in zip(outs, tensors)]
+    return [_from_engine(o, t) for o, t in zip(outs, tensors)]
 
 
 # -- broadcast ---------------------------------------------------------------
@@ -181,9 +236,9 @@ def grouped_allgather(tensors: Sequence[torch.Tensor],
 def broadcast_async(tensor: torch.Tensor, root_rank: int,
                     name: Optional[str] = None,
                     process_set: Optional[ProcessSet] = None) -> int:
-    inner = _ops.broadcast_async(_to_np(tensor), root_rank, name=name,
+    inner = _ops.broadcast_async(_to_jax(tensor), root_rank, name=name,
                                  process_set=process_set)
-    return _handles.allocate(inner, lambda out: _from_np(out, tensor))
+    return _handles.allocate(inner, lambda out: _from_engine(out, tensor))
 
 
 def broadcast(tensor: torch.Tensor, root_rank: int, **kwargs) -> torch.Tensor:
@@ -192,10 +247,10 @@ def broadcast(tensor: torch.Tensor, root_rank: int, **kwargs) -> torch.Tensor:
 
 def broadcast_async_(tensor: torch.Tensor, root_rank: int,
                      **kwargs) -> int:
-    inner = _ops.broadcast_async(_to_np(tensor), root_rank, **kwargs)
+    inner = _ops.broadcast_async(_to_jax(tensor), root_rank, **kwargs)
 
     def finalize(out):
-        tensor.copy_(_from_np(out, tensor))
+        tensor.copy_(_result_view(out))
         return tensor
 
     return _handles.allocate(inner, finalize)
@@ -212,8 +267,8 @@ def alltoall_async(tensor: torch.Tensor,
                    splits: Optional[torch.Tensor] = None,
                    name: Optional[str] = None,
                    process_set: Optional[ProcessSet] = None) -> int:
-    np_splits = None if splits is None else _to_np(splits)
-    inner = _ops.alltoall_async(_to_np(tensor), splits=np_splits, name=name,
+    np_splits = None if splits is None else _to_jax(splits)
+    inner = _ops.alltoall_async(_to_jax(tensor), splits=np_splits, name=name,
                                 process_set=process_set)
 
     def finalize(out):
@@ -221,7 +276,7 @@ def alltoall_async(tensor: torch.Tensor,
         # np.array(copy=True): recv_splits can arrive as a read-only
         # buffer view, and from_numpy on one yields a tensor whose
         # in-place writes are undefined behavior (ADVICE round 3)
-        return (_from_np(received, tensor),
+        return (_from_engine(received, tensor),
                 torch.from_numpy(
                     np.array(recv_splits, copy=True)).to(torch.int32))
 
@@ -235,9 +290,9 @@ def alltoall(tensor: torch.Tensor, **kwargs):
 def reducescatter_async(tensor: torch.Tensor, op: Optional[ReduceOp] = None,
                         name: Optional[str] = None,
                         process_set: Optional[ProcessSet] = None) -> int:
-    inner = _ops.reducescatter_async(_to_np(tensor), op=op, name=name,
+    inner = _ops.reducescatter_async(_to_jax(tensor), op=op, name=name,
                                      process_set=process_set)
-    return _handles.allocate(inner, lambda out: _from_np(out, tensor))
+    return _handles.allocate(inner, lambda out: _from_engine(out, tensor))
 
 
 def reducescatter(tensor: torch.Tensor, **kwargs) -> torch.Tensor:
@@ -249,11 +304,11 @@ def grouped_reducescatter_async(tensors: Sequence[torch.Tensor],
     """Reference: torch grouped_reducescatter — atomic group release via
     the native GroupTable id."""
     inner = _ops.grouped_reducescatter_async(
-        [_to_np(t) for t in tensors], **kwargs
+        [_to_jax(t) for t in tensors], **kwargs
     )
 
     def finalize(outs):
-        return [_from_np(o, t) for o, t in zip(outs, tensors)]
+        return [_from_engine(o, t) for o, t in zip(outs, tensors)]
 
     return _handles.allocate(inner, finalize)
 

@@ -9,11 +9,26 @@ aggregation over ``backward_passes_per_step`` is preserved.
 The reference registers hooks on the autograd graph's grad accumulator
 nodes; modern torch exposes the same moment directly via
 ``register_post_accumulate_grad_hook``, which we use.
+
+Overlap: the hook body itself does no bridge/enqueue work on the
+autograd thread — it posts the parameter to a single submission worker
+and returns, so backward proceeds while compression + the dlpack bridge
++ engine enqueue happen concurrently and negotiation overlaps the rest
+of the backward pass (the reference gets this overlap from its
+background thread consuming the hook's immediate EnqueueTensorAllreduce;
+here the enqueue itself is also off the critical path).  The single
+worker preserves submission order; ``synchronize()`` first drains the
+worker (re-raising any submit-side error), then waits the engine
+futures.
 """
 
 from __future__ import annotations
 
 import contextlib
+import os
+import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
 from typing import Iterable, Optional, Tuple
 
 import torch
@@ -48,6 +63,14 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         self._synchronized = False
         self._should_synchronize = True
         self._hook_handles = []
+        # one worker: keeps per-process submission order deterministic
+        # while taking the bridge+enqueue off the autograd thread
+        self._submit_pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="hvd_torch_submit")
+        self._pending_submits = []
+        # grads whose hooks fired but which no worker drain has picked up
+        # yet; appended on the autograd thread, drained on the worker
+        self._ready_params = deque()
         self._register_hooks()
 
     # -- hooks --------------------------------------------------------------
@@ -68,28 +91,76 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             self._passes[p] += 1
             if self._passes[p] == self.backward_passes_per_step:
                 self._passes[p] = 0
-                self._allreduce_grad_async(p)
+                # post-and-return: backward continues while the worker
+                # compresses, bridges and enqueues this grad.  While the
+                # worker is busy with one drain, later hooks pile their
+                # params here and the NEXT drain submits them as one
+                # batched native call (micro-batching by readiness).
+                self._ready_params.append(p)
+                self._pending_submits.append(
+                    self._submit_pool.submit(self._drain_ready))
         return hook
 
-    def _allreduce_grad_async(self, p):
-        name = self._param_names.get(p, "allreduce.noname")
-        grad = p.grad
-        if self.backward_passes_per_step > 1:
-            grad = grad / self.backward_passes_per_step
-        if self._gradient_predivide_factor != 1.0:
-            grad = grad / self._gradient_predivide_factor
-        compressed, ctx = self._compression.compress(grad)
-        handle = mpi_ops.allreduce_async(
-            compressed, name=name, op=self._op,
-            process_set=self._process_set,
+    def _drain_ready(self):
+        """Worker-side: submit every gradient that became ready.  Batch
+        composition is timing-dependent and rank-local, which is safe
+        because the entries negotiate under their own per-param names
+        (NOT as an atomic group — group membership must be rank-
+        symmetric); the batching only shaves submission latency.
+
+        A short coalescing window (HVD_TPU_TORCH_BATCH_WINDOW_MS,
+        default 1 ms ≈ one negotiation cycle) lets the hooks of a fast
+        backward land in ONE batched submission instead of one
+        negotiation round each — measured 4 rounds -> 1-2 at np=2 on a
+        4-param model.  For large models backward dwarfs the window and
+        the per-burst overlap is unaffected.  Set 0 to submit
+        immediately."""
+        batch = []
+        try:
+            batch.append(self._ready_params.popleft())
+        except IndexError:
+            return  # an earlier drain already took this task's param
+        window_s = float(os.environ.get(
+            "HVD_TPU_TORCH_BATCH_WINDOW_MS", "1.0")) * 1e-3
+        from ..common import basics
+        state = basics._state
+        if (window_s > 0 and state.topology is not None
+                and state.topology.num_processes > 1):
+            # single-process execs are ~instant, so the window would be
+            # pure added latency there; it only pays when a negotiation
+            # round costs multiple ms (cross-process)
+            time.sleep(window_s)
+        while True:
+            try:
+                batch.append(self._ready_params.popleft())
+            except IndexError:
+                break
+        tensors, names, ctxs = [], [], []
+        for p in batch:
+            name = self._param_names.get(p, "allreduce.noname")
+            grad = p.grad
+            if self.backward_passes_per_step > 1:
+                grad = grad / self.backward_passes_per_step
+            if self._gradient_predivide_factor != 1.0:
+                grad = grad / self._gradient_predivide_factor
+            compressed, ctx = self._compression.compress(grad)
+            tensors.append(compressed)
+            names.append(name)
+            ctxs.append(ctx)
+        handles = mpi_ops.allreduce_multi_async(
+            tensors, names, op=self._op, process_set=self._process_set,
         )
-        self._handles[p] = (handle, ctx)
+        for p, handle, ctx in zip(batch, handles, ctxs):
+            self._handles[p] = (handle, ctx)
 
     # -- synchronization ----------------------------------------------------
 
     def synchronize(self):
         """Wait for all outstanding allreduces and install averaged grads
         (reference: _DistributedOptimizer.synchronize)."""
+        pending, self._pending_submits = self._pending_submits, []
+        for f in pending:
+            f.result()  # re-raises a submit-side error on the caller
         for p, (handle, ctx) in list(self._handles.items()):
             output = mpi_ops.synchronize(handle)
             grad = self._compression.decompress(output, ctx)
@@ -124,7 +195,7 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         return super(self.__class__, self).step(closure)
 
     def zero_grad(self, *args, **kwargs):
-        if self._handles:
+        if self._handles or self._pending_submits:
             raise AssertionError(
                 "optimizer.zero_grad() was called after loss.backward() but "
                 "before optimizer.step() or optimizer.synchronize()"

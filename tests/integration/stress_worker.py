@@ -18,6 +18,7 @@ collectives execute in SPMD program order and this schedule would (by
 design) deadlock — see docs/running.md.
 """
 
+import os
 import random
 import sys
 import time
@@ -27,8 +28,11 @@ import numpy as np
 
 import horovod_tpu as hvd
 
-N_OPS = 40
-SEED = 1234
+# schedule length / seed are env-tunable so CI can run a short leg on
+# every change and a longer seeded soak (HVD_TPU_STRESS_OPS=200+) in the
+# slow lane without editing the worker
+N_OPS = int(os.environ.get("HVD_TPU_STRESS_OPS", "40"))
+SEED = int(os.environ.get("HVD_TPU_STRESS_SEED", "1234"))
 
 
 # comparison tolerance per wire dtype (low-precision sums accumulate

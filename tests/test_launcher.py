@@ -178,6 +178,27 @@ def test_tpurun_negotiation_stress():
 
 
 @pytest.mark.integration
+def test_tpurun_negotiation_stress_np8_soak():
+    """np=8 + a longer seeded schedule (120 ops, different seed): more
+    ranks means more cross-rank submission-order divergence and more
+    partial-readiness cycles at the coordinator — the regime where the
+    round-4 grouped deadlock and the round-5 wire-name mismatch both
+    lived.  The batched-enqueue + CV-wake paths get their widest
+    exercise here."""
+    worker = os.path.join(REPO, "tests", "integration", "stress_worker.py")
+    os.environ["HVD_TPU_STRESS_OPS"] = "120"
+    os.environ["HVD_TPU_STRESS_SEED"] = "77"
+    try:
+        res = _run_tpurun(8, timeout=600, target=worker, target_args=["8"])
+    finally:
+        os.environ.pop("HVD_TPU_STRESS_OPS", None)
+        os.environ.pop("HVD_TPU_STRESS_SEED", None)
+    assert res.returncode == 0, \
+        f"stdout:\n{res.stdout[-2000:]}\nstderr:\n{res.stderr[-3000:]}"
+    assert res.stdout.count("STRESS_OK") == 8
+
+
+@pytest.mark.integration
 def test_tpurun_elastic_pretrain_example():
     """The elastic LM-pretrain example (BASELINE's elastic-Llama-pretrain
     analog at toy scale) trains under 2 real processes: elastic

@@ -100,22 +100,37 @@ def test_native_all_ops_roundtrip():
 def test_native_duplicate_name_rejected():
     """Names stay claimed from enqueue until the response executes, so a
     resubmission inside the negotiation window must be rejected
-    (reference: tensor-table duplicate check).  A slow cycle keeps the
-    window open deterministically."""
-    hvd.shutdown()
-    os.environ["HVD_TPU_CYCLE_TIME"] = "300"
-    try:
-        hvd.init()
-        h1 = hvd.allreduce_async(jnp.ones((8,)), name="dup")
+    (reference: tensor-table duplicate check).
+
+    Since the CV-wake loop (round 5) a world-of-1 entry executes within
+    microseconds of enqueue, so a slow cycle no longer holds the window
+    open.  Instead an INCOMPLETE grouped call pins the claim
+    deterministically: the coordinator cannot release a group until all
+    ``group_size`` members arrive, so the first member's name stays
+    claimed until the second member is submitted."""
+    from horovod_tpu.common import basics
+    from horovod_tpu.native.controller import OP_ALLREDUCE
+
+    ctrl = basics._require_init().controller
+    if ctrl is None or not ctrl.is_native:
+        pytest.skip("native controller not active")
+    f1 = ctrl.enqueue(jnp.ones((8,)), OP_ALLREDUCE, name="dup",
+                      group_key="dupg#0", group_size=2)
+    # the group is incomplete: "dup" is claimed and pending
+    with pytest.raises(ValueError):
+        ctrl.enqueue(jnp.ones((8,)), OP_ALLREDUCE, name="dup",
+                     group_key="dupg#0", group_size=2)
+    # ... and the batched entry point enforces the same check
+    if ctrl.supports_batch:
         with pytest.raises(ValueError):
-            hvd.allreduce_async(jnp.ones((8,)), name="dup")
-        h1.wait()
-        # after completion the name is reusable
-        hvd.allreduce(jnp.ones((4,)), name="dup")
-    finally:
-        os.environ.pop("HVD_TPU_CYCLE_TIME", None)
-        hvd.shutdown()
-        hvd.init()
+            ctrl.enqueue_batch([jnp.ones((8,))], ["dup"], OP_ALLREDUCE,
+                               group_key="dupg#0", group_size=2)
+    # completing the group releases both members and frees the name
+    f2 = ctrl.enqueue(jnp.ones((8,)), OP_ALLREDUCE, name="dup2",
+                      group_key="dupg#0", group_size=2)
+    f1.result()
+    f2.result()
+    hvd.allreduce(jnp.ones((4,)), name="dup")  # name reusable again
 
 
 def test_native_timeline_comm_span_covers_execution(tmp_path):

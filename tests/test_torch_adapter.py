@@ -130,6 +130,10 @@ def test_backward_passes_per_step_accumulates():
     (model(x).sum()).backward()
     assert not opt._handles  # first pass: only locally accumulated
     (model(x).sum()).backward()
+    # submission is async (the hook posts to the submit worker); drain it
+    # before peeking at the handle table
+    for f in list(opt._pending_submits):
+        f.result()
     assert opt._handles  # second pass submitted the allreduce
     opt.step()
 
