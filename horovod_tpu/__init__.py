@@ -21,6 +21,10 @@ Typical JAX use::
 
 from __future__ import annotations
 
+from .utils import jax_compat as _jax_compat
+
+_jax_compat.install()  # jax.shard_map spelling on older jax images
+
 from .common import basics as _basics
 from .common.basics import (
     init,
@@ -88,7 +92,7 @@ from .functions import (
     broadcast_optimizer_state,
     broadcast_parameters,
 )
-from . import callbacks, checkpoint, elastic
+from . import callbacks, checkpoint, elastic, metrics
 from .compression import Compression
 from .sync_batch_norm import SyncBatchNorm
 from .optim import (

@@ -33,6 +33,7 @@ schedule baked into the compiled step) — the TPU-native spelling.
 from __future__ import annotations
 
 import math
+import time as _time
 from typing import Any, Callable, Dict, List, Optional, Union
 
 import jax
@@ -41,8 +42,11 @@ import numpy as np
 import optax
 
 from .common import basics
+from .metrics import instruments as _metrics
 from .ops import collective_ops
 from .ops.reduce_ops import Average
+
+_STEP_TIME = _metrics.STEP_DURATION.labels("jax")
 
 
 # -- LR plumbing -------------------------------------------------------------
@@ -162,10 +166,15 @@ class TrainLoop:
 
     def on_batch_begin(self, batch: int) -> None:
         self.batch = batch
+        self._batch_t0 = _time.perf_counter()
         for cb in self.callbacks:
             cb.on_batch_begin(batch)
 
     def on_batch_end(self, batch: int, logs: Optional[dict] = None) -> None:
+        t0 = getattr(self, "_batch_t0", None)
+        if t0 is not None:
+            _STEP_TIME.observe(_time.perf_counter() - t0)
+            self._batch_t0 = None
         for cb in self.callbacks:
             cb.on_batch_end(batch, logs)
 

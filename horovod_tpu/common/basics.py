@@ -176,6 +176,19 @@ def init(devices: Optional[Sequence] = None) -> None:
                 _state.config.timeline_filename, rank=_state.topology.rank
             )
 
+        # telemetry: identity gauge + the per-worker /metrics + /healthz
+        # endpoint (HVD_TPU_METRICS_PORT opts in; collection itself is
+        # always on and costs nothing until scraped)
+        from ..metrics import exposition as _metrics_exposition
+        from ..metrics import instruments as _instruments
+
+        _instruments.PROCESS_INFO.labels(
+            str(_state.topology.rank), str(local_rank()),
+            str(_state.topology.size),
+            str(_state.topology.num_processes),
+        ).set(1)
+        _metrics_exposition.maybe_start_from_env(local_rank=local_rank())
+
         _state.initialized = True
         get_logger().info(
             "initialized: size=%d local_size=%d rank=%d processes=%d backend=%s",
@@ -198,6 +211,9 @@ def shutdown() -> None:
         if _state.timeline is not None:
             _state.timeline.close()
             _state.timeline = None
+        from ..metrics import exposition as _metrics_exposition
+
+        _metrics_exposition.stop_http_server()
         _state.engine = None
         _state.topology = None
         _state.initialized = False

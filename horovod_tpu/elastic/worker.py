@@ -32,6 +32,8 @@ from typing import Optional
 
 from ..common import wire_auth
 from ..common.exceptions import HorovodInternalError, HostsUpdatedInterrupt
+from ..metrics import instruments as _metrics
+from ..metrics.exposition import register_health_source
 from ..utils.logging import get_logger
 
 ENV_ELASTIC = "HVD_TPU_ELASTIC"
@@ -50,6 +52,9 @@ ENV_RESTORE_FD = "HVD_TPU_ELASTIC_RESTORE_FD"
 ENV_T_PERSIST = "HVD_TPU_ELASTIC_T_PERSIST"
 ENV_SNAP_BYTES = "HVD_TPU_ELASTIC_SNAP_BYTES"
 ENV_T_EXEC = "HVD_TPU_ELASTIC_T_EXEC"
+# cumulative exec-restart count, carried across the execv boundary so the
+# metrics counter survives the process image being replaced
+ENV_RESTART_COUNT = "HVD_TPU_ELASTIC_RESTART_COUNT"
 
 #: timing of the most recent exec-restart, filled by
 #: maybe_restore_after_restart on the post-boot side:
@@ -152,6 +157,11 @@ class WorkerNotificationManager:
     def init(self) -> None:
         if not elastic_enabled() or self._thread is not None:
             return
+        # /healthz reflects this worker's membership state: a pending
+        # failure notification means a peer died and this worker is about
+        # to take the recovery path — flagged unhealthy so orchestrators
+        # see the blip; a planned pending update is healthy but visible
+        register_health_source("elastic_worker", self._health)
         sock = socket.create_connection(_driver_addr(), timeout=30)
         _send_line(sock, {"type": "register", "worker_id": _worker_id()})
         sock.settimeout(None)
@@ -274,6 +284,16 @@ class WorkerNotificationManager:
                     "commit is lost)"
                 )
         _persist_and_exec(snap)
+
+    def _health(self):
+        with self._lock:
+            pending = self._pending_epoch
+            failure = self._pending_failure
+        return not failure, {
+            "pending_epoch": pending,
+            "pending_failure": failure,
+            "worker_id": int(os.environ.get(ENV_WORKER_ID, -1)),
+        }
 
     def check_for_updates(self) -> None:
         """Raise HostsUpdatedInterrupt if an update is pending (reference:
@@ -548,6 +568,11 @@ def _persist_and_exec(snap) -> None:
     # marked even with no snapshot: the post-boot wrapper must still fire
     # the user's reset callbacks (the restart IS the reset)
     os.environ[ENV_RESTARTED] = "1"
+    try:
+        count = int(os.environ.get(ENV_RESTART_COUNT, "0"))
+    except ValueError:
+        count = 0
+    os.environ[ENV_RESTART_COUNT] = str(count + 1)
     for k in _ASSIGNMENT_ENV:
         os.environ.pop(k, None)
     sys.stdout.flush()
@@ -623,6 +648,21 @@ def maybe_restore_after_restart(state) -> None:
             "restore_s": restore_s,
             "total_s": persist_s + reboot_s + restore_s,
         }
+        # restore the CUMULATIVE restart count: execv replaced the process
+        # image (and with it the fresh registry's zero), the env carried
+        # the true total across the boundary
+        try:
+            total_restarts = int(os.environ.get(ENV_RESTART_COUNT, "1"))
+        except ValueError:
+            total_restarts = 1
+        already = _metrics.ELASTIC_RESTARTS.get()
+        if total_restarts > already:
+            _metrics.ELASTIC_RESTARTS.inc(total_restarts - already)
+        for phase in ("persist", "reboot", "restore", "total"):
+            _metrics.ELASTIC_RESTART_SECONDS.labels(phase).set(
+                last_restart_stats[f"{phase}_s"]
+            )
+        _metrics.ELASTIC_SNAPSHOT_BYTES.set(snap_bytes)
         get_logger().info(
             "elastic: restart cost %.2fs total (persist %.2fs, "
             "reboot %.2fs, restore %.2fs; snapshot %d bytes)",

@@ -9,12 +9,14 @@ they work unchanged for the tensorflow, jax and torch Keras backends).
 
 from __future__ import annotations
 
+import time
 from typing import Callable, Optional, Union
 
 import numpy as np
 import keras
 
 from ..common import basics
+from ..metrics import instruments as _metrics
 from ..ops import collective_ops as _ops
 from ..ops.reduce_ops import Average
 
@@ -76,6 +78,42 @@ class MetricAverageCallback(keras.callbacks.Callback):
                         np.asarray(value, np.float64), op=Average,
                         name=f"metric_avg.{key}",
                     )))
+
+
+class TelemetryCallback(keras.callbacks.Callback):
+    """Feed the metrics subsystem from the Keras fit loop: per-batch step
+    time into ``hvd_tpu_step_duration_seconds{adapter="keras"}`` and
+    per-epoch logged metrics as gauges (so a /metrics scrape shows live
+    loss/accuracy next to the collective-latency histograms).
+
+    Purely local — registers no collectives, so it is safe on any subset
+    of ranks (unlike MetricAverageCallback, which is rank-symmetric)."""
+
+    def __init__(self, log_metrics: bool = True):
+        super().__init__()
+        self.log_metrics = log_metrics
+        self._step_time = _metrics.STEP_DURATION.labels("keras")
+        self._t0: Optional[float] = None
+
+    def on_train_batch_begin(self, batch, logs=None):
+        self._t0 = time.perf_counter()
+
+    def on_train_batch_end(self, batch, logs=None):
+        if self._t0 is not None:
+            self._step_time.observe(time.perf_counter() - self._t0)
+            self._t0 = None
+
+    def on_epoch_end(self, epoch, logs=None):
+        if not self.log_metrics or not logs:
+            return
+        g = _metrics.gauge(
+            "hvd_tpu_keras_epoch_metric",
+            "Last epoch-end value of each Keras logged metric",
+            ["metric"],
+        )
+        for key, value in logs.items():
+            if isinstance(value, (int, float, np.floating, np.integer)):
+                g.labels(str(key)).set(float(value))
 
 
 class LearningRateWarmupCallback(keras.callbacks.Callback):

@@ -1,0 +1,62 @@
+"""horovod_tpu.metrics: cluster-wide telemetry & health.
+
+The observability layer the timeline writer and profiler bridge don't
+cover (those trace *one run for offline analysis*; this exposes *live,
+queryable state* — per-step throughput, collective latency, stall and
+elastic-membership metrics).  Three pieces:
+
+  * :mod:`.registry`   — process-wide Counters / Gauges / Histograms;
+  * :mod:`.exposition` — Prometheus text format + the per-worker
+    ``/metrics`` + ``/healthz`` HTTP endpoint (``HVD_TPU_METRICS_PORT``);
+  * :mod:`.aggregate`  — job-wide snapshots merged over the framework's
+    own allgather.
+
+Quick use::
+
+    import horovod_tpu as hvd
+    from horovod_tpu import metrics
+
+    hvd.init()                      # HVD_TPU_METRICS_PORT=9090 serves
+                                    # /metrics on 9090+local_rank
+    steps = metrics.counter("my_app_steps", "training steps")
+    steps.inc()
+    print(metrics.render())         # Prometheus text, ad hoc
+    job = metrics.cluster_snapshot()  # collective: all ranks call
+
+See docs/METRICS.md for the metric catalogue.
+"""
+
+from __future__ import annotations
+
+from .registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    REGISTRY,
+    DEFAULT_LATENCY_BUCKETS,
+    counter,
+    gauge,
+    histogram,
+)
+from .exposition import (
+    ENV_METRICS_PORT,
+    health_snapshot,
+    http_server,
+    maybe_start_from_env,
+    register_health_source,
+    render,
+    start_http_server,
+    stop_http_server,
+    unregister_health_source,
+)
+from .aggregate import cluster_snapshot, merge_snapshots, snapshot
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
+    "DEFAULT_LATENCY_BUCKETS", "counter", "gauge", "histogram",
+    "ENV_METRICS_PORT", "render", "start_http_server", "stop_http_server",
+    "http_server", "maybe_start_from_env", "register_health_source",
+    "unregister_health_source", "health_snapshot",
+    "snapshot", "merge_snapshots", "cluster_snapshot",
+]

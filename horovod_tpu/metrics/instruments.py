@@ -1,0 +1,169 @@
+"""The framework's standard metric instruments, in one place.
+
+Every instrumented subsystem (ops engine, native controller, elastic
+driver/worker, framework adapters) imports its instruments from here so
+the metric names, label sets and bucket layouts stay consistent — the
+catalogue in docs/METRICS.md mirrors this file.
+
+Import cost is a handful of registry insertions; no jax, no ctypes, no
+framework imports — safe from any layer (including the elastic driver,
+which runs before jax ever loads).
+"""
+
+from __future__ import annotations
+
+from .registry import DEFAULT_LATENCY_BUCKETS, counter, gauge, histogram
+
+# -- data plane (ops/engine.py, ops/collective_ops.py) -----------------------
+
+#: Wall time of one compiled-collective dispatch (async hand-off to XLA,
+#: not data-ready) by engine cache-key op kind.
+DISPATCH_LATENCY = histogram(
+    "hvd_tpu_collective_dispatch_seconds",
+    "Dispatch wall time of one compiled XLA collective, by program kind",
+    ["op"],
+)
+
+#: Executable-cache outcome per compile lookup (the reference's
+#: ResponseCache analog for compiled programs).
+EXEC_CACHE = counter(
+    "hvd_tpu_executable_cache_total",
+    "Engine executable-cache lookups by outcome (hit/miss)",
+    ["event"],
+)
+
+#: Public collective API submissions, by op and dispatch path
+#: (native = C++ background controller, eager = in-line engine).
+COLLECTIVES = counter(
+    "hvd_tpu_collectives_total",
+    "Collective submissions by op and dispatch path",
+    ["op", "path"],
+)
+
+#: Payload bytes submitted to collectives, by op.
+COLLECTIVE_BYTES = counter(
+    "hvd_tpu_collective_bytes_total",
+    "Tensor bytes submitted to collectives, by op",
+    ["op"],
+)
+
+#: End-to-end latency of a negotiated collective: enqueue() to future
+#: resolution (includes negotiation, fusion and execution).
+OP_LATENCY = histogram(
+    "hvd_tpu_collective_latency_seconds",
+    "Enqueue-to-resolution latency of negotiated collectives, by op",
+    ["op"],
+)
+
+# -- native controller (native/controller.py) --------------------------------
+
+#: Entries currently awaiting a fused response (TensorQueue + pending
+#: negotiation; the reference's stall-inspector pending table).
+ENQUEUE_DEPTH = gauge(
+    "hvd_tpu_enqueue_depth",
+    "Collectives submitted but not yet resolved on this rank",
+)
+
+#: Fill ratio of the padded fusion buffer on the host-pack path
+#: (payload bytes / padded bytes; 1.0 = no padding waste).
+FUSION_UTILIZATION = histogram(
+    "hvd_tpu_fusion_buffer_utilization_ratio",
+    "Fusion-buffer fill ratio (payload/padded) of host-packed responses",
+    buckets=(0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 1.0),
+)
+
+#: Entries fused into one negotiated response.
+FUSED_ENTRIES = histogram(
+    "hvd_tpu_fused_entries_per_response",
+    "Tensor entries fused into one negotiated response",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256),
+)
+
+#: Native-core stats refreshed at scrape time (registry poll hooks):
+NATIVE_CACHE_HITS = gauge(
+    "hvd_tpu_native_response_cache_hits",
+    "Cumulative native ResponseCache hits (bit-vector bypass cycles)",
+)
+NATIVE_CACHE_MISSES = gauge(
+    "hvd_tpu_native_response_cache_misses",
+    "Cumulative native ResponseCache misses (full request encodings)",
+)
+NATIVE_PENDING = gauge(
+    "hvd_tpu_native_pending_collectives",
+    "Stall-inspector pending count inside the native core",
+)
+NATIVE_CYCLE_TIME_MS = gauge(
+    "hvd_tpu_native_cycle_time_ms",
+    "Background-loop cycle time (autotune may move it)",
+)
+NATIVE_FUSION_THRESHOLD = gauge(
+    "hvd_tpu_native_fusion_threshold_bytes",
+    "Fusion threshold (autotune may move it)",
+)
+NATIVE_AUTOTUNE_ACTIVE = gauge(
+    "hvd_tpu_native_autotune_active",
+    "1 while the parameter autotuner is still searching",
+)
+NATIVE_LAST_REQUEST_BYTES = gauge(
+    "hvd_tpu_native_last_request_bytes",
+    "Bytes of this rank's last non-empty negotiation report",
+)
+
+# -- elastic (runner/elastic_driver.py, elastic/worker.py) -------------------
+
+ELASTIC_WORLD_SIZE = gauge(
+    "hvd_tpu_elastic_world_size",
+    "Member processes of the current elastic epoch",
+)
+ELASTIC_EPOCH = gauge(
+    "hvd_tpu_elastic_epoch",
+    "Current elastic rendezvous epoch",
+)
+ELASTIC_RENDEZVOUS = counter(
+    "hvd_tpu_elastic_rendezvous_total",
+    "Completed rendezvous epochs handed out by the driver",
+)
+ELASTIC_SPAWNS = counter(
+    "hvd_tpu_elastic_workers_spawned_total",
+    "Worker processes spawned by the elastic driver",
+)
+ELASTIC_FAILURES = counter(
+    "hvd_tpu_elastic_worker_failures_total",
+    "Worker processes that exited non-zero (slot blacklisted)",
+)
+ELASTIC_RESTARTS = counter(
+    "hvd_tpu_elastic_restarts_total",
+    "Exec-restarts this worker performed (planned + failure recovery)",
+)
+ELASTIC_RESTART_SECONDS = gauge(
+    "hvd_tpu_elastic_last_restart_seconds",
+    "Cost split of this worker's most recent exec-restart",
+    ["phase"],  # persist / reboot / restore / total
+)
+ELASTIC_SNAPSHOT_BYTES = gauge(
+    "hvd_tpu_elastic_last_snapshot_bytes",
+    "Serialized state bytes carried across the last exec-restart",
+)
+
+# -- adapters (torch/optimizer.py, keras/callbacks.py) -----------------------
+
+STEP_DURATION = histogram(
+    "hvd_tpu_step_duration_seconds",
+    "Training step wall time, by adapter",
+    ["adapter"],
+    buckets=DEFAULT_LATENCY_BUCKETS + (25.0, 60.0),
+)
+
+GRAD_NORM = gauge(
+    "hvd_tpu_grad_norm",
+    "Global gradient L2 norm after averaging, by adapter",
+    ["adapter"],
+)
+
+# -- process identity --------------------------------------------------------
+
+PROCESS_INFO = gauge(
+    "hvd_tpu_process_info",
+    "Static process identity (value is always 1)",
+    ["rank", "local_rank", "size", "num_processes"],
+)

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import ctypes
 import threading
+import time
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -26,6 +27,11 @@ import numpy as np
 
 from ..common.exceptions import HorovodInternalError
 from ..common.topology import Topology
+from ..metrics import instruments as _metrics
+from ..metrics.exposition import (
+    register_health_source, unregister_health_source,
+)
+from ..metrics.registry import REGISTRY as _METRICS_REGISTRY
 from ..utils import profiler
 from ..utils.env_parser import Config
 from ..utils.logging import get_logger
@@ -33,6 +39,13 @@ from ..utils.logging import get_logger
 # Enum values must match native/src/common.h.
 OP_ALLREDUCE, OP_ALLGATHER, OP_BROADCAST, OP_ALLTOALL, OP_REDUCESCATTER, \
     OP_BARRIER, OP_JOIN = range(7)
+
+OP_NAMES = {
+    OP_ALLREDUCE: "allreduce", OP_ALLGATHER: "allgather",
+    OP_BROADCAST: "broadcast", OP_ALLTOALL: "alltoall",
+    OP_REDUCESCATTER: "reducescatter", OP_BARRIER: "barrier",
+    OP_JOIN: "join",
+}
 
 _DTYPES = [
     ("uint8", 0), ("int8", 1), ("int32", 2), ("int64", 3),
@@ -83,14 +96,16 @@ class Future:
 
 
 class _Entry:
-    __slots__ = ("payload", "future", "op", "extra", "name")
+    __slots__ = ("payload", "future", "op", "extra", "name", "t0")
 
-    def __init__(self, payload, future, op, extra=None, name=None):
+    def __init__(self, payload, future, op, extra=None, name=None,
+                 t0=None):
         self.payload = payload
         self.future = future
         self.op = op
         self.extra = extra
         self.name = name  # set for locally submitted entries (timeline)
+        self.t0 = t0  # submit perf_counter (None: synthesized entry)
 
 
 class NativeController:
@@ -142,6 +157,12 @@ class NativeController:
         )
         if rc != 0:
             raise OSError(f"hvdtpu_init failed with {rc}")
+        # telemetry: enqueue depth is live (set_function), the native
+        # core's own stats refresh at scrape time (registry poll), and
+        # /healthz reflects loop liveness + the stall inspector
+        _metrics.ENQUEUE_DEPTH.set_function(self._depth)
+        _METRICS_REGISTRY.register_poll(self._refresh_native_stats)
+        register_health_source("native_controller", self._health)
 
     @staticmethod
     def _declare(lib) -> None:
@@ -195,6 +216,12 @@ class NativeController:
         lib.hvdtpu_autotune_inject.restype = None
         lib.hvdtpu_autotune_inject.argtypes = [ctypes.c_double]
         lib.hvdtpu_pending_count.restype = ctypes.c_int
+        try:
+            lib.hvdtpu_loop_dead.restype = ctypes.c_int
+        except AttributeError:
+            # core built before the liveness getter: /healthz then
+            # reports liveness from the python-side entry table only
+            pass
         lib.hvdtpu_timeline_activity.restype = None
         lib.hvdtpu_timeline_activity.argtypes = [
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
@@ -215,6 +242,9 @@ class NativeController:
         self._engine = engine
 
     def shutdown(self) -> None:
+        _metrics.ENQUEUE_DEPTH.set_function(None)
+        _METRICS_REGISTRY.unregister_poll(self._refresh_native_stats)
+        unregister_health_source("native_controller")
         self._lib.hvdtpu_shutdown()
         # fail anything still registered so concurrent waiters raise
         # instead of blocking forever
@@ -255,6 +285,43 @@ class NativeController:
 
     def pending_count(self) -> int:
         return int(self._lib.hvdtpu_pending_count())
+
+    def loop_dead(self) -> bool:
+        """True once the background loop exited (stall shutdown or
+        transport death) — every later enqueue would raise."""
+        fn = getattr(self._lib, "hvdtpu_loop_dead", None)
+        return bool(fn()) if fn is not None else False
+
+    # -- telemetry (metrics/ subsystem hooks) --------------------------------
+
+    def _depth(self) -> int:
+        with self._entries_lock:
+            return len(self._entries)
+
+    def _refresh_native_stats(self) -> None:
+        """Scrape-time poll: copy the native core's cumulative stats into
+        the pull gauges (zero hot-path cost — runs only on collection)."""
+        _metrics.NATIVE_CACHE_HITS.set(self.cache_hits())
+        _metrics.NATIVE_CACHE_MISSES.set(self.cache_misses())
+        _metrics.NATIVE_PENDING.set(self.pending_count())
+        _metrics.NATIVE_CYCLE_TIME_MS.set(self.cycle_time_ms())
+        _metrics.NATIVE_FUSION_THRESHOLD.set(self.fusion_threshold())
+        _metrics.NATIVE_AUTOTUNE_ACTIVE.set(
+            1 if self.autotune_active() else 0
+        )
+        _metrics.NATIVE_LAST_REQUEST_BYTES.set(self.last_request_bytes())
+
+    def _health(self):
+        """/healthz source: unhealthy when the background loop died (the
+        library rejects all further work) — pending work alone is normal
+        and only reported as detail."""
+        dead = self.loop_dead()
+        return not dead, {
+            "loop_dead": dead,
+            "pending_collectives": self.pending_count(),
+            "inflight_entries": self._depth(),
+            "autotune_active": self.autotune_active(),
+        }
 
     def auto_group_name(self, op_type: int) -> str:
         """Symmetric base name for an unnamed grouped call (the group key
@@ -368,7 +435,8 @@ class NativeController:
             entry_id = counter
             with self._entries_lock:
                 self._entries[entry_id] = _Entry(
-                    arr, fut, op_type, extra, name=name
+                    arr, fut, op_type, extra, name=name,
+                    t0=time.perf_counter(),
                 )
             # reduce_op rides in the root_rank field for allreduce (the C
             # core treats both as opaque fuse keys); keep them separate
@@ -450,10 +518,11 @@ class NativeController:
             # futures registered BEFORE the batch becomes visible (same
             # ordering contract as enqueue())
             with self._entries_lock:
+                t0 = time.perf_counter()
                 for i, arr in enumerate(arrs):
                     fut = Future()
                     self._entries[ids[i]] = _Entry(
-                        arr, fut, op_type, None, name=names[i]
+                        arr, fut, op_type, None, name=names[i], t0=t0
                     )
                     futs.append(fut)
             n = len(arrs)
@@ -548,6 +617,7 @@ class NativeController:
                     )
             if not entries:
                 return
+            _metrics.FUSED_ENTRIES.observe(len(entries))
             # XLA_COMM span on the exec thread for jax.profiler captures —
             # covers dispatch of the fused program (through data-ready when
             # the timeline is active, which blocks in resolve()); matches
@@ -600,10 +670,13 @@ class NativeController:
         from ..ops.reduce_ops import ReduceOp
 
         eng = self._engine
+        latency = _metrics.OP_LATENCY.labels(OP_NAMES.get(op, f"op{op}"))
 
         def resolve(e, value):
             if e.future is None:  # synthesized zero contribution (post-join)
                 return
+            if e.t0 is not None:
+                latency.observe(time.perf_counter() - e.t0)
             if self._timeline_active and e.name:
                 # end XLA_COMM when the data is actually ready, not at
                 # async dispatch — tracing trades a bg-thread block for
@@ -689,6 +762,8 @@ class NativeController:
             arrays = [np.ascontiguousarray(a) for a in raw]
             total = sum(sizes)
             padded = _next_pow2(total) if len(arrays) > 1 else total
+            if padded:
+                _metrics.FUSION_UTILIZATION.observe(total / padded)
             # pack in C (hvdtpu_pack memcpys + zeroes the pad tail):
             # ctypes releases the GIL for the call, so the training
             # thread keeps running while this background thread packs

@@ -267,7 +267,15 @@ long long hvdtpu_enqueue(long long entry_id, const char* name, int op,
   if (splits && n_splits > 0) e.splits.assign(splits, splits + n_splits);
   e.enqueued_at = hvdtpu::Clock::now();
   int64_t id = e.id;
-  if (!s->queue->Add(std::move(e))) return -1;  // duplicate name pending
+  if (!s->queue->Add(std::move(e))) {
+    // roll the name claim back (mirror of hvdtpu_enqueue_n): a rejected
+    // entry never executes, so nothing would ever release the name and
+    // every later submission under it would be refused as a duplicate
+    std::lock_guard<std::mutex> lk(s->names_mu);
+    s->active_names.erase(std::string(name) + "\x1f" +
+                          std::to_string(process_set));
+    return -1;  // duplicate name pending
+  }
   {
     // lock-then-notify: without the lock the wake can land between the
     // loop's predicate check and its block and be lost — the submission
@@ -366,6 +374,10 @@ void hvdtpu_shutdown() {
 }
 
 int hvdtpu_initialized() { return hvdtpu::g()->initialized.load() ? 1 : 0; }
+
+// 1 once the background loop exited (stall shutdown / transport death):
+// the liveness bit /healthz reports (every further enqueue returns -3).
+int hvdtpu_loop_dead() { return hvdtpu::g()->loop_dead.load() ? 1 : 0; }
 
 long long hvdtpu_cache_hits() {
   auto* s = hvdtpu::g();

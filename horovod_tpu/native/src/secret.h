@@ -13,11 +13,15 @@
 // dependency — the toolchain image carries none).
 #pragma once
 
-#include <chrono>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <random>
 #include <string>
+
+#if defined(__linux__)
+#include <sys/random.h>
+#endif
 
 namespace hvdtpu {
 namespace secret {
@@ -167,21 +171,41 @@ inline bool MacEqual(const std::string& a, const std::string& b) {
   return acc == 0;
 }
 
-// 16 random bytes from /dev/urandom (challenge nonce)
-inline std::string RandomChallenge() {
-  std::string out(16, '\0');
-  std::FILE* f = std::fopen("/dev/urandom", "rb");
-  if (f) {
-    size_t got = std::fread(&out[0], 1, out.size(), f);
-    std::fclose(f);
-    if (got == out.size()) return out;
+// 16 random bytes for the challenge nonce.  Sources, in order:
+// getrandom(2) (no fd, works in chroots without /dev), /dev/urandom,
+// std::random_device.  Returns false — failing the handshake — when no
+// real entropy source works: a predictable challenge would let a
+// recorded hello be replayed, which is exactly what the
+// challenge-response exists to prevent, so degrading to clock entropy
+// is not an option.
+inline bool RandomChallenge(std::string* out) {
+  out->assign(16, '\0');
+#if defined(__linux__)
+  {
+    size_t off = 0;
+    while (off < out->size()) {
+      ssize_t got = ::getrandom(&(*out)[off], out->size() - off, 0);
+      if (got <= 0) break;  // ENOSYS on pre-3.17 kernels: next source
+      off += static_cast<size_t>(got);
+    }
+    if (off == out->size()) return true;
   }
-  // degraded fallback (no /dev/urandom): clock entropy — still unique
-  // per process start, and the secret itself remains required
-  uint64_t t = static_cast<uint64_t>(
-      std::chrono::steady_clock::now().time_since_epoch().count());
-  std::memcpy(&out[0], &t, sizeof(t));
-  return out;
+#endif
+  if (std::FILE* f = std::fopen("/dev/urandom", "rb")) {
+    size_t got = std::fread(&(*out)[0], 1, out->size(), f);
+    std::fclose(f);
+    if (got == out->size()) return true;
+  }
+  try {
+    std::random_device rd;  // may throw when no source backs it
+    for (size_t i = 0; i + 4 <= out->size(); i += 4) {
+      uint32_t v = rd();
+      std::memcpy(&(*out)[i], &v, 4);
+    }
+    return true;
+  } catch (...) {
+    return false;
+  }
 }
 
 }  // namespace secret

@@ -47,6 +47,14 @@ class TcpTransport : public Transport {
   // or poison negotiation, and a port-squatting rogue coordinator is
   // rejected by the workers (reference: secret.py's HMAC-signed RPC,
   // SURVEY.md §2.4).
+  //
+  // Hello wire: worker sends rank(4, LE) + auth-mode flag(1: 0x01 when it
+  // holds a secret); the coordinator answers with its own flag byte.  A
+  // secret/no-secret MISMATCH (half-configured job) is therefore detected
+  // on the first exchange and rejected with a clear error on both sides —
+  // before the flag existed, a mismatched fleet hung until the rendezvous
+  // timeout with no hint at the cause (one side waiting for challenge
+  // bytes the other never sends).
   TcpTransport(const std::string& host, int port, int rank, int size,
                double timeout_sec = 60.0)
       : rank_(rank), size_(size) {
@@ -163,6 +171,25 @@ class TcpTransport : public Transport {
         ::close(fd);
         continue;
       }
+      uint8_t peer_auth = 0;
+      uint8_t my_auth = secret_.empty() ? 0 : 1;
+      if (!ReadAll(fd, &peer_auth, 1) || !WriteAll(fd, &my_auth, 1)) {
+        ::close(fd);
+        continue;
+      }
+      if ((peer_auth != 0) != (my_auth != 0)) {
+        // half-configured job: reject NOW with a clear error instead of
+        // one side hanging in a handshake read the other never feeds
+        std::fprintf(
+            stderr,
+            "[ERROR] hvd_tpu_core: auth-mode mismatch on negotiation "
+            "hello from rank %d (coordinator %s HVD_TPU_SECRET, peer "
+            "%s) — set the same secret on every process\n",
+            peer_rank, my_auth ? "has" : "lacks",
+            peer_auth ? "has" : "lacks");
+        ::close(fd);
+        continue;  // keep listening: a lone rogue must not kill the job
+      }
       if (!secret_.empty() && !AuthenticatePeer(fd, peer_rank)) {
         // unauthenticated peer on the negotiation port: reject the
         // connection, keep listening for the real rank (the rogue must
@@ -177,12 +204,19 @@ class TcpTransport : public Transport {
   }
 
   // Coordinator side of the mutual handshake; false = reject.
-  // Wire: <- rank(4) already read; <- Cw(16); -> Cr(16) +
-  // HMAC(secret, "coord" + Cw)(32); <- HMAC(secret, "rank" + rank + Cr)(32).
+  // Wire: <- rank(4) + flag(1) already read, -> flag(1) already sent;
+  // <- Cw(16); -> Cr(16) + HMAC(secret, "coord" + Cw)(32);
+  // <- HMAC(secret, "rank" + rank + Cr)(32).
   bool AuthenticatePeer(int fd, int32_t peer_rank) {
     std::string cw(16, '\0');
     if (!ReadAll(fd, &cw[0], cw.size())) return false;
-    std::string cr = secret::RandomChallenge();
+    std::string cr;
+    if (!secret::RandomChallenge(&cr)) {
+      std::fprintf(stderr,
+                   "[ERROR] hvd_tpu_core: no entropy source for the "
+                   "auth challenge; rejecting peer\n");
+      return false;
+    }
     std::string my_proof = secret::HmacSha256(secret_, "coord" + cw);
     if (!WriteAll(fd, cr.data(), cr.size()) ||
         !WriteAll(fd, my_proof.data(), my_proof.size()))
@@ -197,7 +231,13 @@ class TcpTransport : public Transport {
 
   // Worker side of the mutual handshake; false = tear down and fail.
   bool AuthenticateToRoot(int fd) {
-    std::string cw = secret::RandomChallenge();
+    std::string cw;
+    if (!secret::RandomChallenge(&cw)) {
+      std::fprintf(stderr,
+                   "[ERROR] hvd_tpu_core: no entropy source for the "
+                   "auth challenge; failing the handshake\n");
+      return false;
+    }
     if (!WriteAll(fd, cw.data(), cw.size())) return false;
     std::string cr(16, '\0'), coord_proof(32, '\0');
     if (!ReadAll(fd, &cr[0], cr.size()) ||
@@ -236,11 +276,29 @@ class TcpTransport : public Transport {
         // slowloris guard)
         SetRecvTimeout(fd, 5.0);
         int32_t my_rank = rank_;
-        if (WriteAll(fd, &my_rank, 4) &&
-            (secret_.empty() || AuthenticateToRoot(fd))) {
-          SetRecvTimeout(fd, 0.0);  // steady state: blocking reads
-          root_fd_ = fd;
-          return;
+        uint8_t my_auth = secret_.empty() ? 0 : 1;
+        uint8_t root_auth = 0;
+        if (WriteAll(fd, &my_rank, 4) && WriteAll(fd, &my_auth, 1) &&
+            ReadAll(fd, &root_auth, 1)) {
+          if ((root_auth != 0) != (my_auth != 0)) {
+            // half-configured job: fail NOW with a clear error — without
+            // the flag this worker would block in the handshake until
+            // the rendezvous timeout with no hint at the cause
+            std::fprintf(
+                stderr,
+                "[ERROR] hvd_tpu_core: auth-mode mismatch on negotiation "
+                "hello (this rank %s HVD_TPU_SECRET, coordinator %s) — "
+                "set the same secret on every process\n",
+                my_auth ? "has" : "lacks", root_auth ? "has" : "lacks");
+            ::close(fd);
+            failed_ = true;
+            return;
+          }
+          if (secret_.empty() || AuthenticateToRoot(fd)) {
+            SetRecvTimeout(fd, 0.0);  // steady state: blocking reads
+            root_fd_ = fd;
+            return;
+          }
         }
         ::close(fd);
         failed_ = true;

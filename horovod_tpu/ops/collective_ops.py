@@ -19,6 +19,7 @@ grouped_allreduce + the FusionBufferManager.
 from __future__ import annotations
 
 import contextlib
+import time
 from typing import Any, List, Optional, Sequence, Tuple
 
 import jax
@@ -27,8 +28,23 @@ import numpy as np
 
 from ..common import basics
 from ..common.process_sets import ProcessSet
+from ..metrics import instruments as _metrics
 from .fusion import FusionPlan, fuse, unfuse
 from .reduce_ops import Average, ReduceOp, Sum
+
+
+def _count_submission(opname: str, path: str, tree: Any,
+                      n: int = 1) -> None:
+    """Bump the submission counters (per-op count + payload bytes).
+    ``n`` is the number of independent API-level submissions this call
+    represents — the batched multi-tensor path passes len(tensors) so
+    the counter agrees with the per-tensor fallback path."""
+    _metrics.COLLECTIVES.labels(opname, path).inc(n)
+    nbytes = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        nbytes += getattr(leaf, "nbytes", 0) or 0
+    if nbytes:
+        _metrics.COLLECTIVE_BYTES.labels(opname).inc(nbytes)
 
 
 class Handle:
@@ -134,6 +150,10 @@ def _native_submit(tree, op_type, name, builder_extra=None, **enqueue_kw):
     ctrl = _native()
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     leaves = [jnp.asarray(x) for x in leaves]
+    from ..native.controller import OP_NAMES
+
+    _count_submission(OP_NAMES.get(op_type, f"op{op_type}"), "native",
+                      leaves)
     if (name and len(leaves) > 1 and ctrl.supports_batch
             and enqueue_kw.get("splits") is None
             and enqueue_kw.get("extra") is None):
@@ -160,16 +180,24 @@ def _native_submit(tree, op_type, name, builder_extra=None, **enqueue_kw):
 
 
 @contextlib.contextmanager
-def _span(name: Optional[str], opname: str):
+def _span(name: Optional[str], opname: str, tree: Any = None):
     """Record an XLA_COMM span in the python-fallback timeline (the native
-    core writes its own from the C++ controller); no-op when inactive."""
+    core writes its own from the C++ controller) and feed the eager-path
+    metrics: submission counters plus the per-collective latency
+    histogram (on this path the span covers negotiation-free dispatch —
+    the native path's histogram is fed at future resolution instead)."""
     tl = basics._state.timeline
     label = name or opname
     if tl is not None:
         tl.start(label, "XLA_COMM")
+    t0 = time.perf_counter()
     try:
         yield
     finally:
+        _metrics.OP_LATENCY.labels(opname).observe(
+            time.perf_counter() - t0
+        )
+        _count_submission(opname, "eager", tree)
         if tl is not None:
             tl.end(label, "XLA_COMM")
 
@@ -239,7 +267,7 @@ def allreduce_async(
             prescale=prescale_factor, postscale=postscale_factor,
         )
     eng = _engine()
-    with _span(name, "allreduce"):
+    with _span(name, "allreduce", tensor):
         result = _fused_map(
             tensor,
             lambda buf: eng.allreduce(
@@ -306,6 +334,7 @@ def allreduce_multi_async(
         # produce the same wire name as a rank that batched it — a
         # mismatch pends both sides forever (caught by the stall
         # inspector as `name` vs `name.0` during the r5 torch rework).
+        _count_submission("allreduce", "native", arrays, n=len(arrays))
         futures = ctrl.enqueue_batch(
             arrays, [f"{n}.0" for n in names], OP_ALLREDUCE,
             reduce_op=int(rop),
@@ -380,7 +409,7 @@ def allgather_async(
             ),
         )
     eng = _engine()
-    with _span(name, "allgather"):
+    with _span(name, "allgather", tensor):
         result = jax.tree_util.tree_map(
             lambda x: eng.allgather(jnp.asarray(x), process_set), tensor
         )
@@ -475,7 +504,7 @@ def broadcast_async(
             ),
         )
     eng = _engine()
-    with _span(name, "broadcast"):
+    with _span(name, "broadcast", tensor):
         result = _fused_map(
             tensor, lambda buf: eng.broadcast(buf, root_rank, process_set)
         )
@@ -515,7 +544,7 @@ def alltoall_async(
             extra=splits,
         )
     eng = _engine()
-    with _span(name, "alltoall"):
+    with _span(name, "alltoall", tensor):
         return Handle(
             eng.alltoall(jnp.asarray(tensor), splits, process_set)
         )
@@ -553,7 +582,7 @@ def reducescatter_async(
             ),
         )
     eng = _engine()
-    with _span(name, "reducescatter"):
+    with _span(name, "reducescatter", tensor):
         result = jax.tree_util.tree_map(
             lambda x: eng.reducescatter(jnp.asarray(x), op, process_set),
             tensor,

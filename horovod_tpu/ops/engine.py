@@ -25,6 +25,7 @@ the reference's np=1 ops do.  In-jit per-chip collectives live in
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any, List, Optional, Sequence, Tuple
 
 import jax
@@ -35,8 +36,28 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..common.exceptions import HorovodInternalError
 from ..common.process_sets import ProcessSet, global_process_set
 from ..common.topology import Topology, WORLD_AXIS
+from ..metrics import instruments as _metrics
 from ..utils.env_parser import Config
 from .reduce_ops import ReduceOp
+
+_CACHE_HIT = _metrics.EXEC_CACHE.labels("hit")
+_CACHE_MISS = _metrics.EXEC_CACHE.labels("miss")
+
+
+def _timed(program_kind: str, fn):
+    """Wrap a freshly compiled collective so every launch lands in the
+    dispatch-latency histogram.  Applied once per cache entry — the hot
+    (cache-hit) path pays two clock reads and one histogram observe."""
+    lat = _metrics.DISPATCH_LATENCY.labels(program_kind)
+
+    def launch(*args):
+        t0 = time.perf_counter()
+        try:
+            return fn(*args)
+        finally:
+            lat.observe(time.perf_counter() - t0)
+
+    return launch
 
 
 def _reduce_unique(u: jax.Array, op: ReduceOp, num: int,
@@ -167,8 +188,13 @@ class CollectiveEngine:
         key = key + (ctx.set_id,)
         cached = self._cache.get(key)
         if cached is None:
-            cached = jax.jit(fn, out_shardings=self._replicated(ctx))
+            _CACHE_MISS.inc()
+            cached = _timed(
+                key[0], jax.jit(fn, out_shardings=self._replicated(ctx))
+            )
             self._cache[key] = cached
+        else:
+            _CACHE_HIT.inc()
         return cached
 
     def _compile_spmd(self, key, body_factory, ctx: "_SetCtx", in_specs):
@@ -180,13 +206,16 @@ class CollectiveEngine:
         key = key + (ctx.set_id,)
         cached = self._cache.get(key)
         if cached is None:
-            cached = jax.jit(
+            _CACHE_MISS.inc()
+            cached = _timed(key[0], jax.jit(
                 jax.shard_map(
                     body_factory(), mesh=ctx.mesh, in_specs=in_specs,
                     out_specs=P(), check_vma=False,
                 )
-            )
+            ))
             self._cache[key] = cached
+        else:
+            _CACHE_HIT.inc()
         return cached
 
     def _unique_rows(self, a: jax.Array, ctx: "_SetCtx") -> jax.Array:

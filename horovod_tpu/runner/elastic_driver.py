@@ -35,6 +35,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..common import wire_auth
 from ..elastic.worker import ENV_DRIVER, ENV_ELASTIC, ENV_WORKER_ID
+from ..metrics import instruments as _metrics
 from ..utils.logging import get_logger
 
 _LOCAL_HOSTS = ("localhost", "127.0.0.1")
@@ -246,6 +247,7 @@ class ElasticDriver:
             proc.stdin.close()
         w = _Worker(wid, host, slot, proc)
         self._workers[wid] = w
+        _metrics.ELASTIC_SPAWNS.inc()
         if self.verbose:
             print(f"[tpurun elastic] spawned worker {wid} on {host}:{slot}",
                   file=sys.stderr)
@@ -282,6 +284,7 @@ class ElasticDriver:
                             "code %d", w.worker_id, w.host, w.slot, code)
                         self._blacklist.add((w.host, w.slot))
                         any_failure = True
+                        _metrics.ELASTIC_FAILURES.inc()
         return any_exit, any_failure
 
     def _alive_workers(self) -> List[_Worker]:
@@ -405,6 +408,9 @@ class ElasticDriver:
                         pass
                     sock.close()
                     self._pending_rendezvous.pop(wid, None)
+            _metrics.ELASTIC_RENDEZVOUS.inc()
+            _metrics.ELASTIC_WORLD_SIZE.set(len(members))
+            _metrics.ELASTIC_EPOCH.set(self._epoch)
             if self.verbose:
                 print(f"[tpurun elastic] epoch {self._epoch}: world="
                       f"{len(members)} coordinator={coordinator}",
@@ -419,6 +425,13 @@ class ElasticDriver:
         # a terminated driver must unwind so the finally below reaps the
         # worker fleet instead of orphaning it
         restore_handler = ensure_sigterm_unwinds()
+        # driver-side scrape endpoint (its own env var: the driver shares
+        # a host with worker 0, so it must not claim the workers' base
+        # port): HVD_TPU_DRIVER_METRICS_PORT, same off-by-default rules
+        from ..metrics import exposition as _exposition
+
+        _exposition.maybe_start_from_env(
+            env_var="HVD_TPU_DRIVER_METRICS_PORT")
         host, port = self._start_server()
         # workers resolve the driver by this address; local workers can
         # always use loopback

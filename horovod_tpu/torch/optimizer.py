@@ -33,9 +33,13 @@ from typing import Iterable, Optional, Tuple
 
 import torch
 
+from ..metrics import instruments as _metrics
 from ..ops.reduce_ops import Average, ReduceOp
 from . import mpi_ops
 from .compression import Compression
+
+_STEP_TIME = _metrics.STEP_DURATION.labels("torch")
+_GRAD_NORM = _metrics.GRAD_NORM.labels("torch")
 
 
 class _DistributedOptimizer(torch.optim.Optimizer):
@@ -71,6 +75,9 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         # grads whose hooks fired but which no worker drain has picked up
         # yet; appended on the autograd thread, drained on the worker
         self._ready_params = deque()
+        self._t_last_step = None
+        self._metrics_grad_norm = os.environ.get(
+            "HVD_TPU_METRICS_GRAD_NORM", "1") != "0"
         self._register_hooks()
 
     # -- hooks --------------------------------------------------------------
@@ -97,8 +104,15 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                 # params here and the NEXT drain submits them as one
                 # batched native call (micro-batching by readiness).
                 self._ready_params.append(p)
-                self._pending_submits.append(
-                    self._submit_pool.submit(self._drain_ready))
+                pool = self._submit_pool
+                if pool is not None:  # close() may race a late backward
+                    try:
+                        self._pending_submits.append(
+                            pool.submit(self._drain_ready))
+                    except RuntimeError:
+                        # close() shut the pool down between the check
+                        # and the submit; the grad simply stays local
+                        pass
         return hook
 
     def _drain_ready(self):
@@ -161,12 +175,21 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         pending, self._pending_submits = self._pending_submits, []
         for f in pending:
             f.result()  # re-raises a submit-side error on the caller
+        sq_norm = None
         for p, (handle, ctx) in list(self._handles.items()):
             output = mpi_ops.synchronize(handle)
             grad = self._compression.decompress(output, ctx)
             if self._gradient_predivide_factor != 1.0:
                 grad = grad * self._gradient_predivide_factor
             p.grad = grad.to(p.grad.dtype)
+            if self._metrics_grad_norm:
+                # accumulate ON DEVICE (fp32 accumulation: an fp16 norm
+                # of a large grad overflows); one host sync below
+                n = torch.linalg.vector_norm(
+                    p.grad.detach(), dtype=torch.float32) ** 2
+                sq_norm = n if sq_norm is None else sq_norm + n
+        if sq_norm is not None:
+            _GRAD_NORM.set(float(sq_norm) ** 0.5)
         self._handles.clear()
         self._synchronized = True
 
@@ -192,7 +215,14 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                 )
             self.synchronize()
         self._synchronized = False
-        return super(self.__class__, self).step(closure)
+        result = super(self.__class__, self).step(closure)
+        # step-to-step wall time — the operator's iterations/sec view
+        # (covers forward + backward + allreduce wait + update)
+        now = time.perf_counter()
+        if self._t_last_step is not None:
+            _STEP_TIME.observe(now - self._t_last_step)
+        self._t_last_step = now
+        return result
 
     def zero_grad(self, *args, **kwargs):
         if self._handles or self._pending_submits:
@@ -201,6 +231,25 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                 "before optimizer.step() or optimizer.synchronize()"
             )
         return super(self.__class__, self).zero_grad(*args, **kwargs)
+
+    def close(self):
+        """Detach from the model: remove the gradient hooks and shut the
+        submission worker down (its thread otherwise outlives the
+        optimizer — one leaked thread per DistributedOptimizer).  The
+        wrapped optimizer keeps working as a plain local optimizer."""
+        for h in self._hook_handles:
+            h.remove()
+        self._hook_handles.clear()
+        pool = getattr(self, "_submit_pool", None)
+        if pool is not None:
+            self._submit_pool = None
+            pool.shutdown(wait=True)  # drains in-flight submits first
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass  # interpreter teardown: modules may already be gone
 
 
 def DistributedOptimizer(

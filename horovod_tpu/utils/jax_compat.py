@@ -1,0 +1,52 @@
+"""Compatibility shims across jax versions.
+
+The framework (and its tests/examples) target the modern spelling
+``jax.shard_map(f, mesh=..., in_specs=..., out_specs=..., check_vma=...)``.
+Older jax releases (< 0.5) only ship
+``jax.experimental.shard_map.shard_map`` with the ``check_rep`` keyword.
+``install()`` bridges the gap by publishing a signature-adapting wrapper
+as ``jax.shard_map`` when (and only when) the attribute is missing — on
+modern jax it is a no-op, and nothing is ever overwritten.
+
+Installed from ``horovod_tpu/__init__`` so every consumer (the engine's
+compiled collectives, run_per_rank, the parallel strategies, user
+scripts) sees one working spelling regardless of the image's jax.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def install() -> None:
+    _install_shard_map()
+    _install_axis_size()
+
+
+def _install_shard_map() -> None:
+    if hasattr(jax, "shard_map"):
+        return
+    try:
+        from jax.experimental.shard_map import shard_map as _legacy
+    except ImportError:  # no shard_map at all: leave jax untouched
+        return
+
+    def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                  check_vma=None, **kwargs):
+        if check_vma is not None and "check_rep" not in kwargs:
+            kwargs["check_rep"] = check_vma  # renamed keyword, same role
+        return _legacy(f, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, **kwargs)
+
+    jax.shard_map = shard_map
+
+
+def _install_axis_size() -> None:
+    if hasattr(jax.lax, "axis_size"):
+        return
+
+    def axis_size(axis_name):
+        # this jax's axis_frame() already resolves to the static size
+        return jax.core.axis_frame(axis_name)
+
+    jax.lax.axis_size = axis_size

@@ -96,6 +96,69 @@ def test_elastic_driver_drops_unsigned_register(monkeypatch):
         driver._server.close()
 
 
+# -- auth-mode mismatch fails fast ------------------------------------------
+
+
+def test_auth_mode_mismatch_fails_fast():
+    """A secret-carrying worker dialing a secret-less coordinator must
+    reject the hello IMMEDIATELY with a clear error (the auth-mode flag
+    byte), not hang until the rendezvous timeout.  Drives the native
+    TcpTransport directly over ctypes against a fake coordinator socket —
+    no jax, no fleet."""
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    lib_path = os.path.join(REPO, "horovod_tpu", "native",
+                            "libhvd_tpu_core.so")
+    if not os.path.exists(lib_path):
+        pytest.skip("native core not built")
+    code = f"""
+import ctypes, sys, time
+lib = ctypes.CDLL({lib_path!r})
+lib.hvdtpu_init.restype = ctypes.c_int
+lib.hvdtpu_init.argtypes = [
+    ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+    ctypes.c_double, ctypes.c_longlong, ctypes.c_int, ctypes.c_char_p,
+    ctypes.c_double, ctypes.c_double, ctypes.c_int, ctypes.c_char_p,
+]
+t0 = time.time()
+rc = lib.hvdtpu_init(1, 2, b"127.0.0.1", {port}, 1.0, 1 << 20, 16, b"",
+                     0.0, 0.0, 0, b"")
+elapsed = time.time() - t0
+print("RC", rc, "ELAPSED", elapsed, flush=True)
+sys.exit(0 if rc != 0 and elapsed < 30 else 1)
+"""
+    env = os.environ.copy()
+    env["HVD_TPU_SECRET"] = wire_auth.make_secret()
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        srv.settimeout(30)
+        conn, _ = srv.accept()
+        conn.settimeout(30)
+        hello = b""
+        while len(hello) < 5:  # rank(4) + auth flag(1)
+            chunk = conn.recv(5 - len(hello))
+            if not chunk:
+                break
+            hello += chunk
+        assert struct.unpack("<i", hello[:4])[0] == 1
+        assert hello[4:5] == b"\x01"  # worker advertises auth
+        conn.sendall(b"\x00")         # coordinator: no secret
+        out, err = proc.communicate(timeout=60)
+        assert proc.returncode == 0, (out, err)
+        assert "auth-mode mismatch" in err
+        conn.close()
+    finally:
+        srv.close()
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+
 # -- native star rejects rogue peers ----------------------------------------
 
 
@@ -149,14 +212,16 @@ def test_native_star_rejects_secretless_peer():
             try:
                 s.settimeout(10)
                 s.sendall(struct.pack("<i", 1))       # claim rank 1
+                s.sendall(b"\x01")                    # auth-mode flag: yes
                 s.sendall(b"\x00" * 16)               # challenge Cw
                 hdr = b""
-                while len(hdr) < 48:                  # Cr + coord proof
-                    chunk = s.recv(48 - len(hdr))
+                while len(hdr) < 49:                  # flag + Cr + proof
+                    chunk = s.recv(49 - len(hdr))
                     if not chunk:
                         break
                     hdr += chunk
-                if len(hdr) == 48:
+                if len(hdr) == 49:
+                    assert hdr[0:1] == b"\x01"        # coord is secured
                     s.sendall(b"\x00" * 32)           # forged proof
                     if s.recv(1) == b"":
                         rejected = True
