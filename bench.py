@@ -18,9 +18,19 @@ can hang for minutes when the axon tunnel is down — the round-1 failure
 mode).  Orchestration: bounded-retry TPU probe → timed TPU attempt →
 virtual-CPU fallback, so the run always emits its one JSON line.
 
+Real-data modes (round 6): ``--data npy`` / ``--data folder`` feed the
+step through the ``horovod_tpu.data`` pipeline (sharded source -> worker
+pool -> double-buffered device prefetch) instead of device-resident
+tensors, and ``--data synthetic-stream`` pushes the same synthetic
+tensors through the pipeline — the A/B that prices the host-feeding path
+against the resident headline.  Every mode now reports ``input_wait_ms``
+and pipeline stats in the result JSON so BENCH_*.json tracks
+input-boundness across rounds alongside ``mfu``.
+
 Output: ONE JSON line {"metric", "value", "unit", "vs_baseline", ...}.
 """
 
+import argparse
 import json
 import os
 import subprocess
@@ -106,7 +116,24 @@ def probe_backend(window_s: float) -> str:
         time.sleep(min(remaining, min(60, 5 * attempt)))
 
 
-def run_worker(mode: str, timeout_s: int):
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--worker", default=None, choices=["tpu", "cpu"],
+                   help="internal: run the measured loop itself")
+    p.add_argument("--data", default="synthetic",
+                   choices=["synthetic", "synthetic-stream", "npy", "folder"],
+                   help="synthetic = device-resident tensors (headline); "
+                        "synthetic-stream/npy/folder feed the step through "
+                        "the horovod_tpu.data pipeline")
+    p.add_argument("--data-path", default=None,
+                   help="dataset root for --data npy/folder (npy "
+                        "self-seeds a temp dir when omitted)")
+    p.add_argument("--batch", type=int, default=None,
+                   help="override the per-backend default batch size")
+    return p.parse_args(argv)
+
+
+def run_worker(mode: str, timeout_s: int, args=None):
     """Run ``bench.py --worker <mode>`` under a deadline.  Returns the JSON
     result line (str) or None — the caller decides which line to print so
     the one-line output contract holds across fallback + re-attempt."""
@@ -115,9 +142,16 @@ def run_worker(mode: str, timeout_s: int):
         # prevent axon registration entirely so nothing can hang
         env["PALLAS_AXON_POOL_IPS"] = ""
         env["JAX_PLATFORMS"] = "cpu"
+    cmd = [sys.executable, os.path.abspath(__file__), "--worker", mode]
+    if args is not None:
+        cmd += ["--data", args.data]
+        if args.data_path:
+            cmd += ["--data-path", args.data_path]
+        if args.batch:
+            cmd += ["--batch", str(args.batch)]
     try:
         out = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--worker", mode],
+            cmd,
             capture_output=True,
             text=True,
             timeout=timeout_s,
@@ -137,7 +171,79 @@ def run_worker(mode: str, timeout_s: int):
     return None
 
 
-def worker(mode: str) -> int:
+class _EpochFeed:
+    """Endless batch stream over a data.DataLoader (epoch after epoch),
+    keeping every epoch's prefetcher so pipeline stats aggregate across
+    the whole run — the timed window subtracts a snapshot taken at its
+    start, so warmup batches never pollute the reported wait."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self._iters = []
+
+    def __iter__(self):
+        epoch = 0
+        while True:
+            self.loader.set_epoch(epoch)
+            it = iter(self.loader)
+            self._iters.append(it)
+            for item in it:
+                yield item
+            epoch += 1
+
+    def stats(self) -> dict:
+        totals = {}
+        for it in self._iters:
+            for k, v in it.stats().items():
+                if k == "prefetch_depth":
+                    totals[k] = v
+                elif not k.endswith("_mean"):  # totals/counts sum cleanly
+                    totals[k] = round(totals.get(k, 0) + v, 3)
+        n = max(totals.get("batches", 1), 1)
+        for key in ("input_wait", "host_produce", "device_put"):
+            totals[f"{key}_ms_mean"] = round(
+                totals.get(f"{key}_ms_total", 0.0) / n, 3)
+        return totals
+
+
+def _build_feed(args, batch: int, image_size: int, on_tpu: bool):
+    """Build the pipeline-fed batch stream for the non-resident modes."""
+    import numpy as np
+    from horovod_tpu import data
+
+    kind = "synthetic" if args.data == "synthetic-stream" else args.data
+    path = args.data_path
+    if kind == "npy" and path is None:
+        # self-seed: uint8 shards on disk (the realistic storage dtype —
+        # decode is astype(float32)/255 on the worker pool), enough for
+        # 8 batches; the feed loops epochs so the step count is unbounded
+        import atexit
+        import shutil
+        import tempfile
+
+        n = 8 * batch
+        rng = np.random.RandomState(0)
+        inputs = rng.randint(0, 256, size=(n, image_size, image_size, 3),
+                             dtype=np.uint8)
+        labels = rng.randint(0, 1000, size=(n,)).astype(np.int32)
+        path = tempfile.mkdtemp(prefix="hvd_tpu_bench_npy_")
+        # ~155 MB at the TPU config, and the orchestrator may run up to
+        # three workers per bench — always reap the seeded dir at exit
+        atexit.register(shutil.rmtree, path, ignore_errors=True)
+        data.write_npy_shards(path, inputs, labels, num_shards=4)
+        print(f"[bench] seeded {n} uint8 samples into {path}",
+              file=sys.stderr)
+    loader = data.make_loader(
+        kind, path, batch_size=batch, image_size=image_size,
+        synthetic_samples=8 * batch,
+        # bf16 host cast halves the host->device bytes; the first conv
+        # consumes bf16 anyway (model dtype)
+        cast="bfloat16" if on_tpu else None,
+    )
+    return _EpochFeed(loader)
+
+
+def worker(mode: str, args) -> int:
     """The measured run itself.  mode: 'tpu' (default backend) or 'cpu'."""
     import jax
 
@@ -157,7 +263,7 @@ def worker(mode: str) -> int:
     if mode == "tpu" and not on_tpu:
         print("[bench] worker asked for tpu but got cpu backend", file=sys.stderr)
         return 1
-    batch = 128 if on_tpu else 16
+    batch = args.batch or (128 if on_tpu else 16)
     image_size = 224 if on_tpu else 64
     warmup, iters = (5, 30) if on_tpu else (1, 2)
 
@@ -168,14 +274,22 @@ def worker(mode: str) -> int:
         num_classes=1000, dtype=jnp.bfloat16, stem="space_to_depth"
     )
     rng = jax.random.PRNGKey(0)
-    images = jnp.asarray(
-        np.random.RandomState(0)
-        .randn(batch, image_size, image_size, 3)
-        .astype(np.float32)
-    )
-    labels = jnp.asarray(
-        np.random.RandomState(1).randint(0, 1000, size=(batch,))
-    )
+    feed = None
+    if args.data == "synthetic":
+        # device-resident tensors: the headline config (input pipeline
+        # exonerated as a limiter on this path — PERF.md r4 lever sweep)
+        images = jnp.asarray(
+            np.random.RandomState(0)
+            .randn(batch, image_size, image_size, 3)
+            .astype(np.float32)
+        )
+        labels = jnp.asarray(
+            np.random.RandomState(1).randint(0, 1000, size=(batch,))
+        )
+    else:
+        feed = _build_feed(args, batch, image_size, on_tpu)
+        feed_iter = iter(feed)
+        images, labels = next(feed_iter)
 
     optimizer = optax.sgd(0.1, momentum=0.9)
     state = training.create_train_state(model, optimizer, rng, images[:2])
@@ -205,20 +319,58 @@ def worker(mode: str) -> int:
         except Exception as e:  # best-effort on remote backends
             print(f"[bench] cost_analysis unavailable: {e}", file=sys.stderr)
 
-    for _ in range(warmup):
-        state, loss = step(state, images, labels)
+    if feed is None:
+        for _ in range(warmup):
+            state, loss = step(state, images, labels)
+    else:
+        state, loss = step(state, images, labels)  # the batch compile ate
+        for _ in range(warmup - 1):
+            state, loss = step(state, *next(feed_iter))
     # fetch the scalar (not just block_until_ready): a device->host
     # roundtrip is the only sync some remote backends honor
     float(loss)
 
+    wait0 = feed.stats() if feed is not None else {}
     t0 = time.perf_counter()
-    for _ in range(iters):
-        state, loss = step(state, images, labels)
+    if feed is None:
+        for _ in range(iters):
+            state, loss = step(state, images, labels)
+    else:
+        for _ in range(iters):
+            state, loss = step(state, *next(feed_iter))
     final_loss = float(loss)
     dt = time.perf_counter() - t0
     assert np.isfinite(final_loss), f"non-finite loss {final_loss}"
 
     img_per_sec = batch * iters / dt
+    # input-boundness record (round-6 ask #3): wait accumulated over the
+    # TIMED window only, so BENCH_*.json tracks it alongside mfu
+    if feed is not None:
+        pipeline = feed.stats()
+        input_wait_ms = round(
+            (pipeline.get("input_wait_ms_total", 0.0)
+             - wait0.get("input_wait_ms_total", 0.0)) / iters, 3)
+        pipeline["starved_batches"] = int(
+            pipeline.get("starved_batches", 0)
+            - wait0.get("starved_batches", 0))
+        timed = max(
+            int(pipeline.pop("batches", 0) - wait0.get("batches", 0)), 1)
+        pipeline["timed_batches"] = timed
+        # per-batch means over the TIMED window only — whole-run means
+        # would fold warmup (incl. the compile step) into the record
+        for key in ("host_produce", "device_put"):
+            pipeline[f"{key}_ms_mean"] = round(
+                (pipeline.get(f"{key}_ms_total", 0.0)
+                 - wait0.get(f"{key}_ms_total", 0.0)) / timed, 3)
+        for k in ("input_wait_ms_total", "input_wait_ms_mean",
+                  "host_produce_ms_total", "device_put_ms_total"):
+            pipeline.pop(k, None)
+        from horovod_tpu.data import workers as _data_workers
+
+        pipeline["workers"] = _data_workers.default_num_workers()
+    else:
+        pipeline = {"mode": "device_resident"}
+        input_wait_ms = 0.0
     result = {
         "metric": "resnet50_synthetic_train_throughput",
         "value": round(img_per_sec, 2),
@@ -229,6 +381,11 @@ def worker(mode: str) -> int:
         "image_size": image_size,
         "step_time_ms": round(dt / iters * 1e3, 2),
         "n_devices": jax.device_count(),
+        "data": args.data,
+        "input_wait_ms": input_wait_ms,
+        "input_wait_pct": round(
+            100.0 * input_wait_ms / max(dt / iters * 1e3, 1e-9), 2),
+        "pipeline": pipeline,
     }
     if not on_tpu:
         # the record must say WHY it is a CPU number (probe failure or a
@@ -264,8 +421,9 @@ def worker(mode: str) -> int:
 
 
 def main() -> int:
-    if len(sys.argv) >= 3 and sys.argv[1] == "--worker":
-        return worker(sys.argv[2])
+    args = parse_args()
+    if args.worker:
+        return worker(args.worker, args)
     t0 = time.monotonic()
 
     def remaining() -> float:
@@ -276,7 +434,7 @@ def main() -> int:
         30.0, min(PROBE_WINDOW_S, remaining() - CPU_RUN_TIMEOUT_S - 30))
     backend = probe_backend(probe_window)
     if backend == "tpu":
-        line = run_worker("tpu", TPU_RUN_TIMEOUT_S)
+        line = run_worker("tpu", TPU_RUN_TIMEOUT_S, args)
         if line:
             print(line)
             return 0
@@ -293,7 +451,7 @@ def main() -> int:
             "window; running the CPU fallback, then re-probing once more",
             file=sys.stderr,
         )
-    cpu_line = run_worker("cpu", CPU_RUN_TIMEOUT_S)
+    cpu_line = run_worker("cpu", CPU_RUN_TIMEOUT_S, args)
     # End-of-run TPU re-attempt — for the hung/unknown probe and for a
     # probe-ok-but-run-failed outage (the tunnel may have recovered while
     # the CPU run burned time); never for a deterministic cpu-only host.
@@ -304,7 +462,7 @@ def main() -> int:
             and remaining() > FINAL_PROBE_WINDOW_S + TPU_RUN_TIMEOUT_S + 30
             and probe_backend(FINAL_PROBE_WINDOW_S) == "tpu"):
         print("[bench] TPU recovered; re-attempting the chip run", file=sys.stderr)
-        line = run_worker("tpu", TPU_RUN_TIMEOUT_S)
+        line = run_worker("tpu", TPU_RUN_TIMEOUT_S, args)
         if line:
             print(line)
             return 0

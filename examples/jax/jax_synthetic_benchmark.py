@@ -9,6 +9,11 @@ program over the world mesh.
 
     python examples/jax/jax_synthetic_benchmark.py --model ResNet50
     tpurun -np 2 python examples/jax/jax_synthetic_benchmark.py  # CPU demo
+
+``--data npy --data-path DIR`` (or ``--data folder``) feeds the step
+through the ``horovod_tpu.data`` pipeline — per-rank sharded on-disk
+arrays, worker-pool decode, double-buffered device prefetch — and prints
+the pipeline's input-wait stats next to img/sec (docs/DATA.md).
 """
 
 import argparse
@@ -20,7 +25,7 @@ import numpy as np
 import optax
 
 import horovod_tpu as hvd
-from horovod_tpu import models, training
+from horovod_tpu import data as hvd_data, models, training
 
 
 def main():
@@ -36,6 +41,11 @@ def main():
     p.add_argument("--warmup", type=int, default=3)
     p.add_argument("--stem", default="space_to_depth",
                    choices=["conv", "space_to_depth"])
+    p.add_argument("--data", default="synthetic",
+                   choices=["synthetic", "npy", "folder"],
+                   help="synthetic = device-resident; npy/folder stream "
+                        "through the horovod_tpu.data pipeline")
+    p.add_argument("--data-path", default=None)
     args = p.parse_args()
 
     hvd.init()
@@ -48,14 +58,31 @@ def main():
     # per-worker means per-chip: the compiled step shards the global
     # batch over every chip of the world mesh (training.py P(axis))
     global_batch = args.batch_size * max(hvd.size(), 1)
-    images = jnp.asarray(
-        np.random.RandomState(0)
-        .randn(global_batch, args.image_size, args.image_size, 3)
-        .astype(np.float32)
-    )
-    labels = jnp.asarray(
-        np.random.RandomState(1).randint(0, 1000, size=(global_batch,))
-    )
+    loader = None
+    if args.data == "synthetic":
+        images = jnp.asarray(
+            np.random.RandomState(0)
+            .randn(global_batch, args.image_size, args.image_size, 3)
+            .astype(np.float32)
+        )
+        labels = jnp.asarray(
+            np.random.RandomState(1).randint(0, 1000, size=(global_batch,))
+        )
+    else:
+        # the drop-in loader, prefetched to device (docs/DATA.md).  The
+        # compiled step takes the GLOBAL batch, and like the resident
+        # path every process supplies it whole — so the loader is pinned
+        # to the un-sharded spec here (per-rank sharding pairs with
+        # per-process global-array assembly, out of scope for this demo)
+        loader = hvd_data.make_loader(
+            args.data, args.data_path, batch_size=global_batch,
+            image_size=args.image_size,
+            shard=hvd_data.ShardSpec(0, 1))
+        if len(loader) == 0:
+            raise SystemExit(
+                f"dataset too small: needs >= {global_batch} samples "
+                f"for one global batch")
+        images, labels = next(iter(loader))
     optimizer = optax.sgd(0.01, momentum=0.9)
     state = training.create_train_state(
         model, optimizer, jax.random.PRNGKey(0), images[:2]
@@ -74,14 +101,22 @@ def main():
     img_secs = []
     for i in range(args.num_iters):
         t0 = time.perf_counter()
-        for _ in range(args.num_batches_per_iter):
-            state, loss = step(state, images, labels)
-        float(loss)
+        if loader is None:
+            for _ in range(args.num_batches_per_iter):
+                state, loss = step(state, images, labels)
+            float(loss)
+            n_batches = args.num_batches_per_iter
+        else:
+            state, loss = training.fit_epoch(step, state, loader, epoch=i)
+            n_batches = max(len(loader), 1)
         dt = time.perf_counter() - t0
-        rate = global_batch * args.num_batches_per_iter / dt
+        rate = global_batch * n_batches / dt
         img_secs.append(rate)
         if hvd.rank() == 0:
-            print(f"Iter #{i}: {rate:.1f} img/sec total")
+            extra = (f"  (input wait "
+                     f"{loader.stats().get('input_wait_ms_mean', 0)} "
+                     "ms/batch)") if loader is not None else ""
+            print(f"Iter #{i}: {rate:.1f} img/sec total{extra}")
     if hvd.rank() == 0:
         mean, conf = np.mean(img_secs), 1.96 * np.std(img_secs)
         print(f"Img/sec total: {mean:.1f} +- {conf:.1f}")

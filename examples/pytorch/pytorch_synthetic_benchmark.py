@@ -11,6 +11,13 @@ absolute numbers here measure the adapter path, not the chip — bench.py
 is the TPU-native headline.
 
 Run:  tpurun -np 2 python examples/pytorch/pytorch_synthetic_benchmark.py
+
+``--data npy --data-path DIR`` feeds real on-disk arrays through the
+``horovod_tpu.data`` pipeline instead of a resident synthetic batch:
+``device_put=False`` makes the loader yield host numpy batches (sharded
+per rank, decoded on the worker pool, prefetched one batch ahead) and
+``torch.from_numpy`` wraps them zero-copy — the drop-in loader pattern
+for every torch script (see docs/DATA.md).
 """
 
 import argparse
@@ -22,6 +29,7 @@ import torch.nn as nn
 import torch.nn.functional as F
 
 import horovod_tpu.torch as hvd
+from horovod_tpu import data as hvd_data
 
 
 class SmallConvNet(nn.Module):
@@ -44,6 +52,10 @@ def main():
     parser.add_argument("--image-size", type=int, default=64)
     parser.add_argument("--num-iters", type=int, default=10)
     parser.add_argument("--num-warmup", type=int, default=3)
+    parser.add_argument("--data", default="synthetic",
+                        choices=["synthetic", "npy", "folder"])
+    parser.add_argument("--data-path", default=None,
+                        help="dataset root for --data npy/folder")
     args = parser.parse_args()
 
     hvd.init()
@@ -57,17 +69,42 @@ def main():
         optimizer, named_parameters=model.named_parameters()
     )
 
-    rng = np.random.RandomState(hvd.cross_rank())
-    data = torch.as_tensor(rng.rand(
-        args.batch_size, 3, args.image_size, args.image_size
-    ).astype(np.float32))
-    target = torch.as_tensor(
-        rng.randint(0, 100, size=(args.batch_size,))
-    )
+    if args.data == "synthetic":
+        rng = np.random.RandomState(hvd.cross_rank())
+        batches = None
+        data = torch.as_tensor(rng.rand(
+            args.batch_size, 3, args.image_size, args.image_size
+        ).astype(np.float32))
+        target = torch.as_tensor(
+            rng.randint(0, 100, size=(args.batch_size,))
+        )
+    else:
+        # the drop-in loader: host numpy batches (device_put=False — the
+        # torch bridge owns placement), sharded per rank over the live
+        # topology, worker-pool decoded, prefetched one batch ahead
+        loader = hvd_data.make_loader(
+            args.data, args.data_path, batch_size=args.batch_size,
+            image_size=args.image_size, device_put=False)
+
+        def batches():
+            epoch = 0
+            while True:
+                loader.set_epoch(epoch)
+                for inputs, labels in loader:
+                    # NHWC (decode layout) -> NCHW, zero-copy wrap
+                    yield (torch.from_numpy(
+                               np.ascontiguousarray(
+                                   inputs.transpose(0, 3, 1, 2))),
+                           # benchmark net has 100 classes; fold labels in
+                           torch.from_numpy(labels.astype(np.int64) % 100))
+                epoch += 1
+
+        batches = batches()
 
     def step():
+        nonlocal_data = (data, target) if batches is None else next(batches)
         optimizer.zero_grad()
-        loss = F.cross_entropy(model(data), target)
+        loss = F.cross_entropy(model(nonlocal_data[0]), nonlocal_data[1])
         loss.backward()
         optimizer.step()
 

@@ -1,0 +1,237 @@
+"""Double-buffered device prefetcher: overlap host->device with compute.
+
+The one structural fact about feeding a TPU from the host: the transfer
+of batch N+1 must run while batch N computes, or every step pays
+``transfer + compute`` instead of ``max(transfer, compute)``.  XLA gives
+no free overlap for host-produced arrays — ``jax.device_put`` must be
+*issued* before the step needs the data — so a background thread stages
+batches into a bounded queue of device-resident arrays ahead of the
+training thread.
+
+``depth`` (``HVD_TPU_PREFETCH_DEPTH``, default 2) is the double buffer:
+one batch on device being consumed, one in flight.  Deeper queues buy
+tolerance to host-side jitter (a slow decode burst) at the cost of HBM
+for the staged batches; depth 2 is the classic sweet spot and matches
+what flax's ``jax_utils.prefetch_to_device`` defaults to.
+
+Instrumented via the PR-1 metrics subsystem: queue-depth gauge, host-wait
+(input starvation) and produce/transfer histograms.  Local counters are
+mirrored in :meth:`stats` so bench.py can emit them in its result JSON
+without scraping the registry.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from typing import Callable, Iterable, Iterator, Optional
+
+import numpy as np
+
+from ..metrics import instruments as _instr
+
+__all__ = ["DevicePrefetcher", "prefetch_to_device", "default_prefetch_depth"]
+
+#: Env knob: staged device batches (0 = prefetch off, synchronous puts).
+PREFETCH_ENV = "HVD_TPU_PREFETCH_DEPTH"
+
+_SENTINEL = object()
+
+
+def default_prefetch_depth() -> int:
+    env = os.environ.get(PREFETCH_ENV)
+    if env is not None:
+        n = int(env)
+        if n < 0:
+            raise ValueError(f"{PREFETCH_ENV} must be >= 0, got {n}")
+        return n
+    return 2
+
+
+def _host_cast(batch, cast):
+    """Apply the host-side dtype cast to the float arrays of a batch.
+
+    Casting fp32 image tensors to bf16 on the host halves the bytes that
+    cross PCIe / the tunnel — the transfer is the scarce resource, and
+    the first conv consumes bf16 anyway (the on-device cast is free but
+    the transfer of the fp32 bytes is not).  Integer arrays (labels) pass
+    through untouched.
+    """
+    if cast is None:
+        return batch
+    dtype = np.dtype(cast)
+    return tuple(
+        np.asarray(a, dtype=dtype)
+        if isinstance(a, np.ndarray) and np.issubdtype(a.dtype, np.floating)
+        else a
+        for a in batch
+    )
+
+
+class DevicePrefetcher:
+    """Iterate device-resident batches, staged ``depth`` ahead.
+
+    Wraps an iterator of host batches (tuples of numpy arrays).  Each
+    batch is optionally cast (``cast="bfloat16"``), placed with
+    ``jax.device_put`` (optionally against an explicit ``sharding``), and
+    queued.  With ``depth=0`` the prefetch thread is bypassed entirely —
+    synchronous per-next staging, the A/B baseline for measuring what
+    the overlap is worth.
+
+    The background thread is a daemon and also shuts down cleanly on
+    ``close()``/GC; a producer exception re-raises on the consumer side
+    in order.
+    """
+
+    def __init__(self, host_batches: Iterable, *,
+                 depth: Optional[int] = None,
+                 cast: Optional[str] = None,
+                 sharding=None,
+                 device_put: bool = True,
+                 source_kind: str = "custom",
+                 put_timing: Optional[Callable[[], None]] = None):
+        del put_timing  # reserved
+        self._host_iter = iter(host_batches)
+        self.depth = default_prefetch_depth() if depth is None else int(depth)
+        self.cast = cast
+        self.sharding = sharding
+        self.device_put = device_put
+        self.source_kind = source_kind
+        # local mirrors of the registry instruments, for bench JSON
+        self._batches = 0
+        self._wait_s = 0.0
+        self._produce_s = 0.0
+        self._put_s = 0.0
+        self._starved = 0
+        self._queue: Optional[queue.Queue] = None
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+        if self.depth > 0:
+            self._queue = queue.Queue(maxsize=self.depth)
+            self._thread = threading.Thread(
+                target=self._producer, name="hvd-tpu-prefetch", daemon=True)
+            self._thread.start()
+
+    # -- staging -------------------------------------------------------------
+
+    def _stage(self, batch):
+        """Cast + device_put one host batch; returns the staged batch."""
+        t0 = time.perf_counter()
+        batch = _host_cast(batch, self.cast)
+        if self.device_put:
+            import jax
+
+            if self.sharding is not None:
+                batch = jax.device_put(batch, self.sharding)
+            else:
+                batch = jax.device_put(batch)
+        dt = time.perf_counter() - t0
+        self._put_s += dt
+        _instr.DATA_DEVICE_PUT.observe(dt)
+        return batch
+
+    def _producer(self):
+        try:
+            while not self._closed:
+                t0 = time.perf_counter()
+                try:
+                    item = next(self._host_iter)
+                except StopIteration:
+                    self._queue.put(_SENTINEL)
+                    return
+                self._produce_s += time.perf_counter() - t0
+                self._queue.put(self._stage(item))
+        except BaseException as e:  # re-raise on the consumer side
+            self._queue.put(e)
+
+    # -- iteration -----------------------------------------------------------
+
+    def __iter__(self) -> Iterator:
+        return self
+
+    def __next__(self):
+        if self.depth == 0:
+            # synchronous path: the measured baseline without overlap
+            t0 = time.perf_counter()
+            try:
+                item = next(self._host_iter)
+            except StopIteration:
+                raise
+            self._produce_s += time.perf_counter() - t0
+            staged = self._stage(item)
+            self._account_delivery(waited=0.0)
+            return staged
+        t0 = time.perf_counter()
+        item = self._queue.get()
+        waited = time.perf_counter() - t0
+        if item is _SENTINEL:
+            self._queue.put(_SENTINEL)  # idempotent exhaustion
+            raise StopIteration
+        if isinstance(item, BaseException):
+            self._queue.put(item)
+            raise item
+        self._account_delivery(waited=waited)
+        return item
+
+    def _account_delivery(self, waited: float) -> None:
+        self._batches += 1
+        self._wait_s += waited
+        if waited > 0.001:
+            self._starved += 1
+        _instr.DATA_HOST_WAIT.observe(waited)
+        _instr.DATA_BATCHES.labels(source=self.source_kind).inc()
+        _instr.DATA_PREFETCH_DEPTH.set(
+            self._queue.qsize() if self._queue is not None else 0)
+
+    # -- stats / lifecycle ---------------------------------------------------
+
+    def stats(self) -> dict:
+        """Pipeline counters for this iterator's lifetime (bench JSON).
+        ``*_total`` fields sum cleanly across epoch iterators; the means
+        are per delivered batch."""
+        n = max(self._batches, 1)
+        return {
+            "batches": self._batches,
+            "prefetch_depth": self.depth,
+            "input_wait_ms_total": round(self._wait_s * 1e3, 3),
+            "input_wait_ms_mean": round(self._wait_s / n * 1e3, 3),
+            "host_produce_ms_total": round(self._produce_s * 1e3, 3),
+            "host_produce_ms_mean": round(self._produce_s / n * 1e3, 3),
+            "device_put_ms_total": round(self._put_s * 1e3, 3),
+            "device_put_ms_mean": round(self._put_s / n * 1e3, 3),
+            "starved_batches": self._starved,
+        }
+
+    def close(self) -> None:
+        self._closed = True
+        if self._queue is not None:
+            # unblock a producer waiting on a full queue
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except queue.Empty:
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        # release the upstream pipeline too (map_ordered holds a worker
+        # pool open until its generator is closed)
+        close_upstream = getattr(self._host_iter, "close", None)
+        if close_upstream is not None:
+            try:
+                close_upstream()
+            except Exception:
+                pass  # generator mid-next on a stuck thread: GC handles it
+
+    def __del__(self):  # pragma: no cover - GC timing
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def prefetch_to_device(host_batches: Iterable, depth: Optional[int] = None,
+                       **kwargs) -> DevicePrefetcher:
+    """Functional spelling of :class:`DevicePrefetcher` (flax-idiom name)."""
+    return DevicePrefetcher(host_batches, depth=depth, **kwargs)

@@ -109,6 +109,42 @@ NATIVE_LAST_REQUEST_BYTES = gauge(
     "Bytes of this rank's last non-empty negotiation report",
 )
 
+# -- input pipeline (data/) ---------------------------------------------------
+
+#: Device-ready batches staged in the prefetch queue at consume time.
+#: 0 sustained = the host cannot keep up (input-bound); ~depth = healthy.
+DATA_PREFETCH_DEPTH = gauge(
+    "hvd_tpu_data_prefetch_depth",
+    "Device-ready batches currently staged in the prefetch queue",
+)
+
+#: Time the training thread blocked in next() waiting for a device batch —
+#: THE input-starvation signal (0 when the pipeline is fully overlapped).
+DATA_HOST_WAIT = histogram(
+    "hvd_tpu_data_host_wait_seconds",
+    "Training-thread wait for the next prefetched batch (input starvation)",
+)
+
+#: Host-side cost of producing one batch: source read + decode + collate
+#: (worker-pool time, overlapped with device compute when healthy).
+DATA_BATCH_PRODUCE = histogram(
+    "hvd_tpu_data_batch_produce_seconds",
+    "Host-side decode/collate time per batch (worker pool)",
+)
+
+#: Host->device staging cost of one batch (cast + device_put dispatch).
+DATA_DEVICE_PUT = histogram(
+    "hvd_tpu_data_device_put_seconds",
+    "Host-to-device transfer staging time per prefetched batch",
+)
+
+#: Batches delivered to the training thread, by source kind.
+DATA_BATCHES = counter(
+    "hvd_tpu_data_batches_total",
+    "Batches delivered by the input pipeline, by source kind",
+    ["source"],
+)
+
 # -- elastic (runner/elastic_driver.py, elastic/worker.py) -------------------
 
 ELASTIC_WORLD_SIZE = gauge(

@@ -55,6 +55,17 @@ class TcpTransport : public Transport {
   // before the flag existed, a mismatched fleet hung until the rendezvous
   // timeout with no hint at the cause (one side waiting for challenge
   // bytes the other never sends).
+  //
+  // Steady state (authenticated mode): every negotiation frame carries an
+  // HMAC-SHA256 trailer under a per-connection key derived from the hello
+  // challenges — key = HMAC(secret, "frame" + Cw + Cr) — over a direction
+  // byte ('C' coordinator->worker / 'W' worker->coordinator, blocking
+  // reflection), a per-direction monotonic sequence number (blocking
+  // replay/reorder), and the payload.  Closes the round-5 ADVICE gap: the
+  // hello proved identity but left post-handshake frames open to
+  // injection by anyone who could splice the TCP stream.  A bad MAC
+  // poisons the transport exactly like a peer death — FailAllPending on
+  // the Python side, never a silently accepted forged response.
   TcpTransport(const std::string& host, int port, int rank, int size,
                double timeout_sec = 60.0)
       : rank_(rank), size_(size) {
@@ -68,9 +79,9 @@ class TcpTransport : public Transport {
   }
 
   ~TcpTransport() override {
-    for (int fd : peer_fds_)
-      if (fd >= 0) ::close(fd);
-    if (root_fd_ >= 0) ::close(root_fd_);
+    for (auto& peer : peers_)
+      if (peer.fd >= 0) ::close(peer.fd);
+    if (root_.fd >= 0) ::close(root_.fd);
     if (listen_fd_ >= 0) ::close(listen_fd_);
   }
 
@@ -89,7 +100,7 @@ class TcpTransport : public Transport {
       std::vector<std::future<bool>> done;
       for (int r = 1; r < size_; ++r) {
         done.push_back(pool_.Submit([this, r, &all] {
-          return ReadFrame(peer_fds_[r], &all[r]);
+          return ReadFrame(&peers_[r], &all[r]);
         }));
       }
       bool ok = true;
@@ -100,7 +111,7 @@ class TcpTransport : public Transport {
       }
       return all;
     }
-    if (!WriteFrame(root_fd_, mine)) failed_ = true;
+    if (!WriteFrame(&root_, mine)) failed_ = true;
     return {};
   }
 
@@ -110,7 +121,7 @@ class TcpTransport : public Transport {
       std::vector<std::future<bool>> done;
       for (int r = 1; r < size_; ++r) {
         done.push_back(pool_.Submit([this, r, &payload] {
-          return WriteFrame(peer_fds_[r], payload);
+          return WriteFrame(&peers_[r], payload);
         }));
       }
       bool ok = true;
@@ -122,7 +133,7 @@ class TcpTransport : public Transport {
       return payload;
     }
     std::string out;
-    if (!ReadFrame(root_fd_, &out)) {
+    if (!ReadFrame(&root_, &out)) {
       failed_ = true;
       return {};
     }
@@ -130,6 +141,25 @@ class TcpTransport : public Transport {
   }
 
  private:
+  // Per-connection steady-state state.  ``mac_key`` is empty in
+  // unauthenticated mode (frames travel bare, as before the round-6
+  // change); the sequence counters are per-direction so a recorded frame
+  // cannot be replayed or reordered within either stream.
+  struct Conn {
+    int fd = -1;
+    std::string mac_key;
+    uint64_t send_seq = 0;
+    uint64_t recv_seq = 0;
+  };
+
+  // The per-connection frame key, bound to BOTH hello challenges so
+  // neither side alone controls it and every connection (even a
+  // reconnecting same-rank peer) gets a fresh key.
+  std::string DeriveFrameKey(const std::string& cw,
+                             const std::string& cr) const {
+    return secret::HmacSha256(secret_, "frame" + cw + cr);
+  }
+
   void AcceptPeers(int port, double timeout_sec) {
     listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
     int one = 1;
@@ -144,7 +174,7 @@ class TcpTransport : public Transport {
       failed_ = true;
       return;
     }
-    peer_fds_.assign(size_, -1);
+    peers_.assign(size_, Conn{});
     auto deadline = Clock::now() +
                     std::chrono::duration_cast<Clock::duration>(
                         std::chrono::duration<double>(timeout_sec));
@@ -190,7 +220,8 @@ class TcpTransport : public Transport {
         ::close(fd);
         continue;  // keep listening: a lone rogue must not kill the job
       }
-      if (!secret_.empty() && !AuthenticatePeer(fd, peer_rank)) {
+      std::string frame_key;
+      if (!secret_.empty() && !AuthenticatePeer(fd, peer_rank, &frame_key)) {
         // unauthenticated peer on the negotiation port: reject the
         // connection, keep listening for the real rank (the rogue must
         // not consume the rank slot)
@@ -198,7 +229,7 @@ class TcpTransport : public Transport {
         continue;
       }
       SetRecvTimeout(fd, 0.0);  // steady state: blocking frame reads
-      peer_fds_[peer_rank] = fd;
+      peers_[peer_rank] = Conn{fd, frame_key, 0, 0};
       ++accepted;
     }
   }
@@ -207,7 +238,8 @@ class TcpTransport : public Transport {
   // Wire: <- rank(4) + flag(1) already read, -> flag(1) already sent;
   // <- Cw(16); -> Cr(16) + HMAC(secret, "coord" + Cw)(32);
   // <- HMAC(secret, "rank" + rank + Cr)(32).
-  bool AuthenticatePeer(int fd, int32_t peer_rank) {
+  // On success ``*frame_key`` holds the steady-state MAC key.
+  bool AuthenticatePeer(int fd, int32_t peer_rank, std::string* frame_key) {
     std::string cw(16, '\0');
     if (!ReadAll(fd, &cw[0], cw.size())) return false;
     std::string cr;
@@ -226,11 +258,14 @@ class TcpTransport : public Transport {
     std::string want = secret::HmacSha256(
         secret_, "rank" + std::string(reinterpret_cast<char*>(&peer_rank),
                                       4) + cr);
-    return secret::MacEqual(proof, want);
+    if (!secret::MacEqual(proof, want)) return false;
+    *frame_key = DeriveFrameKey(cw, cr);
+    return true;
   }
 
   // Worker side of the mutual handshake; false = tear down and fail.
-  bool AuthenticateToRoot(int fd) {
+  // On success ``*frame_key`` holds the steady-state MAC key.
+  bool AuthenticateToRoot(int fd, std::string* frame_key) {
     std::string cw;
     if (!secret::RandomChallenge(&cw)) {
       std::fprintf(stderr,
@@ -249,7 +284,9 @@ class TcpTransport : public Transport {
     std::string proof = secret::HmacSha256(
         secret_, "rank" + std::string(reinterpret_cast<char*>(&my_rank),
                                       4) + cr);
-    return WriteAll(fd, proof.data(), proof.size());
+    if (!WriteAll(fd, proof.data(), proof.size())) return false;
+    *frame_key = DeriveFrameKey(cw, cr);
+    return true;
   }
 
   void ConnectToRoot(const std::string& host, int port, double timeout_sec) {
@@ -294,9 +331,10 @@ class TcpTransport : public Transport {
             failed_ = true;
             return;
           }
-          if (secret_.empty() || AuthenticateToRoot(fd)) {
+          std::string frame_key;
+          if (secret_.empty() || AuthenticateToRoot(fd, &frame_key)) {
             SetRecvTimeout(fd, 0.0);  // steady state: blocking reads
-            root_fd_ = fd;
+            root_ = Conn{fd, frame_key, 0, 0};
             return;
           }
         }
@@ -345,25 +383,71 @@ class TcpTransport : public Transport {
     return true;
   }
 
-  static bool ReadFrame(int fd, std::string* out) {
-    uint32_t len = 0;
-    if (!ReadAll(fd, &len, 4) || len > (256u << 20)) return false;
-    out->resize(len);
-    return len == 0 || ReadAll(fd, out->data(), len);
+  // The MAC input: direction byte + LE64 sequence number + payload.
+  // Direction is the SENDER's role ('C' = coordinator, 'W' = worker), so
+  // a frame echoed back at its author never verifies; the sequence is
+  // per-direction monotonic, so capture-and-replay (or reorder) of a
+  // validly MAC'd frame fails too.
+  static std::string FrameMac(const std::string& key, char dir,
+                              uint64_t seq, const std::string& payload) {
+    char hdr[9];
+    hdr[0] = dir;
+    for (int i = 0; i < 8; ++i)
+      hdr[1 + i] = static_cast<char>(seq >> (8 * i));
+    return secret::HmacSha256(key, std::string(hdr, 9) + payload);
   }
 
-  static bool WriteFrame(int fd, const std::string& payload) {
+  char SendDir() const { return rank_ == 0 ? 'C' : 'W'; }
+  char RecvDir() const { return rank_ == 0 ? 'W' : 'C'; }
+
+  // Steady-state frame wire: len(4, LE) + payload + MAC(32, authenticated
+  // mode only).  A bad length, short read, or MAC mismatch returns false,
+  // which the callers translate into transport failure (FailAllPending on
+  // the Python side) — a tampered or injected frame can fail the job but
+  // never feed it a forged negotiation payload.
+  bool ReadFrame(Conn* conn, std::string* out) {
+    uint32_t len = 0;
+    if (!ReadAll(conn->fd, &len, 4) || len > (256u << 20)) return false;
+    out->resize(len);
+    if (len != 0 && !ReadAll(conn->fd, out->data(), len)) return false;
+    if (conn->mac_key.empty()) return true;
+    std::string mac(32, '\0');
+    if (!ReadAll(conn->fd, &mac[0], mac.size())) return false;
+    std::string want =
+        FrameMac(conn->mac_key, RecvDir(), conn->recv_seq, *out);
+    if (!secret::MacEqual(mac, want)) {
+      std::fprintf(stderr,
+                   "[ERROR] hvd_tpu_core: bad MAC on steady-state "
+                   "negotiation frame (seq %llu) — tampered or injected "
+                   "traffic on the control channel; failing the "
+                   "transport\n",
+                   static_cast<unsigned long long>(conn->recv_seq));
+      return false;
+    }
+    ++conn->recv_seq;
+    return true;
+  }
+
+  bool WriteFrame(Conn* conn, const std::string& payload) {
     uint32_t len = static_cast<uint32_t>(payload.size());
-    if (!WriteAll(fd, &len, 4)) return false;
-    return payload.empty() || WriteAll(fd, payload.data(), payload.size());
+    if (!WriteAll(conn->fd, &len, 4)) return false;
+    if (!payload.empty() &&
+        !WriteAll(conn->fd, payload.data(), payload.size()))
+      return false;
+    if (conn->mac_key.empty()) return true;
+    std::string mac =
+        FrameMac(conn->mac_key, SendDir(), conn->send_seq, payload);
+    if (!WriteAll(conn->fd, mac.data(), mac.size())) return false;
+    ++conn->send_seq;
+    return true;
   }
 
   int rank_;
   int size_;
   std::string secret_;
   int listen_fd_ = -1;
-  int root_fd_ = -1;
-  std::vector<int> peer_fds_;
+  Conn root_;
+  std::vector<Conn> peers_;
   bool failed_ = false;
   // IO pool sized for a per-host controller star (reference default: 4)
   ThreadPool pool_{4};

@@ -20,6 +20,15 @@ here the enqueue itself is also off the critical path).  The single
 worker preserves submission order; ``synchronize()`` first drains the
 worker (re-raising any submit-side error), then waits the engine
 futures.
+
+Input pipeline: pair this optimizer with ``horovod_tpu.data`` for
+per-rank sharded, worker-pool-decoded, prefetched host batches —
+``DataLoader(..., device_put=False)`` yields numpy arrays that
+``torch.from_numpy`` wraps zero-copy, and the loader's prefetch thread
+overlaps the next batch's decode with this step's backward (the
+``torch.utils.data.DataLoader(num_workers=N)`` analog; example:
+examples/pytorch/pytorch_synthetic_benchmark.py ``--data npy``,
+guide: docs/DATA.md).
 """
 
 from __future__ import annotations

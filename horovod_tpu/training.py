@@ -123,6 +123,34 @@ def data_parallel_train_step(
     return jax.jit(sharded, donate_argnums=(0,))
 
 
+def fit_epoch(step: Callable, state: TrainState, loader,
+              epoch: Optional[int] = None):
+    """Drive one epoch of a compiled train step from a
+    :class:`horovod_tpu.data.DataLoader` (or any iterable of
+    ``(inputs, labels)`` batches).
+
+    The drop-in loop for the ``horovod_tpu.data`` pipeline: the loader
+    stages batch N+1 on device while the step computes batch N, so this
+    is already overlapped — do NOT add ``block_until_ready`` per step
+    (the chained-dependency dispatch queue is the pipeline).
+
+        loader = hvd.data.DataLoader(source, batch_size=128)
+        for epoch in range(epochs):
+            state, loss = training.fit_epoch(step, state, loader, epoch)
+
+    Returns ``(state, last_loss)`` with the loss fetched to host — the
+    end-of-epoch sync point.  ``last_loss`` is None for an empty shard.
+    """
+    if epoch is not None and hasattr(loader, "set_epoch"):
+        loader.set_epoch(epoch)
+    loss = None
+    for inputs, labels in loader:
+        state, loss = step(state, inputs, labels)
+    if loss is not None:
+        loss = float(loss)  # the only sync some remote backends honor
+    return state, loss
+
+
 def replicate_state(state: TrainState, mesh: Optional[Mesh] = None) -> TrainState:
     """Place the state replicated over the mesh (the moral equivalent of
     the reference's broadcast_parameters at train start: every chip holds

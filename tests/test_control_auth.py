@@ -159,6 +159,203 @@ sys.exit(0 if rc != 0 and elapsed < 30 else 1)
             proc.wait()
 
 
+# -- steady-state frame MAC: tamper rejection --------------------------------
+
+
+def _hmac(key: bytes, msg: bytes) -> bytes:
+    import hashlib
+    import hmac as _hmac_mod
+
+    return _hmac_mod.new(key, msg, hashlib.sha256).digest()
+
+
+def _frame_mac(key: bytes, direction: bytes, seq: int,
+               payload: bytes) -> bytes:
+    return _hmac(key, direction + struct.pack("<Q", seq) + payload)
+
+
+def _recv_exact(conn, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError(f"EOF after {len(buf)}/{n} bytes")
+        buf += chunk
+    return buf
+
+
+def test_steady_state_frame_tamper_rejected():
+    """Round-5 ADVICE closure: frames AFTER the authenticated hello are
+    MAC'd under a per-connection key derived from the challenge exchange.
+    A fake coordinator that passes the full handshake (it knows the
+    secret) but then corrupts one steady-state frame's MAC must kill the
+    worker's transport — while a correctly MAC'd frame keeps it alive
+    (proving the rejection is the tamper check, not protocol drift).
+    Drives the native TcpTransport over ctypes; no jax, no fleet."""
+    secret = wire_auth.make_secret()
+    skey = secret.encode()
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    lib_path = os.path.join(REPO, "horovod_tpu", "native",
+                            "libhvd_tpu_core.so")
+    if not os.path.exists(lib_path):
+        pytest.skip("native core not built")
+    code = f"""
+import ctypes, sys, time
+lib = ctypes.CDLL({lib_path!r})
+lib.hvdtpu_init.restype = ctypes.c_int
+lib.hvdtpu_init.argtypes = [
+    ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+    ctypes.c_double, ctypes.c_longlong, ctypes.c_int, ctypes.c_char_p,
+    ctypes.c_double, ctypes.c_double, ctypes.c_int, ctypes.c_char_p,
+]
+rc = lib.hvdtpu_init(1, 2, b"127.0.0.1", {port}, 5.0, 1 << 20, 16, b"",
+                     0.0, 0.0, 0, b"")
+print("INIT", rc, flush=True)
+if rc != 0:
+    sys.exit(2)
+deadline = time.time() + 60
+while time.time() < deadline:
+    if lib.hvdtpu_loop_dead():
+        print("LOOP_DEAD", flush=True)
+        lib.hvdtpu_shutdown()  # join the (dead) background loop cleanly
+        sys.exit(0)
+    time.sleep(0.05)
+print("LOOP_STILL_ALIVE", flush=True)
+sys.exit(3)
+"""
+    env = os.environ.copy()
+    env["HVD_TPU_SECRET"] = secret
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        srv.settimeout(30)
+        conn, _ = srv.accept()
+        conn.settimeout(30)
+        # ---- hello + mutual challenge-response (coordinator role) ----
+        hello = _recv_exact(conn, 5)
+        assert struct.unpack("<i", hello[:4])[0] == 1
+        assert hello[4:5] == b"\x01"
+        conn.sendall(b"\x01")  # we hold the secret too
+        cw = _recv_exact(conn, 16)
+        cr = os.urandom(16)
+        conn.sendall(cr + _hmac(skey, b"coord" + cw))
+        proof = _recv_exact(conn, 32)
+        assert proof == _hmac(
+            skey, b"rank" + struct.pack("<i", 1) + cr
+        ), "worker's hello proof diverged from the documented wire"
+        frame_key = _hmac(skey, b"frame" + cw + cr)
+
+        # ---- steady state: worker sends one MAC'd request per cycle ----
+        def read_worker_frame(expect_seq):
+            (length,) = struct.unpack("<I", _recv_exact(conn, 4))
+            payload = _recv_exact(conn, length)
+            mac = _recv_exact(conn, 32)
+            assert mac == _frame_mac(
+                frame_key, b"W", expect_seq, payload
+            ), "worker frame MAC diverged from the documented construction"
+            return payload
+
+        read_worker_frame(0)
+        # control: a correctly MAC'd (empty) response keeps the loop alive
+        conn.sendall(struct.pack("<I", 0)
+                     + _frame_mac(frame_key, b"C", 0, b""))
+        read_worker_frame(1)  # next cycle arrives => transport survived
+        assert proc.poll() is None
+
+        # tamper: same frame, one MAC bit flipped => transport must die
+        bad = bytearray(_frame_mac(frame_key, b"C", 1, b""))
+        bad[0] ^= 0x01
+        conn.sendall(struct.pack("<I", 0) + bytes(bad))
+
+        out, err = proc.communicate(timeout=60)
+        assert proc.returncode == 0, (out, err)
+        assert "LOOP_DEAD" in out
+        assert "bad MAC" in err
+        conn.close()
+    finally:
+        srv.close()
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+
+def test_replayed_frame_rejected():
+    """A validly MAC'd frame captured and re-sent must fail: the MAC is
+    bound to the per-direction sequence number."""
+    secret = wire_auth.make_secret()
+    skey = secret.encode()
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    lib_path = os.path.join(REPO, "horovod_tpu", "native",
+                            "libhvd_tpu_core.so")
+    if not os.path.exists(lib_path):
+        pytest.skip("native core not built")
+    code = f"""
+import ctypes, sys, time
+lib = ctypes.CDLL({lib_path!r})
+lib.hvdtpu_init.restype = ctypes.c_int
+lib.hvdtpu_init.argtypes = [
+    ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+    ctypes.c_double, ctypes.c_longlong, ctypes.c_int, ctypes.c_char_p,
+    ctypes.c_double, ctypes.c_double, ctypes.c_int, ctypes.c_char_p,
+]
+rc = lib.hvdtpu_init(1, 2, b"127.0.0.1", {port}, 5.0, 1 << 20, 16, b"",
+                     0.0, 0.0, 0, b"")
+if rc != 0:
+    sys.exit(2)
+deadline = time.time() + 60
+while time.time() < deadline:
+    if lib.hvdtpu_loop_dead():
+        lib.hvdtpu_shutdown()  # join the (dead) background loop cleanly
+        sys.exit(0)
+    time.sleep(0.05)
+sys.exit(3)
+"""
+    env = os.environ.copy()
+    env["HVD_TPU_SECRET"] = secret
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        srv.settimeout(30)
+        conn, _ = srv.accept()
+        conn.settimeout(30)
+        _recv_exact(conn, 5)
+        conn.sendall(b"\x01")
+        cw = _recv_exact(conn, 16)
+        cr = os.urandom(16)
+        conn.sendall(cr + _hmac(skey, b"coord" + cw))
+        _recv_exact(conn, 32)
+        frame_key = _hmac(skey, b"frame" + cw + cr)
+
+        def skip_worker_frame():
+            (length,) = struct.unpack("<I", _recv_exact(conn, 4))
+            _recv_exact(conn, length + 32)
+
+        skip_worker_frame()
+        first = struct.pack("<I", 0) + _frame_mac(frame_key, b"C", 0, b"")
+        conn.sendall(first)          # valid at seq 0
+        skip_worker_frame()
+        conn.sendall(first)          # replay at seq 1: stale MAC
+        out, err = proc.communicate(timeout=60)
+        assert proc.returncode == 0, (out, err)
+        assert "bad MAC" in err
+        conn.close()
+    finally:
+        srv.close()
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+
 # -- native star rejects rogue peers ----------------------------------------
 
 

@@ -1,0 +1,139 @@
+"""Direct unit tests for utils/jax_compat.py (the cross-version shim).
+
+Previously only covered indirectly through test_spmd_collectives; these
+pin the shim's own contract: install on jax<0.5 (this image), no-op when
+jax already has the modern spellings, and faithful delegation of
+``shard_map``'s renamed keyword and ``jax.lax.axis_size``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+import horovod_tpu as hvd  # noqa: F401 — init fixture + shim install
+from horovod_tpu.common import basics
+from horovod_tpu.utils import jax_compat
+
+
+def _world_mesh():
+    return basics.topology().mesh()
+
+
+def test_shim_installed_at_package_import():
+    """horovod_tpu/__init__ runs install(); both spellings must exist
+    regardless of the underlying jax version."""
+    assert hasattr(jax, "shard_map")
+    assert hasattr(jax.lax, "axis_size")
+
+
+def test_install_is_idempotent_and_never_overwrites(monkeypatch):
+    """On a jax that already has the attributes (>= 0.5 or an earlier
+    install), install() must be a no-op — nothing overwritten."""
+    sentinel_sm = object()
+    sentinel_as = object()
+    monkeypatch.setattr(jax, "shard_map", sentinel_sm, raising=False)
+    monkeypatch.setattr(jax.lax, "axis_size", sentinel_as, raising=False)
+    jax_compat.install()
+    assert jax.shard_map is sentinel_sm
+    assert jax.lax.axis_size is sentinel_as
+
+
+def test_install_publishes_wrapper_when_missing(monkeypatch):
+    """Simulate the jax<0.5 state: no jax.shard_map attribute.  install()
+    must publish a working adapter (on this image that IS the live path;
+    on modern jax the monkeypatched deletion simulates it)."""
+    monkeypatch.delattr(jax, "shard_map")
+    assert not hasattr(jax, "shard_map")
+    jax_compat.install()
+    assert hasattr(jax, "shard_map")
+    # and the published callable actually runs a sharded computation
+    mesh = _world_mesh()
+    n = len(mesh.devices.ravel())
+    x = jnp.arange(4 * n, dtype=jnp.float32)
+
+    def body(x):
+        return jax.lax.psum(x, "hvd")
+
+    f = jax.shard_map(body, mesh=mesh, in_specs=(P("hvd"),),
+                      out_specs=P("hvd"), check_vma=False)
+    out = f(x)
+    expect = np.tile(x.reshape(n, -1).sum(axis=0), n)
+    np.testing.assert_allclose(np.asarray(out), expect)
+
+
+def test_shard_map_accepts_check_vma_keyword():
+    """The modern ``check_vma`` keyword must be honored whichever
+    underlying implementation serves it (renamed to check_rep on
+    legacy jax) — passing it must not raise."""
+    mesh = _world_mesh()
+
+    def body(x):
+        return x * 2.0
+
+    n = len(mesh.devices.ravel())
+    x = jnp.ones((n, 2), jnp.float32)
+    for check_vma in (False, None):
+        f = jax.shard_map(body, mesh=mesh, in_specs=(P("hvd"),),
+                          out_specs=P("hvd"), check_vma=check_vma)
+        np.testing.assert_allclose(np.asarray(f(x)), np.asarray(x) * 2.0)
+
+
+def test_shard_map_delegates_semantics():
+    """Per-shard semantics must match the legacy implementation exactly:
+    each shard sees only its slice."""
+    mesh = _world_mesh()
+    n = len(mesh.devices.ravel())
+
+    def body(x):
+        # shard-local shape: the world axis is split away
+        assert x.shape[0] == 1
+        return x + jax.lax.axis_index("hvd").astype(jnp.float32)
+
+    x = jnp.zeros((n, 3), jnp.float32)
+    out = np.asarray(jax.shard_map(
+        body, mesh=mesh, in_specs=(P("hvd"),), out_specs=P("hvd"),
+        check_vma=False)(x))
+    np.testing.assert_allclose(out, np.arange(n)[:, None] * np.ones(3))
+
+
+def test_axis_size_resolves_inside_shard_map():
+    mesh = _world_mesh()
+    n = len(mesh.devices.ravel())
+
+    def body(x):
+        return x + jnp.float32(jax.lax.axis_size("hvd"))
+
+    out = np.asarray(jax.shard_map(
+        body, mesh=mesh, in_specs=(P("hvd"),), out_specs=P("hvd"),
+        check_vma=False)(jnp.zeros((n,), jnp.float32)))
+    np.testing.assert_allclose(out, np.full(n, n, np.float32))
+
+
+def test_axis_size_installer_noop_when_present(monkeypatch):
+    sentinel = object()
+    monkeypatch.setattr(jax.lax, "axis_size", sentinel, raising=False)
+    jax_compat._install_axis_size()
+    assert jax.lax.axis_size is sentinel
+
+
+def test_shard_map_installer_handles_absent_legacy_module(monkeypatch):
+    """On a hypothetical jax with NEITHER spelling, install() must leave
+    jax untouched instead of publishing a broken attribute."""
+    import builtins
+
+    monkeypatch.delattr(jax, "shard_map")
+    real_import = builtins.__import__
+
+    def no_legacy(name, *a, **k):
+        if name.startswith("jax.experimental.shard_map"):
+            raise ImportError("simulated: no legacy shard_map")
+        return real_import(name, *a, **k)
+
+    monkeypatch.setattr(builtins, "__import__", no_legacy)
+    jax_compat._install_shard_map()
+    assert not hasattr(jax, "shard_map")
+    monkeypatch.setattr(builtins, "__import__", real_import)
+    jax_compat.install()  # restore for the rest of the suite
+    assert hasattr(jax, "shard_map")

@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# Rebuild horovod_tpu/native/libhvd_tpu_core.so from src/ with the full
+# warning wall (-Wall -Wextra -Werror): the checked-in binary must only
+# ever be produced by a warning-clean compile, so a stale or sloppy
+# rebuild can't slip into a commit.  CI/tooling entry point — `pip
+# install .` (setup.py build_ext) remains the user-facing build.
+#
+# Usage: tools/rebuild_native.sh [extra CXXFLAGS...]
+# Pairs with tests/test_native_build.py, which asserts the committed .so
+# exports exactly the hvdtpu_* C API surface declared in c_api.cc.
+set -euo pipefail
+
+cd "$(dirname "$0")/../horovod_tpu/native/src"
+
+CXX="${CXX:-g++}"
+CXXFLAGS="-O2 -fPIC -std=c++17 -Wall -Wextra -Werror -pthread $*"
+
+echo "[rebuild_native] $CXX $CXXFLAGS" >&2
+make clean >/dev/null
+make CXX="$CXX" CXXFLAGS="$CXXFLAGS"
+
+SO="$(cd .. && pwd)/libhvd_tpu_core.so"
+echo "[rebuild_native] built $SO" >&2
+# sanity: every extern "C" symbol declared in c_api.cc must be exported
+missing=$(
+  grep -oE '^(int|void|long long|double|const char\*) hvdtpu_[a-z_0-9]+' \
+      c_api.cc | awk '{print $NF}' | sort -u |
+  while read -r sym; do
+    nm -D --defined-only "$SO" | grep -q " $sym\$" || echo "$sym"
+  done
+)
+if [ -n "$missing" ]; then
+  echo "[rebuild_native] ERROR: symbols declared but not exported:" >&2
+  echo "$missing" >&2
+  exit 1
+fi
+echo "[rebuild_native] symbol export check passed" >&2
