@@ -1,0 +1,333 @@
+"""Deterministic fault injection (chaos) for horovod_tpu.
+
+The subsystem that PROVES the recovery machinery works: named injection
+points throughout the framework evaluate a seed-driven plan and, when a
+rule fires, inject one of six faults::
+
+    drop     the caller discards the unit of work (frame, batch)
+    delay    sleep ``delay`` seconds, then continue
+    corrupt  flip one bit of the payload handed to :func:`point`
+    raise    raise :class:`ChaosInjected` at the call site
+    kill     SIGKILL this process (the classic elastic fault)
+    hang     sleep forever — a live-but-silent worker, the fault only
+             heartbeats (not process-exit watching) can see
+
+Configured entirely from the environment so any launcher can inject::
+
+    HVD_TPU_CHAOS="elastic.commit:kill,at=8,rank=1;transport.frame.send:corrupt,at=400,rank=1,fuse=/tmp/f1"
+    HVD_TPU_CHAOS_SEED=42
+
+Per-rank derived streams (spec.Rule.stream_seed) make runs replay
+exactly: same seed + same rank + same call sequence = same injection
+trace.  Sites under ``transport.`` live in the native C++ core; their
+rules are exported through the ``hvdtpu_chaos_*`` C API at controller
+load (native/src/chaos.h mirrors the evaluation semantics).
+
+When ``HVD_TPU_CHAOS`` is unset the whole subsystem is a single module
+bool check per call site — free in steady state.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, List, Optional
+
+from ..metrics import instruments as _metrics
+from ..utils.logging import get_logger
+from .spec import ACTION_ENUM, ACTIONS, ChaosSpecError, Rule, parse_spec
+
+__all__ = [
+    "ChaosInjected", "DROP", "SITES", "active", "clear", "configure",
+    "configure_native_lib", "injection_trace", "install_from_env", "point",
+]
+
+ENV_SPEC = "HVD_TPU_CHAOS"
+ENV_SEED = "HVD_TPU_CHAOS_SEED"
+#: Optional JSONL file every Python-side fire is appended to (replay
+#: assertions in tools/chaos_soak.py read it back).
+ENV_LOG = "HVD_TPU_CHAOS_LOG"
+
+#: Sites evaluated in the native C++ core, exported via hvdtpu_chaos_*.
+NATIVE_PREFIX = "transport."
+
+#: Injection-point catalogue (docs/FAULT_TOLERANCE.md mirrors this).
+SITES = (
+    "transport.frame.send",    # native: outgoing negotiation frame
+    "transport.frame.recv",    # native: incoming negotiation frame
+    "controller.enqueue",      # collective submission (ctypes layer)
+    "controller.resolve",      # fused-response execution callback
+    "data.batch",              # input-pipeline worker collate
+    "data.prefetch",           # device staging in the prefetcher
+    "elastic.commit",          # elastic state commit (per training step)
+    "training.step",           # fit_epoch loop body
+)
+
+
+class ChaosInjected(RuntimeError):
+    """Raised at a chaos point by an ``action=raise`` rule."""
+
+
+class _Drop:
+    def __repr__(self):  # pragma: no cover - repr cosmetics
+        return "<chaos.DROP>"
+
+
+#: Sentinel returned by :func:`point` when a ``drop`` rule fired — the
+#: caller discards the unit of work it was about to process.
+DROP = _Drop()
+
+#: Fast-path flag: False means every point() returns immediately.
+active = False
+
+_lock = threading.Lock()
+_plan: dict = {}          # site -> List[_Armed]
+_seed: int = 0
+_rank: int = 0
+_trace: List[dict] = []
+_log_path: Optional[str] = None
+
+
+class _Armed:
+    """One installed rule + its deterministic draw stream."""
+
+    __slots__ = ("rule", "state")
+
+    def __init__(self, rule: Rule, stream_seed: int):
+        self.rule = rule
+        self.state = stream_seed  # xorshift64 state (matches chaos.h)
+
+    def draw(self) -> float:
+        x = self.state
+        x ^= (x << 13) & 0xFFFFFFFFFFFFFFFF
+        x ^= x >> 7
+        x ^= (x << 17) & 0xFFFFFFFFFFFFFFFF
+        self.state = x
+        return (x >> 11) / float(1 << 53)
+
+
+def configure(spec: str, seed: int = 0, rank: int = 0) -> List[Rule]:
+    """Install a chaos plan (replacing any previous one).  Rules whose
+    ``rank`` param names a different process are filtered out here —
+    per-rank plans never reach the hot path."""
+    global active, _seed, _rank
+    rules = parse_spec(spec) if spec else []
+    with _lock:
+        _plan.clear()
+        _trace.clear()
+        _seed, _rank = int(seed), int(rank)
+        for i, rule in enumerate(rules):
+            if rule.rank is not None and rule.rank != rank:
+                continue
+            _plan.setdefault(rule.site, []).append(
+                _Armed(rule, rule.stream_seed(_seed, rank, i))
+            )
+        active = bool(_plan)
+    if active:
+        get_logger().warning(
+            "chaos: fault injection ACTIVE (%d rule(s), seed=%d, rank=%d)",
+            sum(len(v) for v in _plan.values()), _seed, rank,
+        )
+    return rules
+
+
+def install_from_env(rank: int = 0) -> bool:
+    """Read ``HVD_TPU_CHAOS`` / ``HVD_TPU_CHAOS_SEED`` and install the
+    plan for this process (called from ``hvd.init()``).  Returns whether
+    any rule is active here."""
+    global _log_path
+    spec = os.environ.get(ENV_SPEC, "")
+    seed = int(os.environ.get(ENV_SEED, "0") or "0")
+    _log_path = os.environ.get(ENV_LOG) or None
+    configure(spec, seed=seed, rank=rank)
+    return active
+
+
+def clear() -> None:
+    """Disarm every rule (tests)."""
+    global active
+    with _lock:
+        _plan.clear()
+        _trace.clear()
+        active = False
+
+
+def injection_trace() -> List[dict]:
+    """Python-side fires so far, in order (replay assertions)."""
+    with _lock:
+        return list(_trace)
+
+
+def _burn_fuse(path: str) -> bool:
+    """True when this process wins the fuse (O_EXCL create); False when
+    the fuse was already burnt — by this boot or a previous one."""
+    try:
+        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o644)
+        os.close(fd)
+        return True
+    except FileExistsError:
+        return False
+    except OSError:
+        # an unwritable fuse path must not turn a one-shot rule into a
+        # repeating one: treat it as burnt and warn
+        get_logger().warning("chaos: fuse path %r unusable; skipping rule",
+                             path)
+        return False
+
+
+def _record_fire(site: str, action: str, eval_idx: int) -> None:
+    _metrics.CHAOS_INJECTIONS.labels(site, action).inc()
+    event = {"site": site, "action": action, "eval": eval_idx,
+             "rank": _rank}
+    _trace.append(event)
+    get_logger().warning("chaos: injecting %s at %s (eval %d)",
+                         action, site, eval_idx)
+    if _log_path:
+        try:
+            with open(_log_path, "a") as f:
+                f.write(json.dumps(event) + "\n")
+        except OSError:
+            pass
+
+
+def _corrupt(payload: Any) -> Any:
+    """Flip one bit of a bytes-like payload; other types pass through a
+    best-effort mangling (numeric negate-and-offset)."""
+    if isinstance(payload, (bytes, bytearray)):
+        buf = bytearray(payload)
+        if buf:
+            buf[len(buf) // 2] ^= 0x01
+        return bytes(buf)
+    if isinstance(payload, (int, float)):
+        return -payload - 1
+    return payload
+
+
+def point(site: str, payload: Any = None) -> Any:
+    """Evaluate the chaos plan at ``site``.
+
+    Returns ``payload`` (possibly corrupted), or :data:`DROP` when the
+    caller should discard the unit of work.  ``delay`` sleeps in place;
+    ``raise`` raises :class:`ChaosInjected`; ``kill``/``hang`` never
+    return.  One module-bool check when chaos is off.
+    """
+    if not active:
+        return payload
+    with _lock:
+        armed = _plan.get(site)
+        if not armed:
+            return payload
+        fire: Optional[Rule] = None
+        eval_idx = 0
+        for a in armed:
+            r = a.rule
+            eval_idx = r.evals
+            r.evals += 1
+            if fire is not None:
+                continue  # counters still advance for later rules
+            if r.times is not None and r.fired >= r.times:
+                continue
+            if eval_idx < r.after:
+                continue
+            if r.at is not None:
+                if eval_idx != r.at:
+                    continue
+            elif r.prob < 1.0 and a.draw() >= r.prob:
+                continue
+            if r.fuse and not _burn_fuse(r.fuse):
+                # burnt in a prior boot: retire the rule so the hot path
+                # never re-probes the filesystem for it
+                r.times = r.fired
+                continue
+            r.fired += 1
+            fire = r
+            _record_fire(site, r.action, eval_idx)
+    if fire is None:
+        return payload
+    action = fire.action
+    if action == "drop":
+        return DROP
+    if action == "delay":
+        time.sleep(fire.delay)
+        return payload
+    if action == "corrupt":
+        if payload is None:
+            # no payload to corrupt at this site: inject as a failure so
+            # a fault counted in the trace is a fault that happened
+            raise ChaosInjected(
+                f"chaos: corrupt at {site} (no payload; injected as "
+                "failure)"
+            )
+        return _corrupt(payload)
+    if action == "raise":
+        raise ChaosInjected(
+            f"chaos: injected failure at {site} (eval {fire.evals - 1})"
+        )
+    if action == "kill":
+        get_logger().error("chaos: self-kill at %s", site)
+        os._exit(fire.code)
+    if action == "hang":
+        get_logger().error("chaos: self-hang at %s", site)
+        while True:  # a live-but-silent process: only liveness probes see it
+            time.sleep(3600)
+    return payload  # pragma: no cover - exhaustive actions above
+
+
+def raise_point(site: str) -> None:
+    """:func:`point` for sites with NO droppable unit of work (commit,
+    resolve, staging): a ``drop`` rule raises :class:`ChaosInjected`
+    instead — the fault is actually injected, never merely recorded in
+    the metrics/trace while the code path sails on."""
+    if point(site) is DROP:
+        raise ChaosInjected(
+            f"chaos: drop at {site} (no droppable unit; injected as "
+            "failure)"
+        )
+
+
+def configure_native_lib(lib, rank: Optional[int] = None) -> int:
+    """Export the ``transport.*`` rules of the installed plan into the
+    native core through the ``hvdtpu_chaos_*`` C API (called by the
+    ctypes controller after dlopen, before ``hvdtpu_init``).  Returns the
+    number of rules exported; 0 when chaos is off or the loaded binary
+    predates the chaos API."""
+    import ctypes
+
+    if not hasattr(lib, "hvdtpu_chaos_set"):
+        if active and any(s.startswith(NATIVE_PREFIX) for s in _plan):
+            get_logger().warning(
+                "chaos: native core predates hvdtpu_chaos_*; transport.* "
+                "rules will not fire (rebuild with tools/rebuild_native.sh)"
+            )
+        return 0
+    lib.hvdtpu_chaos_clear()
+    if not active:
+        return 0
+    n = 0
+    with _lock:
+        use_rank = _rank if rank is None else rank
+        for site, armed in _plan.items():
+            if not site.startswith(NATIVE_PREFIX):
+                continue
+            for a in armed:
+                r = a.rule
+                lib.hvdtpu_chaos_set(
+                    site.encode(), ACTION_ENUM[r.action],
+                    ctypes.c_double(r.prob),
+                    ctypes.c_longlong(-1 if r.at is None else r.at),
+                    ctypes.c_longlong(r.after),
+                    ctypes.c_longlong(-1 if r.times is None else r.times),
+                    ctypes.c_double(r.delay),
+                    ctypes.c_int(r.code),
+                    (r.fuse or "").encode(),
+                    ctypes.c_ulonglong(a.state),
+                )
+                n += 1
+    if n:
+        get_logger().warning(
+            "chaos: %d native transport rule(s) exported (rank=%d)",
+            n, use_rank,
+        )
+    return n

@@ -11,6 +11,24 @@ pattern for the JAX loop:
   * :func:`restore_checkpoint` — every worker reads the latest checkpoint
     if present (shared filesystem), or rank 0 reads and the state is
     broadcast (``broadcast=True``) — the §5.4(b) resume flow.
+  * :func:`save_state_checkpoint` / :func:`restore_state_checkpoint` —
+    the same contract for ``hvd.elastic`` object states (pickled
+    snapshots), feeding the elastic auto-resume path
+    (``state.enable_auto_resume``; docs/FAULT_TOLERANCE.md).
+
+Both families use ``ckpt-<step>`` names so :func:`latest_checkpoint`
+serves either — but use ONE family per directory: a same-step save from
+the other family would overwrite, and pruning counts them together.
+Cross-family reads fail loudly (the state format carries a magic
+header), never with a bare deserialization error.
+
+Every write is CRASH-ATOMIC: the payload goes to a uniquely named temp
+file in the same directory, is fsync'd, and is published with
+``os.replace`` — a worker killed mid-save (the exact fault the chaos
+subsystem injects) can leave a stray ``.tmp`` behind but never a
+truncated ``ckpt-N`` that :func:`latest_checkpoint` would then resume
+from.  Stale temp files are swept by the same pruning pass that trims
+old checkpoints.
 
 Orbax remains the right tool for sharded multi-host checkpoints of very
 large models; these helpers cover the reference's replicated-weights
@@ -20,8 +38,10 @@ contract without extra dependencies.
 from __future__ import annotations
 
 import os
+import pickle
 import re
-from typing import Any, Optional
+import time
+from typing import Any, Optional, Tuple
 
 import flax.serialization
 import jax
@@ -31,10 +51,40 @@ from . import functions
 from .common import basics
 
 _CKPT_RE = re.compile(r"^ckpt-(\d+)$")
+_TMP_RE = re.compile(r"^ckpt-\d+\.tmp\.\d+$")
+
+#: Header distinguishing pickled elastic-state checkpoints from flax
+#: msgpack pytree checkpoints (both live under the same ckpt-N names so
+#: latest_checkpoint() serves either family).
+_STATE_MAGIC = b"HVDTPU-STATE1\n"
 
 
 def _is_root() -> bool:
     return not basics.is_initialized() or basics.rank() == 0
+
+
+def _atomic_publish(directory: str, name: str, payload: bytes) -> str:
+    """Write ``payload`` to ``<directory>/<name>`` crash-atomically:
+    unique same-directory temp (two savers can't collide), fsync, then
+    ``os.replace`` — readers only ever see absent or complete files."""
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, name)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)  # atomic publish
+    except BaseException:
+        # a failed/interrupted save must not leave the temp behind when
+        # we still control the process (a SIGKILL leaves it for _prune)
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    return path
 
 
 def save_checkpoint(directory: str, state: Any, step: int,
@@ -43,27 +93,38 @@ def save_checkpoint(directory: str, state: Any, step: int,
     torch.save(...)`` idiom).  Returns the path written (root only)."""
     if not _is_root():
         return None
-    os.makedirs(directory, exist_ok=True)
-    path = os.path.join(directory, f"ckpt-{int(step)}")
     payload = flax.serialization.to_bytes(
         jax.tree_util.tree_map(np.asarray, state)
     )
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(payload)
-    os.replace(tmp, path)  # atomic publish
+    path = _atomic_publish(directory, f"ckpt-{int(step)}", payload)
     _prune(directory, keep)
     return path
 
 
 def _prune(directory: str, keep: int) -> None:
-    ckpts = sorted(
-        (int(m.group(1)), name)
-        for name in os.listdir(directory)
-        if (m := _CKPT_RE.match(name))
-    )
+    ckpts = []
+    for name in os.listdir(directory):
+        if (m := _CKPT_RE.match(name)):
+            ckpts.append((int(m.group(1)), name))
+        elif _TMP_RE.match(name):
+            # debris from a writer killed mid-save (chaos kill, OOM):
+            # harmless to resume logic, but sweep it so the directory
+            # doesn't accrete one orphan per injected fault.  AGE-GATED:
+            # a fresh temp may belong to a concurrent saver still
+            # writing (per-PID names exist exactly to allow that) —
+            # deleting it would make that saver's os.replace fail
+            tmp_path = os.path.join(directory, name)
+            try:
+                if time.time() - os.path.getmtime(tmp_path) > 300:
+                    os.remove(tmp_path)
+            except OSError:
+                pass
+    ckpts.sort()
     for _, name in ckpts[:-keep] if keep else []:
-        os.remove(os.path.join(directory, name))
+        try:
+            os.remove(os.path.join(directory, name))
+        except OSError:
+            pass  # a concurrent pruner (elastic restart race) got it
 
 
 def latest_checkpoint(directory: str) -> Optional[str]:
@@ -75,6 +136,12 @@ def latest_checkpoint(directory: str) -> Optional[str]:
         if (m := _CKPT_RE.match(name))
     )
     return os.path.join(directory, ckpts[-1][1]) if ckpts else None
+
+
+def checkpoint_step(path: str) -> Optional[int]:
+    """The step encoded in a ``ckpt-N`` path, or None."""
+    m = _CKPT_RE.match(os.path.basename(path))
+    return int(m.group(1)) if m else None
 
 
 def restore_checkpoint(directory: str, state: Any,
@@ -91,16 +158,14 @@ def restore_checkpoint(directory: str, state: Any,
     if not multi:
         if path is None:
             return state
-        with open(path, "rb") as f:
-            return flax.serialization.from_bytes(state, f.read())
+        return _read_pytree(path, state)
 
     if broadcast:
         found = functions.broadcast_object(path is not None, root_rank=0)
         if not found:
             return state
         if basics.rank() == 0:
-            with open(path, "rb") as f:
-                loaded = flax.serialization.from_bytes(state, f.read())
+            loaded = _read_pytree(path, state)
         else:
             loaded = state
         host = jax.tree_util.tree_map(np.asarray, loaded)
@@ -108,5 +173,84 @@ def restore_checkpoint(directory: str, state: Any,
 
     if path is None:
         return state
+    return _read_pytree(path, state)
+
+
+def _read_pytree(path: str, state: Any) -> Any:
     with open(path, "rb") as f:
-        return flax.serialization.from_bytes(state, f.read())
+        payload = f.read()
+    if payload.startswith(_STATE_MAGIC):
+        # a pickled elastic-state checkpoint landed in this directory:
+        # say so instead of surfacing a bare msgpack decode error (and
+        # crash-looping a resuming job on it)
+        raise ValueError(
+            f"{path} is an elastic STATE checkpoint "
+            "(save_state_checkpoint format); restore it with "
+            "restore_state_checkpoint / state.enable_auto_resume, or "
+            "keep pytree and state checkpoints in separate directories"
+        )
+    return flax.serialization.from_bytes(state, payload)
+
+
+# -- elastic object-state checkpoints (auto-resume feed) ---------------------
+
+
+def save_state_checkpoint(directory: str, state: Any, step: int,
+                          keep: int = 3) -> Optional[str]:
+    """Persist an ``hvd.elastic`` state's snapshot as ``ckpt-<step>``
+    (rank 0 only; crash-atomic).  The state must expose ``_snapshot()``
+    (ObjectState/TpuState do); anything picklable inside survives.
+
+    Pairs with :func:`restore_state_checkpoint` and with the automatic
+    reset-epoch path ``state.enable_auto_resume(directory)``.
+    """
+    if not _is_root():
+        return None
+    payload = _STATE_MAGIC + pickle.dumps(
+        {"step": int(step), "snapshot": state._snapshot()}
+    )
+    path = _atomic_publish(directory, f"ckpt-{int(step)}", payload)
+    _prune(directory, keep)
+    return path
+
+
+def peek_state_checkpoint(directory: str) -> Optional[Tuple[int, Any]]:
+    """Load the latest state checkpoint as ``(step, snapshot)`` without
+    touching any live state; None when the directory holds none (or only
+    pytree-format checkpoints)."""
+    path = latest_checkpoint(directory)
+    if path is None:
+        return None
+    try:
+        with open(path, "rb") as f:
+            head = f.read(len(_STATE_MAGIC))
+            if head != _STATE_MAGIC:
+                return None  # a flax pytree checkpoint, not a state one
+            blob = pickle.loads(f.read())
+        return int(blob["step"]), blob["snapshot"]
+    # a corrupt/alien file can raise nearly anything out of pickle
+    # (UnpicklingError, ValueError, AttributeError for a moved class...)
+    except Exception as e:
+        from .utils.logging import get_logger
+
+        # resumability must not crash-loop a booting worker on one bad
+        # file (version skew, torn disk): warn and resume without it
+        get_logger().error(
+            "checkpoint: %s unusable (%s: %s); ignoring it",
+            path, type(e).__name__, e,
+        )
+        return None
+
+
+def restore_state_checkpoint(directory: str, state: Any) -> Optional[int]:
+    """Apply the latest state checkpoint's snapshot to ``state`` (every
+    rank reads locally — shared filesystem, as with the pytree path).
+    Returns the restored step, or None when nothing was restored."""
+    found = peek_state_checkpoint(directory)
+    if found is None:
+        return None
+    step, snapshot = found
+    state._apply_snapshot(snapshot)
+    if hasattr(state, "save"):
+        state.save()  # the restored view becomes the committed baseline
+    return step

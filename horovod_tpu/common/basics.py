@@ -99,6 +99,20 @@ def _maybe_init_distributed() -> None:
         kwargs["shutdown_timeout_seconds"] = int(
             os.environ.get("HVD_TPU_SHUTDOWN_TIMEOUT", "8")
         )
+    # older jax (< 0.5) lacks the heartbeat/shutdown timeout knobs on
+    # initialize(); passing them would TypeError and kill every elastic
+    # worker at boot — drop what this jax can't take and say so (the
+    # native-transport heartbeats still provide liveness there)
+    import inspect
+
+    accepted = inspect.signature(jax.distributed.initialize).parameters
+    dropped = [k for k in kwargs if k not in accepted]
+    if dropped:
+        get_logger().info(
+            "jax.distributed.initialize does not accept %s on this jax "
+            "version; continuing without", dropped,
+        )
+        kwargs = {k: v for k, v in kwargs.items() if k in accepted}
     jax.distributed.initialize(
         coordinator_address=coord, num_processes=num, process_id=pid,
         **kwargs,
@@ -129,6 +143,17 @@ def _register_early_distributed_shutdown() -> None:
 
     def _early_shutdown():
         try:
+            # with fleet recovery in flight the shutdown barrier can
+            # never complete — abandon instead of blocking at exit
+            # (mirrors elastic worker.clean_shutdown)
+            from ..elastic import worker as _elastic_worker
+
+            if _elastic_worker.recovery_pending():
+                _elastic_worker._abandon_distributed()
+                return
+        except Exception:
+            pass
+        try:
             from jax._src import distributed as _jd
 
             if getattr(_jd.global_state, "client", None) is not None:
@@ -157,6 +182,14 @@ def init(devices: Optional[Sequence] = None) -> None:
         _state.config = Config.from_env()
         _state.topology = _topology.discover(devices)
         _state.process_set_registry.attach_world(_state.topology)
+
+        # fault injection: install the HVD_TPU_CHAOS plan for THIS rank
+        # before the controller loads (the ctypes controller exports the
+        # transport.* rules into the native core).  No spec = one module
+        # bool per injection point.
+        from .. import chaos as _chaos
+
+        _chaos.install_from_env(rank=_state.topology.process_index)
 
         from ..ops.engine import CollectiveEngine  # deferred: avoids cycle
 

@@ -1,0 +1,116 @@
+"""Shared retry policy: exponential backoff with full jitter, deadline-aware.
+
+Before this module every transient-failure loop in the control plane was
+hand-rolled (fixed 100 ms polls in the native connect path, bare
+``create_connection(timeout=30)`` one-shots in the elastic worker, an
+unretried discovery-script ``subprocess.run``) — each with its own
+timeout constant and its own thundering-herd behavior when a whole fleet
+retried in lockstep after a failure.  ``retry_call`` is the one policy
+they all share now (the native ``ConnectToRoot`` mirrors it in C++):
+
+  * exponential backoff capped at ``max_delay``;
+  * FULL jitter (sleep ~ U[0, cap]) — the AWS-architecture result that
+    desynchronizes a fleet better than equal-jitter or raw exponential;
+  * deadline-aware — a sleep never overshoots the overall ``timeout``,
+    and the last error re-raises when time (or ``attempts``) runs out;
+  * instrumented — attempts-per-call land in the
+    ``hvd_tpu_retry_attempts`` histogram labeled by ``site``.
+
+Deterministic under chaos testing: pass ``rng`` (any object with
+``random()``) to pin the jitter stream.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Optional, Tuple, Type, TypeVar
+
+from ..metrics import instruments as _metrics
+from ..utils.logging import get_logger
+
+__all__ = ["retry_call", "env_float"]
+
+T = TypeVar("T")
+
+
+def env_float(name: str, default: float) -> float:
+    """``float(os.environ[name])`` with a fall-through default — the
+    spelling every env-tunable timeout in the fault-tolerance path uses
+    (a garbled value falls back rather than killing the process)."""
+    import os
+
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        get_logger().warning("%s=%r is not a number; using %s",
+                             name, raw, default)
+        return default
+
+
+def retry_call(
+    fn: Callable[[], T],
+    *,
+    site: str,
+    retry_on: Tuple[Type[BaseException], ...] = (OSError,),
+    attempts: Optional[int] = None,
+    timeout: Optional[float] = None,
+    base_delay: float = 0.1,
+    max_delay: float = 5.0,
+    rng: Optional[random.Random] = None,
+    describe: Optional[str] = None,
+) -> T:
+    """Call ``fn()`` until it succeeds, an exception outside ``retry_on``
+    escapes, ``attempts`` are exhausted, or the ``timeout`` deadline
+    passes.  The final failure re-raises the last error unchanged (the
+    caller's except-clauses keep working).
+
+    Args:
+      site: metrics/log label (e.g. ``"elastic.rendezvous"``).
+      retry_on: exception classes that mean "transient, try again".
+      attempts: max calls (None = bounded by ``timeout`` only; with both
+        None, a single failure re-raises immediately).
+      timeout: overall wall-clock budget in seconds, measured from the
+        first call; sleeps are clipped so the budget is never overshot.
+      base_delay/max_delay: backoff cap grows ``base_delay * 2**n`` up to
+        ``max_delay``; actual sleep is uniform in [0, cap] (full jitter).
+      rng: jitter source (tests/chaos replay); default module random.
+      describe: human phrase for warning logs (default: ``site``).
+    """
+    if attempts is None and timeout is None:
+        attempts = 1
+    draw = (rng or random).random
+    deadline = None if timeout is None else time.monotonic() + timeout
+    what = describe or site
+    n = 0
+    while True:
+        n += 1
+        try:
+            result = fn()
+            _metrics.RETRY_ATTEMPTS.labels(site).observe(n)
+            return result
+        except retry_on as e:
+            out_of_attempts = attempts is not None and n >= attempts
+            out_of_time = (deadline is not None
+                           and time.monotonic() >= deadline)
+            if out_of_attempts or out_of_time:
+                _metrics.RETRY_ATTEMPTS.labels(site).observe(n)
+                get_logger().warning(
+                    "%s failed after %d attempt(s) (%s); giving up: %s",
+                    what, n,
+                    "deadline exceeded" if out_of_time else "attempts "
+                    "exhausted", e,
+                )
+                raise
+            cap = min(max_delay, base_delay * (2 ** (n - 1)))
+            sleep = cap * draw()
+            if deadline is not None:
+                sleep = min(sleep, max(0.0, deadline - time.monotonic()))
+            get_logger().info(
+                "%s attempt %d failed (%s); retrying in %.2fs",
+                what, n, e, sleep,
+            )
+            time.sleep(sleep)

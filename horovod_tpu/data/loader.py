@@ -25,6 +25,7 @@ from __future__ import annotations
 import time
 from typing import Callable, Iterator, Optional
 
+from .. import chaos as _chaos
 from ..metrics import instruments as _instr
 from . import prefetch as _prefetch
 from . import sharding as _sharding
@@ -92,6 +93,11 @@ class DataLoader:
     # -- iteration -----------------------------------------------------------
 
     def _collate(self, indices):
+        # chaos: delay = a slow decode burst; raise/drop = a decode
+        # failure surfacing at the training thread's yield point (the
+        # ordered window then cancels the in-flight tail)
+        if _chaos.active and _chaos.point("data.batch") is _chaos.DROP:
+            raise _chaos.ChaosInjected("chaos: batch dropped at data.batch")
         t0 = time.perf_counter()
         inputs, labels = self.source.batch(indices)
         if self.transform is not None:

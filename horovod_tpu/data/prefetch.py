@@ -30,6 +30,7 @@ from typing import Callable, Iterable, Iterator, Optional
 
 import numpy as np
 
+from .. import chaos as _chaos
 from ..metrics import instruments as _instr
 
 __all__ = ["DevicePrefetcher", "prefetch_to_device", "default_prefetch_depth"]
@@ -118,6 +119,12 @@ class DevicePrefetcher:
 
     def _stage(self, batch):
         """Cast + device_put one host batch; returns the staged batch."""
+        # chaos: delay = staging jitter; raise/drop re-raise on the
+        # consumer side through the queue; hang freezes the producer
+        # thread (the training thread then starves — the input-bound
+        # failure mode)
+        if _chaos.active:
+            _chaos.raise_point("data.prefetch")
         t0 = time.perf_counter()
         batch = _host_cast(batch, self.cast)
         if self.device_put:

@@ -54,6 +54,12 @@ def run(func):
         maybe_restore_after_restart(state)
         skip_sync = False
         while True:
+            # reset-epoch auto-resume (no-op unless state.enable_auto_resume
+            # armed a checkpoint directory): a replacement worker with no
+            # exec-restart snapshot picks up the fleet's last checkpoint
+            # BEFORE sync, so a fresh rank 0 seeds peers from the
+            # checkpoint instead of from scratch
+            state.maybe_auto_resume()
             if not skip_sync:
                 state.sync()
             try:
@@ -77,9 +83,12 @@ def run(func):
                 # membership change: keep current state.  If it was caused
                 # by a peer failure, the coordination service can't be
                 # torn down gracefully — take the restart path with the
-                # live state snapshot instead
+                # live state snapshot instead.  The driver TOLD us about
+                # this failure, so don't report it back (that would spawn
+                # a fresh failure epoch for the world it is rebuilding)
                 if getattr(e, "due_to_failure", False) and elastic_enabled():
-                    restart_after_failure(state)  # does not return
+                    restart_after_failure(state,  # does not return
+                                          notify_driver=False)
                 skip_sync = e.skip_sync
             reset_world(state)
 

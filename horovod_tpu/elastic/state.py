@@ -19,9 +19,12 @@ teardown (``_materialize_to_host``).
 from __future__ import annotations
 
 import copy
+import time
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
+
+from ..common.exceptions import HorovodInternalError
 
 
 def _is_jax_array(x) -> bool:
@@ -65,8 +68,16 @@ class State:
     def commit(self) -> None:
         """Snapshot + poll for membership updates (reference: State.commit
         = save() then check_host_updates())."""
+        # chaos: the per-step injection point of the elastic worker —
+        # kill,at=N self-kills at training step N (the classic elastic
+        # fault); hang freezes mid-step, which only heartbeats can see
+        from .. import chaos as _chaos
+
+        if _chaos.active:
+            _chaos.raise_point("elastic.commit")
         self.save()
         self.check_host_updates()
+        self.check_controller_liveness()
 
     def check_host_updates(self) -> None:
         """Raise ``HostsUpdatedInterrupt`` if the driver announced a
@@ -76,6 +87,37 @@ class State:
 
         notification_manager.check_for_updates()
 
+    def check_controller_liveness(self) -> None:
+        """Raise ``HorovodInternalError`` when the native background loop
+        has died (heartbeat-timed-out peer, bad MAC on the control
+        channel, stall shutdown).  Collective waiters learn this from
+        their own failed futures, but a worker in a NON-collective phase
+        (eval, checkpoint write, a commit-only loop) would otherwise sail
+        past a dead control plane until its next submission; polling here
+        makes every commit a liveness point, so the elastic recovery path
+        starts within one step of the failure.
+
+        Known tradeoff: the loop also stops when a PEER exits cleanly
+        first (idle teardown — the wire cannot distinguish a clean exit
+        from a crash), so a still-committing survivor of an
+        unequal-length job takes one recovery epoch it strictly didn't
+        need.  That epoch converges (exec-restart → rendezvous → the new
+        smaller world resumes from live state), and the alternative —
+        ignoring loop death at commit — leaves genuinely failed workers
+        running blind until their next collective, which may be never."""
+        from ..common import basics
+
+        if not basics.is_initialized():
+            return
+        ctrl = basics._state.controller
+        if (ctrl is not None and getattr(ctrl, "is_native", False)
+                and ctrl.loop_dead()):
+            raise HorovodInternalError(
+                "negotiation background loop has died (peer failure, "
+                "control-channel corruption, or stall shutdown); taking "
+                "the elastic recovery path"
+            )
+
     def save(self) -> None:
         raise NotImplementedError
 
@@ -84,6 +126,27 @@ class State:
 
     def sync(self) -> None:
         raise NotImplementedError
+
+    # -- checkpoint auto-resume (docs/FAULT_TOLERANCE.md) -------------------
+
+    def enable_auto_resume(self, directory: str,
+                           step_attr: str = "step") -> None:
+        """Arm reset-epoch auto-resume: on every (re)boot and membership
+        reset, the run wrapper restores this state from the newest
+        ``checkpoint.save_state_checkpoint`` in ``directory`` IF that
+        checkpoint is ahead of the state's own ``step_attr`` — a freshly
+        spawned replacement worker resumes at the fleet's step instead of
+        zero, and a whole-job restart resumes instead of starting over.
+        Survivors (whose live state is at or past the checkpoint) keep
+        their state; the post-reset ``sync()`` then converges everyone on
+        rank 0's view."""
+        self._resume_dir = directory
+        self._resume_step_attr = step_attr
+
+    def maybe_auto_resume(self) -> Optional[int]:
+        """No-op unless :meth:`enable_auto_resume` armed a directory;
+        subclasses with snapshots implement the restore."""
+        return None
 
     def _materialize_to_host(self) -> None:
         """Convert live device state to host buffers before backend
@@ -151,6 +214,52 @@ class ObjectState(State):
         snap = functions.broadcast_object(self._snapshot(), root_rank=0)
         self._apply_snapshot(snap)
         self.save()
+
+    def maybe_auto_resume(self) -> Optional[int]:
+        """Restore from the newest state checkpoint when it is AHEAD of
+        this state (see :meth:`State.enable_auto_resume`).  Returns the
+        restored step, or None when nothing applied."""
+        directory = getattr(self, "_resume_dir", None)
+        if not directory:
+            return None
+        from .. import checkpoint as _checkpoint
+        from ..metrics import instruments as _metrics
+        from ..utils.logging import get_logger
+
+        # cheap gate first: the step is IN the filename, so the common
+        # case (a survivor whose live state is already at/past the
+        # checkpoint) never reads or unpickles the snapshot blob at all
+        latest = _checkpoint.latest_checkpoint(directory)
+        if latest is None:
+            return None
+        named_step = _checkpoint.checkpoint_step(latest)
+        step_attr = getattr(self, "_resume_step_attr", "step")
+        current = self._attrs.get(step_attr)
+        try:
+            if (current is not None and named_step is not None
+                    and int(current) >= named_step):
+                return None  # live state is at/past the checkpoint
+        except (TypeError, ValueError):
+            pass  # non-numeric step attr: the checkpoint wins
+        found = _checkpoint.peek_state_checkpoint(directory)
+        if found is None:
+            return None
+        ckpt_step, snapshot = found
+        try:
+            if current is not None and int(current) >= ckpt_step:
+                return None  # a newer save landed between the two reads
+        except (TypeError, ValueError):
+            pass
+        t0 = time.perf_counter()
+        self._apply_snapshot(snapshot)
+        self.save()
+        _metrics.RECOVERY_SECONDS.labels("auto_resume").set(
+            time.perf_counter() - t0)
+        get_logger().info(
+            "elastic: auto-resumed from checkpoint step %d (was %s)",
+            ckpt_step, current,
+        )
+        return ckpt_step
 
     def _materialize_to_host(self) -> None:
         for k, v in list(self._attrs.items()):

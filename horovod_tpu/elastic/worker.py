@@ -32,6 +32,7 @@ from typing import Optional
 
 from ..common import wire_auth
 from ..common.exceptions import HorovodInternalError, HostsUpdatedInterrupt
+from ..common.retry import env_float, retry_call
 from ..metrics import instruments as _metrics
 from ..metrics.exposition import register_health_source
 from ..utils.logging import get_logger
@@ -66,7 +67,24 @@ _ASSIGNMENT_ENV = (
     "HVD_TPU_NATIVE_PORT",
 )
 
-_RENDEZVOUS_TIMEOUT = float(os.environ.get("HVD_TPU_ELASTIC_TIMEOUT", "600"))
+_RENDEZVOUS_TIMEOUT = env_float("HVD_TPU_ELASTIC_TIMEOUT", 600.0)
+
+# Per-attempt connect timeout for driver sockets; attempts ride the
+# shared backoff+jitter policy (common/retry.py) under the overall
+# rendezvous budget — a driver briefly down (restart, SYN drop under
+# load) costs a retry, not the worker.
+_CONNECT_TIMEOUT = env_float("HVD_TPU_ELASTIC_CONNECT_TIMEOUT", 10.0)
+
+
+def _connect_driver(site: str, budget: float) -> socket.socket:
+    return retry_call(
+        lambda: socket.create_connection(_driver_addr(),
+                                         timeout=_CONNECT_TIMEOUT),
+        site=site,
+        timeout=budget,
+        retry_on=(OSError,),
+        describe=f"elastic driver connect ({site})",
+    )
 
 # How long after a failure=True notification the main thread gets to begin
 # recovery on its own (reach a host-update check or catch the collective
@@ -162,7 +180,8 @@ class WorkerNotificationManager:
         # to take the recovery path — flagged unhealthy so orchestrators
         # see the blip; a planned pending update is healthy but visible
         register_health_source("elastic_worker", self._health)
-        sock = socket.create_connection(_driver_addr(), timeout=30)
+        sock = _connect_driver("elastic.notify_connect",
+                               budget=_CONNECT_TIMEOUT * 3)
         _send_line(sock, {"type": "register", "worker_id": _worker_id()})
         sock.settimeout(None)
         self._sock = sock
@@ -295,6 +314,27 @@ class WorkerNotificationManager:
             "worker_id": int(os.environ.get(ENV_WORKER_ID, -1)),
         }
 
+    def report_failing(self, reason: str) -> None:
+        """Best-effort worker->driver failure report on the persistent
+        notification connection, sent on the way into exec-restart
+        recovery.  The driver rebroadcasts it as a ``failure=True``
+        membership push, so every OTHER worker starts recovery from its
+        own commit poll within a step — instead of discovering the
+        failure whenever this process's death closes sockets, a race the
+        jax coordination service's fatal handler can win when the dying
+        rank hosted the service (observed: follower SIGABRT'd by
+        PollForError before its first post-failure commit)."""
+        with self._lock:
+            sock = self._sock
+        if sock is None:
+            return
+        try:
+            _send_line(sock, {"type": "failing",
+                              "worker_id": _worker_id(),
+                              "reason": reason[:512]})
+        except (OSError, KeyError, ValueError):
+            pass  # the report is an optimization, never a requirement
+
     def check_for_updates(self) -> None:
         """Raise HostsUpdatedInterrupt if an update is pending (reference:
         State.check_host_updates draining the manager's queue)."""
@@ -319,9 +359,8 @@ def rendezvous() -> dict:
     """Block until the driver hands this worker its assignment for the
     next epoch (reference: the elastic rendezvous server handing out
     rank/size on each reset — SURVEY.md §3.4)."""
-    sock = socket.create_connection(
-        _driver_addr(), timeout=_RENDEZVOUS_TIMEOUT
-    )
+    sock = _connect_driver("elastic.rendezvous", budget=_RENDEZVOUS_TIMEOUT)
+    sock.settimeout(_RENDEZVOUS_TIMEOUT)  # assignment wait, not connect
     try:
         _send_line(sock, {"type": "rendezvous", "worker_id": _worker_id()})
         f = sock.makefile("r")
@@ -421,6 +460,53 @@ def _teardown_jax() -> None:
     _api.clear_backends()
 
 
+def recovery_pending() -> bool:
+    """True when fleet recovery is known to be in flight on this worker:
+    a membership/failure notification is unconsumed, or the native
+    negotiation loop is dead (peer failure, control-channel corruption,
+    stall shutdown)."""
+    mgr = notification_manager
+    with mgr._lock:
+        if mgr._pending_epoch is not None:
+            return True
+    try:
+        from ..common import basics
+
+        ctrl = basics._state.controller
+        return bool(ctrl is not None and getattr(ctrl, "is_native", False)
+                    and ctrl.loop_dead())
+    except Exception:
+        return False
+
+
+# Abandoned-but-referenced runtime objects: dropping the LAST python ref
+# to a live coordination client/service can run a blocking (or fatal)
+# C++ destructor at GC time; parking the refs here leaks them until
+# process exit on purpose.
+_abandoned_runtime = []
+
+
+def _abandon_distributed() -> None:
+    """Drop the coordination-service client/service WITHOUT the shutdown
+    barrier: used when that barrier could never complete (a peer is in
+    exec-restart recovery and will not arrive).  Process exit closes the
+    sockets; the refs are parked so no destructor blocks first."""
+    try:
+        from jax._src import distributed as _dist
+
+        gs = _dist.global_state
+        if gs.client is not None:
+            _abandoned_runtime.append(gs.client)
+            gs.client = None
+        if gs.service is not None:
+            _abandoned_runtime.append(gs.service)
+            gs.service = None
+        gs.coordinator_address = None
+    except Exception as e:
+        get_logger().info("elastic: abandoning distributed state raised "
+                          "(%s)", e)
+
+
 def clean_shutdown() -> None:
     """Coordinated teardown at the end of an elastic job.
 
@@ -428,9 +514,21 @@ def clean_shutdown() -> None:
     leaving it to interpreter-exit atexit ordering is fragile (a task that
     lingers in other finalizers trips the barrier timeout and the service
     then kills every task).  The elastic run wrapper calls this as soon as
-    training returns, while all workers are still in controlled code."""
+    training returns, while all workers are still in controlled code.
+
+    With recovery IN FLIGHT, the barrier is skipped entirely: the
+    restarting peers will never arrive, and old jax (< 0.5, no
+    shutdown-timeout knob) would hold this process in the barrier until
+    the restarting service host's execv kills it through the fatal
+    PollForError handler (chaos-soak finding)."""
     import jax
 
+    if recovery_pending():
+        get_logger().warning(
+            "elastic: fleet recovery in flight at job completion; "
+            "skipping the shutdown barrier (it could never complete)")
+        _abandon_distributed()
+        return
     try:
         from jax._src import distributed as _dist
 
@@ -478,10 +576,16 @@ def reset_world(state) -> None:
     )
 
 
-def restart_after_failure(state) -> None:
+def restart_after_failure(state, notify_driver: bool = True) -> None:
     """Peer-death recovery: persist the last committed state and
     exec-restart this worker in place (same PID — the driver's process
     table is undisturbed), rejoining via rendezvous on boot.
+
+    ``notify_driver=False`` when this restart was ORDERED by a driver
+    failure notification: re-reporting it would make the driver start yet
+    another failure epoch for the world it is already rebuilding (the
+    chaos soak found exactly that feedback loop).  Report only locally
+    detected failures.
 
     Rationale (TPU-specific deviation from the reference, which aborts
     NCCL comms and keeps the process): a JAX process cannot detach from a
@@ -497,6 +601,14 @@ def restart_after_failure(state) -> None:
     # the watchdog exec-restarting from the last commit is the correct
     # backstop.  A concurrent double-restart is safe: execv is the last
     # action of either thread and whichever reaches it first wins.
+    #
+    # Tell the driver FIRST: it rebroadcasts failure=True to the other
+    # members, whose commit polls then begin their own recovery within a
+    # step — bounded by polling cadence, not by when this process's death
+    # happens to close sockets (see report_failing).
+    if notify_driver:
+        notification_manager.report_failing(
+            "control-plane failure; exec-restarting")
     snap = state._snapshot() if hasattr(state, "_snapshot") else None
     get_logger().info("elastic: peer failure — exec-restarting this worker")
     _persist_and_exec(snap)
@@ -535,11 +647,35 @@ def _bounded_live_snapshot(state, timeout_s: float):
 def _persist_and_exec(snap) -> None:
     """Write the state snapshot for the next boot and exec-restart in
     place (same PID).  Safe from any thread: execv replaces the whole
-    process image."""
+    process image.
+
+    When this process HOSTS the jax coordination service, execv destroys
+    the service endpoint and every still-connected peer's client FATALs
+    the instant its PollForError RPC breaks (SIGABRT — observed in the
+    chaos soak's frame-corruption scenario), pre-empting those peers' own
+    clean recovery.  So the service host lingers for a short grace
+    (HVD_TPU_ELASTIC_LEADER_GRACE, default 2 s) after the failure was
+    reported: long enough for peers' commit polls to notice and
+    exec-restart themselves (closing their clients harmlessly), bounded
+    so leader recovery stays fast."""
     import pickle
     import sys
     import tempfile
     import time
+
+    try:
+        from jax._src import distributed as _dist
+
+        hosts_service = _dist.global_state.service is not None
+    except Exception:
+        hosts_service = False
+    if hosts_service:
+        grace = float(os.environ.get("HVD_TPU_ELASTIC_LEADER_GRACE", "2"))
+        if grace > 0:
+            get_logger().info(
+                "elastic: hosting the coordination service — delaying "
+                "exec-restart %.1fs so peers recover first", grace)
+            time.sleep(grace)
 
     if snap is not None:
         t0 = time.time()
@@ -663,6 +799,10 @@ def maybe_restore_after_restart(state) -> None:
                 last_restart_stats[f"{phase}_s"]
             )
         _metrics.ELASTIC_SNAPSHOT_BYTES.set(snap_bytes)
+        # the headline fault-tolerance number: detection-to-trainable
+        # wall time of this recovery (docs/FAULT_TOLERANCE.md)
+        _metrics.RECOVERY_SECONDS.labels("restart").set(
+            last_restart_stats["total_s"])
         get_logger().info(
             "elastic: restart cost %.2fs total (persist %.2fs, "
             "reboot %.2fs, restore %.2fs; snapshot %d bytes)",

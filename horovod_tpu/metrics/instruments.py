@@ -181,6 +181,40 @@ ELASTIC_SNAPSHOT_BYTES = gauge(
     "Serialized state bytes carried across the last exec-restart",
 )
 
+# -- fault tolerance (chaos/, common/retry.py, native heartbeats) ------------
+
+#: Chaos faults actually injected, by site and action (0 in production:
+#: the gauge existing proves chaos was OFF, not unmeasured).
+CHAOS_INJECTIONS = counter(
+    "hvd_tpu_chaos_injections_total",
+    "Chaos faults injected, by site and action",
+    ["site", "action"],
+)
+
+#: Native heartbeat read-deadline expiries (a peer went silent past
+#: HVD_TPU_HEARTBEAT_TIMEOUT); mirrored from the core by delta at
+#: scrape time (a true counter — ``_total``/rate() semantics hold).
+HEARTBEAT_MISSES = counter(
+    "hvd_tpu_heartbeat_misses_total",
+    "Heartbeat deadlines missed by peers on the negotiation channel",
+)
+
+#: Attempts one retry_call() needed before success/exhaustion, by site.
+RETRY_ATTEMPTS = histogram(
+    "hvd_tpu_retry_attempts",
+    "Attempts per retry_call invocation, by site",
+    ["site"],
+    buckets=(1, 2, 3, 5, 8, 13, 21, 34),
+)
+
+#: Wall time from fault detection to training resumed (filled by the
+#: elastic worker: restart total; and by auto-resume restores).
+RECOVERY_SECONDS = gauge(
+    "hvd_tpu_recovery_seconds",
+    "Wall time of the most recent failure recovery, by phase",
+    ["phase"],  # restart / auto_resume
+)
+
 # -- adapters (torch/optimizer.py, keras/callbacks.py) -----------------------
 
 STEP_DURATION = histogram(

@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import chaos as _chaos
 from ..common.exceptions import HorovodInternalError
 from ..common.topology import Topology
 from ..metrics import instruments as _metrics
@@ -124,6 +125,11 @@ class NativeController:
         self._group_call_seqs: Dict[str, int] = {}
         self._lib = ctypes.CDLL(lib_path)
         self._declare(self._lib)
+        # fault injection: export the transport.* rules of the installed
+        # chaos plan into the core BEFORE init builds the transport (the
+        # frame path evaluates them; no plan = one atomic check per frame)
+        _chaos.configure_native_lib(self._lib,
+                                    rank=topology.process_index)
         # the callback object must outlive the native thread: keep the ref
         self._cb = _EXEC_CB(self._on_exec)
         self._lib.hvdtpu_set_exec_callback(self._cb, None)
@@ -222,6 +228,22 @@ class NativeController:
             # core built before the liveness getter: /healthz then
             # reports liveness from the python-side entry table only
             pass
+        try:
+            lib.hvdtpu_chaos_set.restype = ctypes.c_int
+            lib.hvdtpu_chaos_set.argtypes = [
+                ctypes.c_char_p, ctypes.c_int, ctypes.c_double,
+                ctypes.c_longlong, ctypes.c_longlong, ctypes.c_longlong,
+                ctypes.c_double, ctypes.c_int, ctypes.c_char_p,
+                ctypes.c_ulonglong,
+            ]
+            lib.hvdtpu_chaos_clear.restype = None
+            lib.hvdtpu_chaos_injections.restype = ctypes.c_longlong
+            lib.hvdtpu_heartbeat_misses.restype = ctypes.c_longlong
+        except AttributeError:
+            # core built before the chaos/heartbeat API: transport.*
+            # injection rules won't fire and heartbeat misses read 0
+            # (configure_native_lib warns when a plan needs them)
+            pass
         lib.hvdtpu_timeline_activity.restype = None
         lib.hvdtpu_timeline_activity.argtypes = [
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
@@ -286,6 +308,18 @@ class NativeController:
     def pending_count(self) -> int:
         return int(self._lib.hvdtpu_pending_count())
 
+    def heartbeat_misses(self) -> int:
+        """Heartbeat read-deadlines peers missed on the negotiation
+        channel (0 on loopback or a pre-heartbeat core)."""
+        fn = getattr(self._lib, "hvdtpu_heartbeat_misses", None)
+        return int(fn()) if fn is not None else 0
+
+    def chaos_injections(self) -> int:
+        """Faults the NATIVE chaos engine injected so far (the Python
+        engine counts its own through the metrics registry)."""
+        fn = getattr(self._lib, "hvdtpu_chaos_injections", None)
+        return int(fn()) if fn is not None else 0
+
     def loop_dead(self) -> bool:
         """True once the background loop exited (stall shutdown or
         transport death) — every later enqueue would raise."""
@@ -310,6 +344,18 @@ class NativeController:
             1 if self.autotune_active() else 0
         )
         _metrics.NATIVE_LAST_REQUEST_BYTES.set(self.last_request_bytes())
+        hb_delta = self.heartbeat_misses() - _metrics.HEARTBEAT_MISSES.get()
+        if hb_delta > 0:
+            _metrics.HEARTBEAT_MISSES.inc(hb_delta)
+        native_chaos = self.chaos_injections()
+        if native_chaos:
+            # mirror the native engine's count under the shared chaos
+            # counter (site granularity lives in its stderr log)
+            counter = _metrics.CHAOS_INJECTIONS.labels(
+                "transport.frame", "native")
+            delta = native_chaos - counter.get()
+            if delta > 0:
+                counter.inc(delta)
 
     def _health(self):
         """/healthz source: unhealthy when the background loop died (the
@@ -414,6 +460,19 @@ class NativeController:
                 n = self._auto_counters.get(op_type, 0) + 1
                 self._auto_counters[op_type] = n
                 name = f"op{op_type}.auto.{n}"
+        # chaos: a DROP here submits nothing while still handing back a
+        # future — the caller waits on a collective that never happened,
+        # the lost-submission fault; raise/delay/kill/hang act in place.
+        # The future IS registered in _entries so shutdown() (which every
+        # recovery path reaches) fails it — an injected fault must be
+        # recoverable, never an unresolvable hang
+        if _chaos.active and _chaos.point("controller.enqueue") is _chaos.DROP:
+            fut = Future()
+            with self._entries_lock:
+                self._name_counter += 1
+                self._entries[self._name_counter] = _Entry(
+                    None, fut, op_type, name=name)
+            return fut
         # the ENQUEUE span also lands in any active jax.profiler capture
         # (utils/profiler.py bridge), same activity name as the timeline
         with profiler.span(name, "ENQUEUE"):
@@ -502,6 +561,18 @@ class NativeController:
             for _ in arrs:
                 self._name_counter += 1
                 ids.append(self._name_counter)
+        if _chaos.active and _chaos.point("controller.enqueue") is _chaos.DROP:
+            # lost batch; registered so shutdown() fails the futures
+            # (see enqueue())
+            dropped = []
+            with self._entries_lock:
+                for name in names:
+                    self._name_counter += 1
+                    fut = Future()
+                    self._entries[self._name_counter] = _Entry(
+                        None, fut, op_type, name=name)
+                    dropped.append(fut)
+            return dropped
         futs = []
         with profiler.span(names[0] if len(names) == 1
                            else f"{names[0]}+{len(names) - 1}", "ENQUEUE"):
@@ -617,6 +688,11 @@ class NativeController:
                     )
             if not entries:
                 return
+            # chaos on the resolution path: raise/drop fail this fused
+            # response's futures cleanly (via the except below); delay
+            # holds resolution; kill/hang act in place
+            if _chaos.active:
+                _chaos.raise_point("controller.resolve")
             _metrics.FUSED_ENTRIES.observe(len(entries))
             # XLA_COMM span on the exec thread for jax.profiler captures —
             # covers dispatch of the fused program (through data-ready when

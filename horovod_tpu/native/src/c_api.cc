@@ -17,6 +17,7 @@
 #include <string>
 #include <thread>
 
+#include "chaos.h"
 #include "common.h"
 #include "controller.h"
 #include "parameter_manager.h"
@@ -421,6 +422,48 @@ int hvdtpu_pending_count() {
   auto* s = hvdtpu::g();
   return s->initialized.load()
              ? static_cast<int>(s->stall->PendingCount())
+             : 0;
+}
+
+// -- chaos (fault injection) + liveness ------------------------------------
+//
+// The Python layer (horovod_tpu/chaos) parses HVD_TPU_CHAOS, filters by
+// rank, derives per-rule stream seeds, and exports every transport.*
+// rule here BEFORE hvdtpu_init builds the transport; the engine is a
+// process-global singleton so configuration is valid outside init.
+
+int hvdtpu_chaos_set(const char* site, int action, double prob,
+                     long long at, long long after, long long times,
+                     double delay_sec, int exit_code, const char* fuse,
+                     unsigned long long seed) {
+  if (site == nullptr || site[0] == '\0') return 1;
+  if (action < 1 || action > 6) return 1;
+  hvdtpu::chaos::Rule rule;
+  rule.action = static_cast<hvdtpu::chaos::Action>(action);
+  rule.prob = prob;
+  rule.at = at;
+  rule.after = after;
+  rule.times = times;
+  rule.delay_sec = delay_sec;
+  rule.exit_code = exit_code;
+  rule.fuse = fuse ? fuse : "";
+  rule.rng = seed ? seed : 1;
+  hvdtpu::chaos::Engine::Get().Set(site, rule);
+  return 0;
+}
+
+void hvdtpu_chaos_clear() { hvdtpu::chaos::Engine::Get().Clear(); }
+
+long long hvdtpu_chaos_injections() {
+  return hvdtpu::chaos::Engine::Get().injections();
+}
+
+// Heartbeat deadlines missed by peers on the negotiation channel
+// (scraped into hvd_tpu_heartbeat_misses_total at collection time).
+long long hvdtpu_heartbeat_misses() {
+  auto* s = hvdtpu::g();
+  return s->initialized.load() && s->controller
+             ? s->controller->heartbeat_misses()
              : 0;
 }
 

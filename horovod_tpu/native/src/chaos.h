@@ -1,0 +1,181 @@
+// Native-side chaos (fault-injection) engine.
+//
+// The C++ twin of horovod_tpu/chaos: the Python layer parses the
+// HVD_TPU_CHAOS spec, filters rules by rank, derives the per-rule
+// deterministic stream seeds, and exports every `transport.*` rule here
+// through the hvdtpu_chaos_* C API (c_api.cc) BEFORE hvdtpu_init builds
+// the transport.  Evaluation semantics (at/after/times/prob/fuse, the
+// xorshift64 draw) match chaos/spec.py exactly so a rule behaves the
+// same no matter which side evaluates it.
+//
+// Free when idle: Decide() is one relaxed atomic-bool load when no rule
+// is installed — the steady-state frame path pays nothing.
+#pragma once
+
+#include <fcntl.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace hvdtpu {
+namespace chaos {
+
+// Values shared with chaos/spec.py ACTION_ENUM.
+enum class Action : int {
+  kNone = 0,
+  kDrop = 1,
+  kDelay = 2,
+  kCorrupt = 3,
+  kRaise = 4,  // native mapping: fail the transport (clean error path)
+  kKill = 5,
+  kHang = 6,
+};
+
+struct Rule {
+  Action action = Action::kNone;
+  double prob = 1.0;
+  long long at = -1;      // fire exactly on this eval index (-1: off)
+  long long after = 0;    // eligible from this eval index on
+  long long times = -1;   // max fires (-1: unlimited)
+  double delay_sec = 0.05;
+  int exit_code = 137;
+  std::string fuse;       // once-across-restarts marker file ("" = off)
+  uint64_t rng = 1;       // xorshift64 state (per-rule derived stream)
+  long long evals = 0;
+  long long fired = 0;
+};
+
+class Engine {
+ public:
+  static Engine& Get() {
+    static Engine e;
+    return e;
+  }
+
+  void Set(const std::string& site, const Rule& rule) {
+    std::lock_guard<std::mutex> lk(mu_);
+    rules_[site].push_back(rule);
+    active_.store(true, std::memory_order_release);
+  }
+
+  void Clear() {
+    std::lock_guard<std::mutex> lk(mu_);
+    rules_.clear();
+    active_.store(false, std::memory_order_release);
+  }
+
+  long long injections() const {
+    return injections_.load(std::memory_order_relaxed);
+  }
+
+  // Evaluate `site`; returns the action to inject (kNone almost always)
+  // and fills *delay_sec for kDelay.  kDelay/kKill/kHang are EXECUTED
+  // here (sleep / _exit / sleep-forever) so every call site stays a
+  // one-liner; kDrop/kCorrupt/kRaise are returned for the caller to
+  // apply to its own unit of work.
+  Action Decide(const char* site, double* delay_sec = nullptr) {
+    if (!active_.load(std::memory_order_acquire)) return Action::kNone;
+    Action fire = Action::kNone;
+    double fire_delay = 0.0;
+    int fire_code = 137;
+    long long fired_eval = 0;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      auto it = rules_.find(site);
+      if (it == rules_.end()) return Action::kNone;
+      for (auto& r : it->second) {
+        long long eval_idx = r.evals++;
+        if (fire != Action::kNone) continue;  // later counters still advance
+        if (r.times >= 0 && r.fired >= r.times) continue;
+        if (eval_idx < r.after) continue;
+        if (r.at >= 0) {
+          if (eval_idx != r.at) continue;
+        } else if (r.prob < 1.0 && Draw(&r.rng) >= r.prob) {
+          continue;
+        }
+        if (!r.fuse.empty() && !BurnFuse(r.fuse)) {
+          r.times = r.fired;  // burnt in a prior boot: retire the rule
+          continue;           // (no per-eval filesystem probe after this)
+        }
+        r.fired++;
+        fire = r.action;
+        fire_delay = r.delay_sec;
+        fire_code = r.exit_code;
+        fired_eval = eval_idx;
+      }
+      if (fire == Action::kNone) return Action::kNone;
+      injections_.fetch_add(1, std::memory_order_relaxed);
+      std::fprintf(stderr,
+                   "[WARNING] hvd_tpu_core: chaos injecting action %d at "
+                   "%s (eval %lld)\n",
+                   static_cast<int>(fire), site, fired_eval);
+    }
+    switch (fire) {
+      case Action::kDelay: {
+        if (delay_sec != nullptr) *delay_sec = fire_delay;
+        std::this_thread::sleep_for(
+            std::chrono::duration<double>(fire_delay));
+        return Action::kDelay;
+      }
+      case Action::kKill:
+        std::fprintf(stderr,
+                     "[ERROR] hvd_tpu_core: chaos self-kill at %s\n", site);
+        ::_exit(fire_code);
+      case Action::kHang:
+        std::fprintf(stderr,
+                     "[ERROR] hvd_tpu_core: chaos self-hang at %s\n", site);
+        for (;;)
+          std::this_thread::sleep_for(std::chrono::seconds(3600));
+      default:
+        return fire;
+    }
+  }
+
+ private:
+  // Identical generator to chaos/__init__.py _Armed.draw: the two sides
+  // fire on the same draw sequence for the same derived stream seed.
+  static double Draw(uint64_t* state) {
+    uint64_t x = *state;
+    x ^= x << 13;
+    x ^= x >> 7;
+    x ^= x << 17;
+    *state = x;
+    return static_cast<double>(x >> 11) /
+           static_cast<double>(1ULL << 53);
+  }
+
+  static bool BurnFuse(const std::string& path) {
+    int fd = ::open(path.c_str(), O_CREAT | O_EXCL | O_WRONLY, 0644);
+    if (fd >= 0) {
+      ::close(fd);
+      return true;
+    }
+    return false;  // already burnt (or unwritable: never re-arm)
+  }
+
+  std::mutex mu_;
+  std::unordered_map<std::string, std::vector<Rule>> rules_;
+  std::atomic<bool> active_{false};
+  std::atomic<long long> injections_{0};
+};
+
+// One-liner helpers for call sites.
+inline Action Decide(const char* site) { return Engine::Get().Decide(site); }
+
+// Flip one bit in the middle of a payload (matches chaos._corrupt).
+inline void CorruptPayload(std::string* payload) {
+  if (payload != nullptr && !payload->empty())
+    (*payload)[payload->size() / 2] ^= 0x01;
+}
+
+}  // namespace chaos
+}  // namespace hvdtpu

@@ -114,16 +114,23 @@ bool Controller::RunLoopOnce() {
   payload = transport_->BcastResponseList(payload);
   if (transport_->failed()) {
     // peer died mid-negotiation: fail every pending entry so waiters get
-    // HorovodInternalError — the elastic recovery signal (SURVEY.md §5.3)
+    // HorovodInternalError — the elastic recovery signal (SURVEY.md §5.3).
+    // The transport's failure reason NAMES the peer and the cause
+    // (connection closed vs heartbeat deadline) so the error on the
+    // Python side says which process to look at.
+    std::string why = transport_->failure_reason();
+    if (why.empty()) why = "peer died or disconnected";
     size_t n = FailAllPending(
-        "negotiation transport failed (peer died or disconnected)", "");
+        "negotiation transport failed: " + why, "");
     if (n) {
-      logger_(2, "negotiation transport failed with collectives in flight; "
-                 "background loop stopping");
+      logger_(2, "negotiation transport failed (" + why +
+                 ") with collectives in flight; background loop stopping");
     } else {
-      // idle teardown: a peer simply exited first — not an error
-      logger_(1, "peer closed the negotiation channel; "
-                 "background loop stopping");
+      // idle teardown: often just a peer exiting first — not an error —
+      // but still NAME the cause (a heartbeat-timed-out peer detected
+      // while idle must be diagnosable from this one line)
+      logger_(1, "negotiation channel down while idle (" + why +
+                 "); background loop stopping");
     }
     return false;
   }
@@ -220,10 +227,17 @@ bool Controller::RunLoopOnce() {
                    " submitted on this rank but not yet executed "
                    "(waiting on peers?)");
   if (shutdown) {
-    // fail everything in flight so waiters raise instead of hanging
-    FailAllPending("stall shutdown threshold exceeded",
-                   "stall shutdown threshold exceeded; "
-                   "aborting background loop");
+    // fail everything in flight so waiters raise instead of hanging —
+    // naming the stuck tensors so the Python-side error says WHAT never
+    // completed, not just that something did
+    std::string stuck;
+    for (const auto& name : stall_->PendingNames()) {
+      if (!stuck.empty()) stuck += ", ";
+      stuck += name;
+    }
+    std::string msg = "stall shutdown threshold exceeded";
+    if (!stuck.empty()) msg += " (pending: " + stuck + ")";
+    FailAllPending(msg, msg + "; aborting background loop");
     return false;
   }
   return true;

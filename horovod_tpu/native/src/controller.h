@@ -72,6 +72,12 @@ class Controller {
   // O(positions) bytes; a miss cycle carries full encodings).
   int64_t last_request_bytes() const { return last_request_bytes_.load(); }
 
+  // Heartbeat deadlines missed on the negotiation transport (0 on the
+  // loopback transport) — scraped into hvd_tpu_heartbeat_misses_total.
+  long long heartbeat_misses() const {
+    return transport_->heartbeat_misses();
+  }
+
   // Whether the last cycle did anything (popped new entries or executed
   // responses).  Gates the background loop's sleep-skip: progress means
   // more work is likely imminent (piggyback the next request on the

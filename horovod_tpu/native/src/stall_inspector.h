@@ -56,6 +56,20 @@ class StallInspector {
   // Warning horizon (seconds); <= 0 when stall checking is disabled.
   double warn_seconds() const { return warn_seconds_; }
 
+  // Names currently pending (insertion-order-free), capped at `max_n` —
+  // used to NAME the stuck tensors in the stall-shutdown error instead
+  // of a bare "threshold exceeded".
+  std::vector<std::string> PendingNames(size_t max_n = 8) const {
+    std::lock_guard<std::mutex> lk(mu_);
+    std::vector<std::string> names;
+    for (const auto& [name, t0] : pending_) {
+      (void)t0;
+      if (names.size() >= max_n) break;
+      names.push_back(name);
+    }
+    return names;
+  }
+
   size_t PendingCount() const {
     std::lock_guard<std::mutex> lk(mu_);
     return pending_.size();

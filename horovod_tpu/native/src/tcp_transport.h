@@ -23,13 +23,19 @@
 #include <sys/time.h>
 #include <unistd.h>
 
+#include <atomic>
+#include <cerrno>
 #include <chrono>
 #include <cstdlib>
 #include <cstring>
+#include <memory>
+#include <mutex>
+#include <random>
 #include <string>
 #include <thread>
 #include <vector>
 
+#include "chaos.h"
 #include "secret.h"
 #include "thread_pool.h"
 #include "transport.h"
@@ -66,19 +72,61 @@ class TcpTransport : public Transport {
   // injection by anyone who could splice the TCP stream.  A bad MAC
   // poisons the transport exactly like a peer death — FailAllPending on
   // the Python side, never a silently accepted forged response.
+  // Liveness (round-7 fault-tolerance work): every process runs a tiny
+  // heartbeat thread that writes a 4-byte HB frame on each established
+  // control connection every HVD_TPU_HEARTBEAT_INTERVAL seconds, and
+  // steady-state reads carry a HVD_TPU_HEARTBEAT_TIMEOUT receive
+  // deadline.  A peer that is HUNG (process alive, loop frozen — SIGSTOP,
+  // GIL wedge, frozen VM) stops producing both cycle frames and
+  // heartbeats, so the deadline expires and pending collectives fail
+  // FAST with a named-peer error instead of waiting out the stall
+  // inspector; a peer merely BUSY (minutes-long XLA compile inside the
+  // exec callback) keeps heartbeating from this independent thread and is
+  // never false-positived.  Interval/timeout <= 0 disables both (legacy
+  // blocking reads).  HB frames are liveness-only: no payload, no MAC,
+  // no sequence — any byte injection on the stream already desyncs the
+  // MAC'd framing, so they add no authenticated-mode attack surface.
   TcpTransport(const std::string& host, int port, int rank, int size,
                double timeout_sec = 60.0)
       : rank_(rank), size_(size) {
     const char* sec = std::getenv("HVD_TPU_SECRET");
     secret_ = sec ? sec : "";
+    hb_interval_ = EnvSeconds("HVD_TPU_HEARTBEAT_INTERVAL", 5.0);
+    hb_timeout_ = EnvSeconds("HVD_TPU_HEARTBEAT_TIMEOUT", 30.0);
+    if (hb_interval_ <= 0.0 || hb_timeout_ <= 0.0) {
+      hb_interval_ = hb_timeout_ = 0.0;
+    } else if (hb_timeout_ < 3.0 * hb_interval_) {
+      // a deadline tighter than a few beat periods false-positives
+      // healthy-but-idle peers on ordinary jitter; widen it and say so
+      double widened = 3.0 * hb_interval_;
+      std::fprintf(stderr,
+                   "[WARNING] hvd_tpu_core: HVD_TPU_HEARTBEAT_TIMEOUT "
+                   "(%.1fs) < 3x interval (%.1fs); raising the deadline "
+                   "to %.1fs\n",
+                   hb_timeout_, hb_interval_, widened);
+      hb_timeout_ = widened;
+    }
     if (rank == 0) {
+      // the beacon must start BEFORE the accept loop finishes: an
+      // already-connected worker arms its read deadline immediately,
+      // and a straggler peer booting slower than the deadline would
+      // otherwise make that worker false-positive rank 0 as hung on
+      // every cold start (AcceptPeers hands each accepted conn to the
+      // running beacon under the conn's send mutex)
+      peers_ = std::vector<Conn>(static_cast<size_t>(size_));
+      if (hb_interval_ > 0.0)
+        hb_thread_ = std::thread([this] { HeartbeatLoop(); });
       AcceptPeers(port, timeout_sec);
     } else {
       ConnectToRoot(host, port, timeout_sec);
+      if (!failed_ && hb_interval_ > 0.0)
+        hb_thread_ = std::thread([this] { HeartbeatLoop(); });
     }
   }
 
   ~TcpTransport() override {
+    hb_stop_.store(true);
+    if (hb_thread_.joinable()) hb_thread_.join();
     for (auto& peer : peers_)
       if (peer.fd >= 0) ::close(peer.fd);
     if (root_.fd >= 0) ::close(root_.fd);
@@ -88,6 +136,13 @@ class TcpTransport : public Transport {
   int rank() const override { return rank_; }
   int size() const override { return size_; }
   bool failed() const override { return failed_; }
+
+  std::string failure_reason() const override {
+    std::lock_guard<std::mutex> lk(reason_mu_);
+    return failure_reason_;
+  }
+
+  long long heartbeat_misses() const override { return hb_misses_.load(); }
 
   std::vector<std::string> GatherRequests(const std::string& mine) override {
     if (failed_) return {};
@@ -144,13 +199,39 @@ class TcpTransport : public Transport {
   // Per-connection steady-state state.  ``mac_key`` is empty in
   // unauthenticated mode (frames travel bare, as before the round-6
   // change); the sequence counters are per-direction so a recorded frame
-  // cannot be replayed or reordered within either stream.
+  // cannot be replayed or reordered within either stream.  ``send_mu``
+  // serializes the heartbeat thread against the cycle writer — a frame
+  // and a heartbeat must never interleave on the wire.
   struct Conn {
     int fd = -1;
     std::string mac_key;
     uint64_t send_seq = 0;
     uint64_t recv_seq = 0;
+    int peer_rank = -1;
+    std::unique_ptr<std::mutex> send_mu = std::make_unique<std::mutex>();
   };
+
+  // Length-field sentinel marking a heartbeat frame (real frames are
+  // capped at 256 MB, far below this).
+  static constexpr uint32_t kHeartbeatFrame = 0xFFFFFFFFu;
+
+  // Parse a seconds knob; a value that is not a number falls back to
+  // the default WITH a warning (mirrors common/retry.py env_float) —
+  // atof would silently return 0 and turn a typo into "liveness off".
+  static double EnvSeconds(const char* name, double dflt) {
+    const char* v = std::getenv(name);
+    if (v == nullptr || *v == '\0') return dflt;
+    char* end = nullptr;
+    double parsed = std::strtod(v, &end);
+    if (end == v) {
+      std::fprintf(stderr,
+                   "[WARNING] hvd_tpu_core: %s=%s is not a number; "
+                   "using %.1f\n",
+                   name, v, dflt);
+      return dflt;
+    }
+    return parsed;
+  }
 
   // The per-connection frame key, bound to BOTH hello challenges so
   // neither side alone controls it and every connection (even a
@@ -174,12 +255,14 @@ class TcpTransport : public Transport {
       failed_ = true;
       return;
     }
-    peers_.assign(size_, Conn{});
     auto deadline = Clock::now() +
                     std::chrono::duration_cast<Clock::duration>(
                         std::chrono::duration<double>(timeout_sec));
     for (int accepted = 0; accepted < size_ - 1;) {
       if (Clock::now() > deadline) {
+        RecordFailure("rendezvous timed out: only " +
+                      std::to_string(accepted) + " of " +
+                      std::to_string(size_ - 1) + " peers connected");
         failed_ = true;
         return;
       }
@@ -228,8 +311,16 @@ class TcpTransport : public Transport {
         ::close(fd);
         continue;
       }
-      SetRecvTimeout(fd, 0.0);  // steady state: blocking frame reads
-      peers_[peer_rank] = Conn{fd, frame_key, 0, 0};
+      // steady state: reads carry the heartbeat deadline (0 = blocking)
+      SetRecvTimeout(fd, hb_timeout_);
+      {
+        // the beacon thread is already live: publish the conn under its
+        // send mutex so the first heartbeat can't race the field writes
+        std::lock_guard<std::mutex> lk(*peers_[peer_rank].send_mu);
+        peers_[peer_rank].fd = fd;
+        peers_[peer_rank].mac_key = frame_key;
+        peers_[peer_rank].peer_rank = peer_rank;
+      }
       ++accepted;
     }
   }
@@ -293,6 +384,20 @@ class TcpTransport : public Transport {
     auto deadline = Clock::now() +
                     std::chrono::duration_cast<Clock::duration>(
                         std::chrono::duration<double>(timeout_sec));
+    // Exponential backoff with full jitter between attempts (mirrors
+    // common/retry.py): a whole fleet restarting after a failure must
+    // not hammer rank 0's pending listen queue in lockstep — the fixed
+    // 100 ms poll this replaces synchronized every worker's retries.
+    std::mt19937_64 jitter_rng{std::random_device{}()};
+    std::uniform_real_distribution<double> uniform(0.0, 1.0);
+    int attempt = 0;
+    auto backoff = [&] {
+      double cap = std::min(1.0, 0.05 * static_cast<double>(1 << std::min(
+          attempt, 10)));
+      ++attempt;
+      std::this_thread::sleep_for(
+          std::chrono::duration<double>(cap * uniform(jitter_rng)));
+    };
     while (Clock::now() < deadline) {
       addrinfo hints{}, *res = nullptr;
       hints.ai_family = AF_INET;
@@ -300,7 +405,7 @@ class TcpTransport : public Transport {
       if (::getaddrinfo(host.c_str(), std::to_string(port).c_str(), &hints,
                         &res) != 0 ||
           res == nullptr) {
-        std::this_thread::sleep_for(std::chrono::milliseconds(100));
+        backoff();
         continue;
       }
       int fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
@@ -333,8 +438,11 @@ class TcpTransport : public Transport {
           }
           std::string frame_key;
           if (secret_.empty() || AuthenticateToRoot(fd, &frame_key)) {
-            SetRecvTimeout(fd, 0.0);  // steady state: blocking reads
-            root_ = Conn{fd, frame_key, 0, 0};
+            // steady state: heartbeat deadline on reads (0 = blocking)
+            SetRecvTimeout(fd, hb_timeout_);
+            root_.fd = fd;
+            root_.mac_key = frame_key;
+            root_.peer_rank = 0;
             return;
           }
         }
@@ -344,8 +452,10 @@ class TcpTransport : public Transport {
       }
       if (fd >= 0) ::close(fd);
       ::freeaddrinfo(res);
-      std::this_thread::sleep_for(std::chrono::milliseconds(100));
+      backoff();
     }
+    RecordFailure("rendezvous with the coordinator at " + host + ":" +
+                  std::to_string(port) + " timed out");
     failed_ = true;
   }
 
@@ -361,15 +471,30 @@ class TcpTransport : public Transport {
     ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
   }
 
-  static bool ReadAll(int fd, void* buf, size_t n) {
+  // Read outcome: distinguishes the heartbeat deadline expiring (peer
+  // alive-but-silent or frozen) from the connection closing (peer died)
+  // so the failure reason can name what actually happened.
+  enum class IoRc { kOk, kClosed, kTimeout };
+
+  static IoRc ReadAllRc(int fd, void* buf, size_t n) {
     char* p = static_cast<char*>(buf);
     while (n > 0) {
       ssize_t got = ::recv(fd, p, n, 0);
-      if (got <= 0) return false;
-      p += got;
-      n -= static_cast<size_t>(got);
+      if (got > 0) {
+        p += got;
+        n -= static_cast<size_t>(got);
+        continue;
+      }
+      if (got == 0) return IoRc::kClosed;
+      if (errno == EINTR) continue;
+      if (errno == EAGAIN || errno == EWOULDBLOCK) return IoRc::kTimeout;
+      return IoRc::kClosed;
     }
-    return true;
+    return IoRc::kOk;
+  }
+
+  static bool ReadAll(int fd, void* buf, size_t n) {
+    return ReadAllRc(fd, buf, n) == IoRc::kOk;
   }
 
   static bool WriteAll(int fd, const void* buf, size_t n) {
@@ -400,46 +525,167 @@ class TcpTransport : public Transport {
   char SendDir() const { return rank_ == 0 ? 'C' : 'W'; }
   char RecvDir() const { return rank_ == 0 ? 'W' : 'C'; }
 
+  // First failure cause wins (concurrent pool reads can fail together);
+  // read by failure_reason() for the named-peer FailAllPending error.
+  void RecordFailure(const std::string& why) {
+    std::lock_guard<std::mutex> lk(reason_mu_);
+    if (failure_reason_.empty()) failure_reason_ = why;
+  }
+
+  bool ReadFailed(const Conn* conn, IoRc rc) {
+    if (rc == IoRc::kTimeout) {
+      hb_misses_.fetch_add(1);
+      RecordFailure(
+          "peer rank " + std::to_string(conn->peer_rank) +
+          " sent nothing (not even heartbeats) for " +
+          std::to_string(static_cast<int>(hb_timeout_)) +
+          "s — process hung or frozen");
+    } else {
+      RecordFailure("connection to peer rank " +
+                    std::to_string(conn->peer_rank) +
+                    " closed (process died or disconnected)");
+    }
+    return false;
+  }
+
   // Steady-state frame wire: len(4, LE) + payload + MAC(32, authenticated
-  // mode only).  A bad length, short read, or MAC mismatch returns false,
-  // which the callers translate into transport failure (FailAllPending on
-  // the Python side) — a tampered or injected frame can fail the job but
-  // never feed it a forged negotiation payload.
+  // mode only).  A bad length, short read, deadline expiry, or MAC
+  // mismatch returns false, which the callers translate into transport
+  // failure (FailAllPending on the Python side) — a tampered or injected
+  // frame can fail the job but never feed it a forged negotiation
+  // payload.  Heartbeat frames (length == kHeartbeatFrame) are consumed
+  // transparently: each one proves the peer alive and re-arms the
+  // receive deadline.
   bool ReadFrame(Conn* conn, std::string* out) {
-    uint32_t len = 0;
-    if (!ReadAll(conn->fd, &len, 4) || len > (256u << 20)) return false;
-    out->resize(len);
-    if (len != 0 && !ReadAll(conn->fd, out->data(), len)) return false;
-    if (conn->mac_key.empty()) return true;
-    std::string mac(32, '\0');
-    if (!ReadAll(conn->fd, &mac[0], mac.size())) return false;
-    std::string want =
-        FrameMac(conn->mac_key, RecvDir(), conn->recv_seq, *out);
-    if (!secret::MacEqual(mac, want)) {
-      std::fprintf(stderr,
-                   "[ERROR] hvd_tpu_core: bad MAC on steady-state "
-                   "negotiation frame (seq %llu) — tampered or injected "
-                   "traffic on the control channel; failing the "
-                   "transport\n",
-                   static_cast<unsigned long long>(conn->recv_seq));
+    auto act = chaos::Decide("transport.frame.recv");
+    if (act == chaos::Action::kRaise) {
+      RecordFailure("chaos-injected receive failure");
       return false;
     }
-    ++conn->recv_seq;
-    return true;
+    for (;;) {
+      uint32_t len = 0;
+      IoRc rc = ReadAllRc(conn->fd, &len, 4);
+      if (rc != IoRc::kOk) return ReadFailed(conn, rc);
+      if (len == kHeartbeatFrame) continue;  // liveness-only frame
+      if (len > (256u << 20)) {
+        RecordFailure("oversized frame from peer rank " +
+                      std::to_string(conn->peer_rank));
+        return false;
+      }
+      out->resize(len);
+      if (len != 0) {
+        rc = ReadAllRc(conn->fd, out->data(), len);
+        if (rc != IoRc::kOk) return ReadFailed(conn, rc);
+      }
+      if (act == chaos::Action::kCorrupt) chaos::CorruptPayload(out);
+      if (act == chaos::Action::kDrop) {
+        // simulated message loss: discard this frame (and its MAC) and
+        // wait for the next one — the peers' protocol states now skew,
+        // which is exactly the desync the recovery path must survive
+        if (!conn->mac_key.empty()) {
+          std::string mac(32, '\0');
+          rc = ReadAllRc(conn->fd, &mac[0], mac.size());
+          if (rc != IoRc::kOk) return ReadFailed(conn, rc);
+          ++conn->recv_seq;
+        }
+        act = chaos::Action::kNone;
+        continue;
+      }
+      if (conn->mac_key.empty()) return true;
+      std::string mac(32, '\0');
+      rc = ReadAllRc(conn->fd, &mac[0], mac.size());
+      if (rc != IoRc::kOk) return ReadFailed(conn, rc);
+      std::string want =
+          FrameMac(conn->mac_key, RecvDir(), conn->recv_seq, *out);
+      if (!secret::MacEqual(mac, want)) {
+        std::fprintf(stderr,
+                     "[ERROR] hvd_tpu_core: bad MAC on steady-state "
+                     "negotiation frame (seq %llu) — tampered or injected "
+                     "traffic on the control channel; failing the "
+                     "transport\n",
+                     static_cast<unsigned long long>(conn->recv_seq));
+        RecordFailure(
+            "bad MAC on a negotiation frame from peer rank " +
+            std::to_string(conn->peer_rank) +
+            " (tampered or corrupted control traffic)");
+        return false;
+      }
+      ++conn->recv_seq;
+      return true;
+    }
   }
 
   bool WriteFrame(Conn* conn, const std::string& payload) {
-    uint32_t len = static_cast<uint32_t>(payload.size());
+    auto act = chaos::Decide("transport.frame.send");
+    if (act == chaos::Action::kRaise) {
+      RecordFailure("chaos-injected send failure");
+      return false;
+    }
+    if (act == chaos::Action::kDrop) return true;  // simulated loss
+    // MAC over the ORIGINAL payload, then (under chaos corrupt) flip one
+    // bit of what actually travels: the receiver sees a genuine
+    // corruption — MAC mismatch in authenticated mode, a garbled
+    // encoding otherwise — and must take the clean failure path.
+    const std::string* body = &payload;
+    std::string corrupted;
+    if (act == chaos::Action::kCorrupt) {
+      if (payload.empty() && conn->mac_key.empty()) {
+        // nothing to flip and no MAC to break: inject as a transport
+        // failure — a fault the engine counted must actually happen
+        // (mirrors the Python engine's corrupt-without-payload rule)
+        RecordFailure(
+            "chaos-injected corruption (empty unauthenticated frame)");
+        return false;
+      }
+      corrupted = payload;
+      if (!corrupted.empty()) {
+        chaos::CorruptPayload(&corrupted);
+        body = &corrupted;
+      }
+    }
+    std::lock_guard<std::mutex> lk(*conn->send_mu);
+    uint32_t len = static_cast<uint32_t>(body->size());
     if (!WriteAll(conn->fd, &len, 4)) return false;
-    if (!payload.empty() &&
-        !WriteAll(conn->fd, payload.data(), payload.size()))
+    if (!body->empty() && !WriteAll(conn->fd, body->data(), body->size()))
       return false;
     if (conn->mac_key.empty()) return true;
     std::string mac =
         FrameMac(conn->mac_key, SendDir(), conn->send_seq, payload);
+    if (act == chaos::Action::kCorrupt && body == &payload)
+      mac[0] ^= 0x01;  // empty payload: corrupt the MAC instead
     if (!WriteAll(conn->fd, mac.data(), mac.size())) return false;
     ++conn->send_seq;
     return true;
+  }
+
+  // Periodic liveness beacon, independent of the negotiation loop: a
+  // rank blocked for minutes inside the exec callback (first-touch XLA
+  // compile) still heartbeats; a frozen process does not.
+  void HeartbeatLoop() {
+    auto last = Clock::now();
+    while (!hb_stop_.load()) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+      if (std::chrono::duration<double>(Clock::now() - last).count() <
+          hb_interval_)
+        continue;
+      last = Clock::now();
+      if (rank_ == 0) {
+        for (auto& peer : peers_) SendHeartbeat(&peer);
+      } else {
+        SendHeartbeat(&root_);
+      }
+    }
+  }
+
+  void SendHeartbeat(Conn* conn) {
+    // fd checked under the send mutex: on rank 0 this thread runs while
+    // AcceptPeers is still publishing connections
+    std::lock_guard<std::mutex> lk(*conn->send_mu);
+    if (conn->fd < 0) return;
+    uint32_t magic = kHeartbeatFrame;
+    // failures are ignored: the cycle path owns failure detection and
+    // reporting; a dead fd just stops beaconing
+    WriteAll(conn->fd, &magic, 4);
   }
 
   int rank_;
@@ -449,6 +695,14 @@ class TcpTransport : public Transport {
   Conn root_;
   std::vector<Conn> peers_;
   bool failed_ = false;
+  // liveness (see constructor comment)
+  double hb_interval_ = 0.0;
+  double hb_timeout_ = 0.0;
+  std::thread hb_thread_;
+  std::atomic<bool> hb_stop_{false};
+  std::atomic<long long> hb_misses_{0};
+  mutable std::mutex reason_mu_;
+  std::string failure_reason_;
   // IO pool sized for a per-host controller star (reference default: 4)
   ThreadPool pool_{4};
 };

@@ -33,6 +33,16 @@ class Transport {
   // True when the transport failed mid-collective => HorovodInternalError
   // on the Python side (elastic recovery hook).
   virtual bool failed() const { return false; }
+
+  // Human-readable cause of the failure, naming the peer when known
+  // ("peer rank 2 missed heartbeats for 30s") — surfaced verbatim in the
+  // FailAllPending error so operators see WHICH process to look at
+  // instead of a generic "transport failed".  Empty when not failed or
+  // the cause is unknown.
+  virtual std::string failure_reason() const { return ""; }
+
+  // Heartbeat read-deadline expiries observed (TCP transport only).
+  virtual long long heartbeat_misses() const { return 0; }
 };
 
 // Single-process world: negotiation degenerates to identity.

@@ -34,6 +34,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from ..common import wire_auth
+from ..common.retry import env_float, retry_call
 from ..elastic.worker import ENV_DRIVER, ENV_ELASTIC, ENV_WORKER_ID
 from ..metrics import instruments as _metrics
 from ..utils.logging import get_logger
@@ -67,23 +68,53 @@ def _free_port() -> int:
 class HostDiscovery:
     """Wraps the user's discovery script (reference:
     runner/elastic/discovery.py HostDiscoveryScript): executable printing
-    one ``host`` or ``host:slots`` per line."""
+    one ``host`` or ``host:slots`` per line.
+
+    The script is an external dependency that flakes in real clusters
+    (cloud API hiccup, ssh probe timing out), so invocations ride the
+    shared backoff+jitter policy: ``HVD_TPU_DISCOVERY_TIMEOUT`` seconds
+    per attempt (default 30), ``HVD_TPU_DISCOVERY_RETRIES`` attempts
+    (default 3) before the failure surfaces to the poll loop — which
+    already tolerates it by keeping the previous host set."""
 
     def __init__(self, script: str, default_slots: int = 1):
         self.script = script
         self.default_slots = default_slots
+        self.timeout = env_float("HVD_TPU_DISCOVERY_TIMEOUT", 30.0)
+        self.retries = int(env_float("HVD_TPU_DISCOVERY_RETRIES", 3))
 
-    def find_available_hosts(self) -> List[Tuple[str, int]]:
+    def _run_script(self) -> str:
         out = subprocess.run(
-            [self.script], capture_output=True, text=True, timeout=30
+            [self.script], capture_output=True, text=True,
+            timeout=self.timeout,
         )
         if out.returncode != 0:
             raise RuntimeError(
                 f"host discovery script failed ({out.returncode}): "
                 f"{out.stderr.strip()}"
             )
+        return out.stdout
+
+    def find_available_hosts(self) -> List[Tuple[str, int]]:
+        try:
+            stdout = retry_call(
+                self._run_script,
+                site="elastic.discovery",
+                retry_on=(RuntimeError, OSError,
+                          subprocess.TimeoutExpired),
+                attempts=max(1, self.retries),
+                describe=f"host discovery ({self.script})",
+            )
+        except RuntimeError:
+            raise
+        except (OSError, subprocess.TimeoutExpired) as e:
+            # normalize to the contract the poll loops catch
+            # (`except RuntimeError` keeps the previous host set) — a
+            # persistent flake must degrade the poll, never crash the
+            # driver and reap the fleet
+            raise RuntimeError(f"host discovery failed: {e}") from e
         hosts = []
-        for line in out.stdout.splitlines():
+        for line in stdout.splitlines():
             line = line.strip()
             if not line:
                 continue
@@ -140,9 +171,8 @@ class ElasticDriver:
         self.max_np = max_np
         self.knob_env = knob_env or {}
         self.poll_interval = poll_interval
-        self.timeout = timeout or float(
-            os.environ.get("HVD_TPU_ELASTIC_TIMEOUT", "600")
-        )
+        self.timeout = timeout or env_float("HVD_TPU_ELASTIC_TIMEOUT",
+                                            600.0)
         self.verbose = verbose
         # per-job control-plane secret: signs the driver<->worker JSON
         # lines AND the workers' native-star hello; exported through the
@@ -160,6 +190,9 @@ class ElasticDriver:
         self._notify_socks: Dict[int, socket.socket] = {}
         self._server: Optional[socket.socket] = None
         self._shutdown = False
+        # a live worker reported control-plane failure ("failing" line):
+        # drives a failure=True reset epoch even with no process exit
+        self._failure_reported = False
 
     # -- server ------------------------------------------------------------
 
@@ -202,13 +235,43 @@ class ElasticDriver:
         if kind == "register":
             with self._cv:
                 self._notify_socks[wid] = conn
-            # keep the socket open; its EOF doubles as a liveness signal
+            # keep the socket open (its EOF doubles as a liveness signal)
+            # and keep READING it: a worker entering exec-restart recovery
+            # reports "failing" here so the driver can push failure=True
+            # to the other members immediately — their recovery then
+            # starts from their own commit polls instead of racing the
+            # jax coordination service's fatal handler
+            self._drain_notify_conn(wid, conn, f)
         elif kind == "rendezvous":
             with self._cv:
                 self._pending_rendezvous[wid] = conn
                 self._cv.notify_all()
         else:
             conn.close()
+
+    def _drain_notify_conn(self, wid, conn: socket.socket, f) -> None:
+        """Read worker->driver reports on the registered connection until
+        EOF (runs on the per-connection handler thread)."""
+        while not self._shutdown:
+            try:
+                line = f.readline()
+            except OSError:
+                return
+            if not line:
+                return  # EOF: liveness handled by the send path
+            try:
+                msg = _verified(json.loads(line))
+            except ValueError:
+                continue
+            if msg is None:
+                continue
+            if msg.get("type") == "failing":
+                get_logger().warning(
+                    "elastic: worker %s reports failure: %s",
+                    wid, msg.get("reason", ""))
+                with self._cv:
+                    self._failure_reported = True
+                    self._cv.notify_all()
 
     # -- worker lifecycle --------------------------------------------------
 
@@ -307,10 +370,14 @@ class ElasticDriver:
 
     def _query_ports(self, sock: socket.socket):
         """Ask the rank-0-elect worker to allocate the epoch's
-        coordinator + native ports on its host."""
+        coordinator + native ports on its host.  The reply deadline is
+        ``HVD_TPU_ELASTIC_NOTIFY_TIMEOUT`` (default 30 s) — env-tunable
+        because a loaded rank-0 host legitimately takes longer than a
+        hard-coded 30 under CI-grade contention."""
         try:
             sock.sendall(_signed_line({"type": "allocate_ports"}))
-            sock.settimeout(30)
+            sock.settimeout(env_float("HVD_TPU_ELASTIC_NOTIFY_TIMEOUT",
+                                      30.0))
             reply = _verified(json.loads(sock.makefile("r").readline()))
             sock.settimeout(None)
             if reply is None or reply.get("type") != "ports":
@@ -322,18 +389,36 @@ class ElasticDriver:
     def _notify_hosts_updated(self, failure: bool = False) -> None:
         """Push the membership change; ``failure=True`` tells survivors a
         peer died, so they must take the restart recovery path (a graceful
-        in-process teardown would trip on the dead peer's barrier)."""
+        in-process teardown would trip on the dead peer's barrier).
+
+        A survivor dying MID-NOTIFY must not take the monitor down: every
+        send failure is caught (any exception, not just OSError) and the
+        remaining survivors are still notified.  The dead socket is
+        dropped; the death itself is booked by ``_observe_exits`` — the
+        ONE place exits become visible (exit code + blacklist + metrics +
+        completion flag) — which the very next ``_complete_rendezvous``
+        wait iteration runs.  A send failure with the process still alive
+        is the normal exec-restart window (the restarting worker's socket
+        closed at execv; it re-registers after boot).
+
+        Sends run OUTSIDE the driver lock: a frozen worker whose recv
+        buffer fills would otherwise block ``sendall`` while holding the
+        only lock, deadlocking every other driver thread."""
+        with self._cv:
+            targets = list(self._notify_socks.items())
+            line = _signed_line({"type": "hosts_updated",
+                                 "epoch": self._epoch,
+                                 "failure": failure})
         dead = []
-        for wid, sock in self._notify_socks.items():
+        for wid, sock in targets:
             try:
-                sock.sendall(_signed_line(
-                    {"type": "hosts_updated", "epoch": self._epoch,
-                     "failure": failure}
-                ))
-            except OSError:
+                sock.sendall(line)
+            except Exception:
                 dead.append(wid)
-        for wid in dead:
-            self._notify_socks.pop(wid, None)
+        if dead:
+            with self._cv:
+                for wid in dead:
+                    self._notify_socks.pop(wid, None)
 
     def _complete_rendezvous(self, driver_host: str) -> bool:
         """Wait until every live worker has requested rendezvous, then
@@ -408,6 +493,12 @@ class ElasticDriver:
                         pass
                     sock.close()
                     self._pending_rendezvous.pop(wid, None)
+            # "failing" reports that arrived while THIS epoch was being
+            # arranged are part of the failure it just recovered from —
+            # carrying them forward would trigger a spurious next epoch
+            # (a genuinely new failure gets re-reported or shows up as an
+            # out-of-band rendezvous)
+            self._failure_reported = False
             _metrics.ELASTIC_RENDEZVOUS.inc()
             _metrics.ELASTIC_WORLD_SIZE.set(len(members))
             _metrics.ELASTIC_EPOCH.set(self._epoch)
@@ -481,6 +572,13 @@ class ElasticDriver:
             time.sleep(0.1)
             with self._cv:
                 _, had_failure = self._observe_exits()
+                if self._failure_reported:
+                    # a live member says its control plane died: run a
+                    # failure reset epoch now — survivors recover from
+                    # their commit polls instead of waiting for the
+                    # failing process's death to close sockets
+                    self._failure_reported = False
+                    had_failure = True
                 membership_changed = had_failure
                 alive = self._alive_workers()
             if not alive and not membership_changed:

@@ -124,7 +124,9 @@ def data_parallel_train_step(
 
 
 def fit_epoch(step: Callable, state: TrainState, loader,
-              epoch: Optional[int] = None):
+              epoch: Optional[int] = None, *,
+              checkpoint_dir: Optional[str] = None,
+              checkpoint_every: int = 0):
     """Drive one epoch of a compiled train step from a
     :class:`horovod_tpu.data.DataLoader` (or any iterable of
     ``(inputs, labels)`` batches).
@@ -138,14 +140,33 @@ def fit_epoch(step: Callable, state: TrainState, loader,
         for epoch in range(epochs):
             state, loss = training.fit_epoch(step, state, loader, epoch)
 
+    With ``checkpoint_dir`` + ``checkpoint_every`` set, rank 0 writes a
+    crash-atomic checkpoint every N batches (``checkpoint.save_checkpoint``
+    keyed by ``state.step``) — pair with ``checkpoint.restore_checkpoint``
+    before training so a restarted job resumes instead of starting over
+    (docs/FAULT_TOLERANCE.md).  The ``int(state.step)`` read is the only
+    device sync this adds, and only on checkpoint batches.
+
     Returns ``(state, last_loss)`` with the loss fetched to host — the
     end-of-epoch sync point.  ``last_loss`` is None for an empty shard.
     """
+    from . import chaos as _chaos
+    from . import checkpoint as _checkpoint
+
     if epoch is not None and hasattr(loader, "set_epoch"):
         loader.set_epoch(epoch)
     loss = None
+    batches = 0
     for inputs, labels in loader:
+        if _chaos.active:
+            _chaos.raise_point("training.step")
         state, loss = step(state, inputs, labels)
+        batches += 1
+        if (checkpoint_dir and checkpoint_every
+                and batches % checkpoint_every == 0):
+            _checkpoint.save_checkpoint(
+                checkpoint_dir, state, int(state.step)
+            )
     if loss is not None:
         loss = float(loss)  # the only sync some remote backends honor
     return state, loss

@@ -1,0 +1,98 @@
+"""Chaos-soak worker: commit-driven elastic training under fault injection.
+
+Reference analog: the training scripts of elastic_common.py (SURVEY.md
+§4), extended with the round-7 fault-tolerance machinery: per-batch
+``state.commit()`` (the chaos ``elastic.commit`` injection point and the
+controller-liveness poll), per-batch rank-0 state checkpoints, and
+``enable_auto_resume`` so a REPLACEMENT worker — spawned fresh after
+chaos kills a member, with no exec-restart snapshot to inherit — resumes
+from the fleet's newest checkpoint instead of step 0.
+
+Usage: chaos_worker.py <logdir> <batches> <ckpt_dir>
+
+Env:
+  HVD_TPU_SOAK_LOCAL_SYNC=1   use a per-worker state (sync() = save only).
+      Needed on hosts whose jax cannot run multi-process XLA collectives
+      (CPU backend < jax 0.5): the control plane (rendezvous, native
+      negotiation, heartbeats, exec-restart recovery) is fully exercised,
+      only the cross-worker state broadcast is skipped.  On real TPU
+      fleets leave it unset.
+
+Every batch "trains" by incrementing ``weight`` by exactly 1, so after
+any fault/recovery dance the final weight must equal the batch count —
+lost or duplicated work is arithmetically visible.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu import checkpoint as hvd_checkpoint
+
+
+def log(logdir, **kv):
+    wid = os.environ.get("HVD_TPU_ELASTIC_WORKER_ID", "na")
+    with open(os.path.join(logdir, f"worker_{wid}.log"), "a") as f:
+        f.write(json.dumps(kv) + "\n")
+
+
+class LocalSyncState(hvd.elastic.TpuState):
+    """Per-worker state: every worker is its own authority (no rank-0
+    broadcast).  For workloads/hosts where cross-worker sync is either
+    unwanted or unavailable; recovery still flows through commits,
+    snapshots and checkpoints."""
+
+    def sync(self):
+        self.save()
+
+
+def main():
+    logdir, batches, ckpt_dir = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+    hvd.init()
+
+    cls = (LocalSyncState
+           if os.environ.get("HVD_TPU_SOAK_LOCAL_SYNC") == "1"
+           else hvd.elastic.TpuState)
+    state = cls(step=0, weight=np.zeros(()))
+    state.enable_auto_resume(ckpt_dir, step_attr="step")
+
+    log(logdir, event="init", rank=hvd.cross_rank(), world=hvd.cross_size(),
+        pid=os.getpid())
+
+    def on_reset():
+        log(logdir, event="reset", world=hvd.cross_size(),
+            step=int(state.step))
+
+    state.register_reset_callbacks([on_reset])
+
+    @hvd.elastic.run
+    def train(state):
+        # first visible step after boot/reset: >0 here on a FRESH worker
+        # proves checkpoint auto-resume kicked in (it had no snapshot)
+        log(logdir, event="boot", step=int(state.step),
+            rank=hvd.cross_rank(), world=hvd.cross_size())
+        while state.step < batches:
+            state.weight = np.asarray(state.weight) + 1.0
+            state.step = int(state.step) + 1
+            state.commit()
+            if hvd.cross_rank() == 0:
+                hvd_checkpoint.save_state_checkpoint(
+                    ckpt_dir, state, state.step)
+            log(logdir, event="batch", step=state.step,
+                weight=float(state.weight), rank=hvd.cross_rank(),
+                world=hvd.cross_size())
+            time.sleep(0.05)
+        return float(state.weight)
+
+    final = train(state)
+    assert abs(final - batches) < 1e-6, (final, batches)
+    log(logdir, event="done", weight=final, step=int(state.step),
+        world=hvd.cross_size(), rank=hvd.cross_rank())
+
+
+if __name__ == "__main__":
+    main()

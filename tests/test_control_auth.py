@@ -26,6 +26,7 @@ import pytest
 
 import horovod_tpu.runner.launch as launch
 from horovod_tpu.common import wire_auth
+from envguards import requires_multiprocess_collectives
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "integration", "launcher_worker.py")
@@ -360,6 +361,7 @@ sys.exit(3)
 
 
 @pytest.mark.integration
+@requires_multiprocess_collectives  # the 2-proc job runs real collectives
 def test_native_star_rejects_secretless_peer():
     """A peer without the job secret must be rejected by rank 0's accept
     loop WITHOUT consuming the rank slot: the rogue sees EOF after its
@@ -476,6 +478,7 @@ def fake_ssh(tmp_path, monkeypatch):
 
 
 @pytest.mark.integration
+@requires_multiprocess_collectives  # the 2-proc job runs real collectives
 def test_launch_ssh_end_to_end(fake_ssh, monkeypatch):
     """_launch_ssh over two non-local 'hosts' (loopback aliases), driven
     through the shim: collectives must pass on both ranks, the secret and

@@ -23,6 +23,7 @@ from horovod_tpu.elastic import ElasticSampler, ObjectState, TpuState
 from horovod_tpu.common.exceptions import (
     HorovodInternalError, HostsUpdatedInterrupt,
 )
+from envguards import requires_multiprocess_collectives
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "integration", "elastic_worker.py")
@@ -306,6 +307,7 @@ def _read_logs(logdir):
 
 
 @pytest.mark.integration
+@requires_multiprocess_collectives  # workers allreduce across processes
 def test_elastic_scale_up(tmp_path):
     """Start at 1 worker, add a slot mid-run, finish at 2 (reference:
     elastic scale-up via discovery-file mutation)."""
@@ -387,6 +389,7 @@ def test_terminated_driver_reaps_workers(tmp_path):
 
 
 @pytest.mark.integration
+@requires_multiprocess_collectives  # workers allreduce across processes
 def test_elastic_restart_cost_bounded_at_100mb(tmp_path):
     """A planned membership change with 100 MB of elastic state must
     exec-restart in bounded time, with the disk snapshot (persist +
@@ -439,6 +442,7 @@ def test_elastic_restart_cost_bounded_at_100mb(tmp_path):
 
 
 @pytest.mark.integration
+@requires_multiprocess_collectives  # workers allreduce across processes
 def test_elastic_fault_recovery(tmp_path):
     """Kill -9 a worker mid-training; survivor rolls back to the last
     commit and finishes alone (reference: elastic_common.py's kill-based
@@ -492,6 +496,7 @@ def test_elastic_fault_recovery(tmp_path):
 
 
 @pytest.mark.integration
+@requires_multiprocess_collectives  # workers allreduce across processes
 def test_elastic_scale_down(tmp_path):
     """Start at 2 workers, remove a slot mid-run: the displaced worker
     rendezvouses, takes the "shutdown" reply and exits 0; the survivor

@@ -11,12 +11,15 @@ import sys
 
 import pytest
 
+from envguards import requires_multiprocess_collectives
+
 import horovod_tpu.ray as hvd_ray
 import horovod_tpu.spark as hvd_spark
 from tests.executor_fns import rank_report
 
 
 @pytest.mark.integration
+@requires_multiprocess_collectives  # spawns an N-proc world running collectives
 def test_ray_executor_local_backend(monkeypatch):
     monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "")
     monkeypatch.setenv("JAX_PLATFORMS", "cpu")
@@ -35,6 +38,7 @@ def test_ray_executor_local_backend(monkeypatch):
 
 
 @pytest.mark.integration
+@requires_multiprocess_collectives  # spawns an N-proc world running collectives
 def test_elastic_ray_executor_local_backend(monkeypatch):
     """ElasticRayExecutor contract on the subprocess backend: callable
     discovery feeds the same ElasticDriver as tpurun --host-discovery-

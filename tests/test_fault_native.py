@@ -1,0 +1,283 @@
+"""Native fault-tolerance paths over ctypes: heartbeats + stall inspector.
+
+Technique (established in test_control_auth.py): drive the REAL native
+core in a child process via ctypes against a python fake coordinator
+speaking the documented wire — no jax, no fleet, deterministic timing.
+Children must call ``hvdtpu_shutdown()`` before exiting or the static
+destructors abort.
+"""
+
+import os
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIB = os.path.join(REPO, "horovod_tpu", "native", "libhvd_tpu_core.so")
+
+HB = struct.pack("<I", 0xFFFFFFFF)  # heartbeat frame (length sentinel)
+
+
+def _require_lib():
+    if not os.path.exists(LIB):
+        pytest.skip("native core not built")
+
+
+def _recv_exact(conn, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError(f"EOF after {len(buf)}/{n} bytes")
+        buf += chunk
+    return buf
+
+
+def _accept_hello(srv):
+    conn, _ = srv.accept()
+    conn.settimeout(60)
+    hello = _recv_exact(conn, 5)  # rank(4) + auth flag(1)
+    assert struct.unpack("<i", hello[:4])[0] == 1
+    conn.sendall(b"\x00")  # coordinator: no secret
+    return conn
+
+
+def _read_worker_frame(conn):
+    """One negotiation frame's payload, transparently skipping worker
+    heartbeats (liveness-only 4-byte frames)."""
+    while True:
+        (length,) = struct.unpack("<I", _recv_exact(conn, 4))
+        if length == 0xFFFFFFFF:
+            continue
+        return _recv_exact(conn, length)
+
+
+_CHILD_PRELUDE = """
+import ctypes, sys, time
+lib = ctypes.CDLL({lib!r})
+lib.hvdtpu_init.restype = ctypes.c_int
+lib.hvdtpu_init.argtypes = [
+    ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+    ctypes.c_double, ctypes.c_longlong, ctypes.c_int, ctypes.c_char_p,
+    ctypes.c_double, ctypes.c_double, ctypes.c_int, ctypes.c_char_p,
+]
+lib.hvdtpu_heartbeat_misses.restype = ctypes.c_longlong
+"""
+
+
+@pytest.mark.integration
+def test_heartbeat_timeout_names_silent_peer():
+    """A coordinator that goes completely silent after the hello (socket
+    open, nothing sent — a hung process) must kill the worker's transport
+    at the heartbeat deadline, with the miss counted and the cause
+    spelled out, instead of blocking forever."""
+    _require_lib()
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    code = _CHILD_PRELUDE.format(lib=LIB) + f"""
+rc = lib.hvdtpu_init(1, 2, b"127.0.0.1", {port}, 20.0, 1 << 20, 16, b"",
+                     0.0, 0.0, 0, b"")
+if rc != 0:
+    sys.exit(2)
+deadline = time.time() + 30
+while time.time() < deadline:
+    if lib.hvdtpu_loop_dead():
+        misses = lib.hvdtpu_heartbeat_misses()
+        print("LOOP_DEAD misses=", misses, flush=True)
+        lib.hvdtpu_shutdown()
+        sys.exit(0 if misses >= 1 else 3)
+    time.sleep(0.05)
+print("STILL_ALIVE", flush=True)
+sys.exit(4)
+"""
+    env = os.environ.copy()
+    env.pop("HVD_TPU_SECRET", None)
+    env["HVD_TPU_HEARTBEAT_INTERVAL"] = "0.5"
+    env["HVD_TPU_HEARTBEAT_TIMEOUT"] = "2"
+    proc = subprocess.Popen([sys.executable, "-c", code], env=env,
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                            text=True)
+    try:
+        srv.settimeout(30)
+        conn = _accept_hello(srv)
+        # total silence: never read, never write — just hold the socket
+        out, err = proc.communicate(timeout=60)
+        assert proc.returncode == 0, (out, err)
+        assert "LOOP_DEAD" in out
+        assert "sent nothing (not even heartbeats)" in err
+        assert "peer rank 0" in err
+        conn.close()
+    finally:
+        srv.close()
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+
+@pytest.mark.integration
+def test_heartbeats_prevent_false_positive_on_busy_peer():
+    """A peer that produces no negotiation frames for longer than the
+    deadline but DOES heartbeat (the long-XLA-compile case) must not be
+    declared dead: each heartbeat re-arms the receive deadline."""
+    _require_lib()
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    code = _CHILD_PRELUDE.format(lib=LIB) + f"""
+rc = lib.hvdtpu_init(1, 2, b"127.0.0.1", {port}, 20.0, 1 << 20, 16, b"",
+                     0.0, 0.0, 0, b"")
+if rc != 0:
+    sys.exit(2)
+time.sleep(3.5)  # > HVD_TPU_HEARTBEAT_TIMEOUT of 1.5s
+alive = not lib.hvdtpu_loop_dead()
+print("ALIVE" if alive else "DEAD", flush=True)
+sys.stdout.flush()
+time.sleep(1.0)  # coordinator sends a real frame + closes -> loop ends
+lib.hvdtpu_shutdown()
+sys.exit(0 if alive else 3)
+"""
+    env = os.environ.copy()
+    env.pop("HVD_TPU_SECRET", None)
+    env["HVD_TPU_HEARTBEAT_INTERVAL"] = "0.5"
+    env["HVD_TPU_HEARTBEAT_TIMEOUT"] = "1.5"
+    proc = subprocess.Popen([sys.executable, "-c", code], env=env,
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                            text=True)
+    stop = threading.Event()
+
+    def coordinator(conn):
+        # heartbeats only — no negotiation frames — for well past the
+        # worker's deadline; then one real (empty) frame and EOF so the
+        # worker's loop unblocks and hvdtpu_shutdown can join it
+        t_end = time.time() + 4.0
+        while time.time() < t_end and not stop.is_set():
+            try:
+                conn.sendall(HB)
+            except OSError:
+                return
+            time.sleep(0.4)
+        try:
+            conn.sendall(struct.pack("<I", 0))
+            conn.close()
+        except OSError:
+            pass
+
+    try:
+        srv.settimeout(30)
+        conn = _accept_hello(srv)
+        t = threading.Thread(target=coordinator, args=(conn,), daemon=True)
+        t.start()
+        out, err = proc.communicate(timeout=60)
+        stop.set()
+        assert proc.returncode == 0, (out, err)
+        assert "ALIVE" in out
+    finally:
+        stop.set()
+        srv.close()
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+
+@pytest.mark.integration
+def test_stall_shutdown_surfaces_named_tensor_error():
+    """Drive a pending tensor past the warning AND shutdown thresholds
+    (the coordinator never acknowledges it) and assert FailAllPending
+    delivers the error — NAMING the stuck tensor — to the registered
+    exec callback, with the loop marked dead (previously only exercised
+    implicitly)."""
+    _require_lib()
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    code = _CHILD_PRELUDE.format(lib=LIB) + f"""
+EXEC_CB = ctypes.CFUNCTYPE(
+    None, ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+    ctypes.c_int, ctypes.c_double, ctypes.c_double,
+    ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+    ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int),
+    ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int),
+    ctypes.c_int, ctypes.c_char_p,
+)
+errors = []
+def on_exec(user, op, dtype, ps, root, pre, post, ids, n_ids,
+            sdims, sndims, exts, extlens, next_, error):
+    if error:
+        errors.append(error.decode() if isinstance(error, bytes) else error)
+cb = EXEC_CB(on_exec)
+lib.hvdtpu_set_exec_callback(cb, None)
+# warn at 0.3s, hard shutdown at 0.8s; heartbeats off for framing clarity
+rc = lib.hvdtpu_init(1, 2, b"127.0.0.1", {port}, 20.0, 1 << 20, 16, b"",
+                     0.3, 0.8, 0, b"")
+if rc != 0:
+    sys.exit(2)
+lib.hvdtpu_enqueue.restype = ctypes.c_longlong
+lib.hvdtpu_enqueue.argtypes = [
+    ctypes.c_longlong, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+    ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_int,
+    ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+    ctypes.c_double, ctypes.c_double,
+    ctypes.POINTER(ctypes.c_longlong), ctypes.c_int,
+]
+shape = (ctypes.c_longlong * 1)(4)
+rid = lib.hvdtpu_enqueue(7, b"stalled.grad", 0, 6, shape, 1, 0, b"", 0,
+                         0, 1.0, 1.0, None, 0)
+print("ENQ", rid, flush=True)
+deadline = time.time() + 30
+while time.time() < deadline:
+    if errors and lib.hvdtpu_loop_dead():
+        print("ERR:", errors[0], flush=True)
+        lib.hvdtpu_shutdown()
+        ok = ("stall shutdown" in errors[0]
+              and "stalled.grad" in errors[0])
+        sys.exit(0 if ok else 3)
+    time.sleep(0.05)
+print("NO_ERROR", flush=True)
+sys.exit(4)
+"""
+    env = os.environ.copy()
+    env.pop("HVD_TPU_SECRET", None)
+    env["HVD_TPU_HEARTBEAT_INTERVAL"] = "0"  # blocking reads: pure stall
+    proc = subprocess.Popen([sys.executable, "-c", code], env=env,
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                            text=True)
+    stop = threading.Event()
+
+    def coordinator(conn):
+        # acknowledge every cycle with an EMPTY response list: the
+        # worker's tensor is reported but never marked ready, so it ages
+        # past both stall thresholds while cycles keep completing
+        while not stop.is_set():
+            try:
+                _read_worker_frame(conn)
+                conn.sendall(struct.pack("<I", 0))
+            except (OSError, ConnectionError):
+                return
+
+    try:
+        srv.settimeout(30)
+        conn = _accept_hello(srv)
+        t = threading.Thread(target=coordinator, args=(conn,), daemon=True)
+        t.start()
+        out, err = proc.communicate(timeout=60)
+        stop.set()
+        assert proc.returncode == 0, (out, err)
+        assert "stall shutdown" in out and "stalled.grad" in out
+        # the warning fired on the way to the shutdown threshold
+        assert "possible stall" in err
+        conn.close()
+    finally:
+        stop.set()
+        srv.close()
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()

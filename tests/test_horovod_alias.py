@@ -14,6 +14,8 @@ import stat
 import subprocess
 import sys
 
+from envguards import requires_multiprocess_collectives
+
 import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -60,6 +62,7 @@ def test_alias_missing_backend_parity():
 
 
 @pytest.mark.integration
+@requires_multiprocess_collectives  # spawns an N-proc world running collectives
 def test_unmodified_reference_script_under_horovodrun(tmp_path):
     """The whole north-star sentence, literally: a console script named
     ``horovodrun`` (same entry point the wheel installs) launches the

@@ -12,6 +12,8 @@ import sys
 
 import pytest
 
+from envguards import requires_multiprocess_collectives
+
 import horovod_tpu.runner.launch as launch
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -87,6 +89,7 @@ def test_np_exceeding_slots_rejected(capsys):
 
 
 @pytest.mark.parametrize("np_", [2])
+@requires_multiprocess_collectives  # spawns an N-proc world running collectives
 def test_tpurun_multiprocess_collectives(np_):
     """The big one: np real processes, jax.distributed rendezvous, every
     eager collective checked cross-process (python fallback controller)."""
@@ -107,6 +110,7 @@ def test_tpurun_failure_propagates():
 
 
 @pytest.mark.parametrize("np_", [2, 3])
+@requires_multiprocess_collectives  # spawns an N-proc world running collectives
 def test_tpurun_multiprocess_native_controller(np_):
     """Same per-rank assertions with the C++ controller negotiating over
     its TCP star (reference analog: the gloo-controller path of
@@ -119,6 +123,7 @@ def test_tpurun_multiprocess_native_controller(np_):
 
 
 @pytest.mark.integration
+@requires_multiprocess_collectives  # spawns an N-proc world running collectives
 def test_tpurun_tensorflow_adapter():
     """TF/Keras adapter under 2 real processes: tf.Tensor bridge, graph
     mode, DistributedGradientTape averaging, Keras optimizer lockstep
@@ -134,6 +139,7 @@ def test_tpurun_tensorflow_adapter():
 
 
 @pytest.mark.integration
+@requires_multiprocess_collectives  # spawns an N-proc world running collectives
 def test_tpurun_keras_mnist_example():
     """The Keras example trains to high accuracy under 2 real processes —
     pins the full model.fit + DistributedOptimizer + callbacks path
@@ -149,6 +155,7 @@ def test_tpurun_keras_mnist_example():
 
 
 @pytest.mark.integration
+@requires_multiprocess_collectives  # spawns an N-proc world running collectives
 def test_tpurun_keras_elastic_example():
     """The elastic Keras example (reference:
     tensorflow2_keras_mnist_elastic.py) trains under 2 real processes:
@@ -164,6 +171,7 @@ def test_tpurun_keras_elastic_example():
 
 
 @pytest.mark.integration
+@requires_multiprocess_collectives  # spawns an N-proc world running collectives
 def test_tpurun_negotiation_stress():
     """Randomized mixed-collective schedule, submitted async in a
     DIFFERENT order on every rank with timing jitter (the cross-rank
@@ -178,6 +186,7 @@ def test_tpurun_negotiation_stress():
 
 
 @pytest.mark.integration
+@requires_multiprocess_collectives  # spawns an N-proc world running collectives
 def test_tpurun_negotiation_stress_np8_soak():
     """np=8 + a longer seeded schedule (120 ops, different seed): more
     ranks means more cross-rank submission-order divergence and more
@@ -199,6 +208,7 @@ def test_tpurun_negotiation_stress_np8_soak():
 
 
 @pytest.mark.integration
+@requires_multiprocess_collectives  # spawns an N-proc world running collectives
 def test_tpurun_elastic_pretrain_example():
     """The elastic LM-pretrain example (BASELINE's elastic-Llama-pretrain
     analog at toy scale) trains under 2 real processes: elastic
@@ -214,6 +224,7 @@ def test_tpurun_elastic_pretrain_example():
 
 
 @pytest.mark.integration
+@requires_multiprocess_collectives  # spawns an N-proc world running collectives
 def test_tpurun_pytorch_synthetic_example():
     """The torch synthetic benchmark example runs under 2 real processes
     (grad-hook DistributedOptimizer + state broadcasts end to end)."""
@@ -247,6 +258,7 @@ def test_jax_pipeline_example():
 
 
 @pytest.mark.integration
+@requires_multiprocess_collectives  # spawns an N-proc world running collectives
 def test_tpurun_mxnet_adapter():
     """MXNet adapter under 2 real processes (faked-mxnet NDArray storage,
     real cross-process collectives): in-place/grouped ops, default-op
@@ -260,6 +272,7 @@ def test_tpurun_mxnet_adapter():
 
 
 @pytest.mark.integration
+@requires_multiprocess_collectives  # spawns an N-proc world running collectives
 def test_tpurun_torch_adapter():
     """Torch adapter under 2 real processes: grouped ops, uneven
     alltoall, SyncBatchNorm global stats + gradient flow (reference

@@ -12,6 +12,8 @@ import os
 import numpy as np
 import pytest
 
+from envguards import requires_multiprocess_collectives
+
 from horovod_tpu.spark import LocalStore, Store
 from horovod_tpu.spark.keras import FlaxEstimator, KerasEstimator
 from horovod_tpu.spark.torch import TorchEstimator
@@ -137,6 +139,7 @@ def test_shard_reader_memory_contract():
 
 
 @pytest.mark.integration
+@requires_multiprocess_collectives  # estimator workers allreduce across processes
 def test_flax_estimator_fit_transform(tmp_path, monkeypatch):
     monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "")
     monkeypatch.setenv("JAX_PLATFORMS", "cpu")
@@ -183,6 +186,7 @@ def test_flax_estimator_fit_transform(tmp_path, monkeypatch):
 
 
 @pytest.mark.integration
+@requires_multiprocess_collectives  # estimator workers allreduce across processes
 def test_torch_estimator_fit_transform(tmp_path, monkeypatch):
     monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "")
     monkeypatch.setenv("JAX_PLATFORMS", "cpu")
@@ -216,6 +220,7 @@ def test_torch_estimator_fit_transform(tmp_path, monkeypatch):
 
 
 @pytest.mark.integration
+@requires_multiprocess_collectives  # estimator workers allreduce across processes
 def test_keras_estimator_fit_transform(tmp_path, monkeypatch):
     """Real-Keras estimator: a Keras 3 model trains across the worker
     fleet via the Keras adapter's DistributedOptimizer (reference:
@@ -256,6 +261,7 @@ def test_keras_estimator_fit_transform(tmp_path, monkeypatch):
 
 
 @pytest.mark.integration
+@requires_multiprocess_collectives  # estimator workers allreduce across processes
 def test_keras_estimator_deferred_build_model(tmp_path, monkeypatch):
     """A driver model with no Input spec ships no weights; workers must
     build against the data and broadcast rank 0's init instead of

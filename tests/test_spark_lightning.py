@@ -16,6 +16,8 @@ import sys
 import numpy as np
 import pytest
 
+from envguards import requires_multiprocess_collectives
+
 FAKES = os.path.join(os.path.dirname(__file__), "_fake_modules")
 
 
@@ -69,6 +71,7 @@ def test_resolve_configure_optimizers_shapes(lightning_env):
 
 
 @pytest.mark.integration
+@requires_multiprocess_collectives  # estimator workers allreduce across processes
 def test_lightning_estimator_fit_transform(tmp_path, lightning_env):
     from horovod_tpu.spark import LocalStore
     from horovod_tpu.spark.lightning import (

@@ -21,12 +21,16 @@ make CXX="$CXX" CXXFLAGS="$CXXFLAGS"
 
 SO="$(cd .. && pwd)/libhvd_tpu_core.so"
 echo "[rebuild_native] built $SO" >&2
-# sanity: every extern "C" symbol declared in c_api.cc must be exported
+# sanity: every extern "C" symbol declared in c_api.cc must be exported —
+# including the hvdtpu_chaos_* / heartbeat surface.  Snapshot the symbol
+# table ONCE: under pipefail, `nm | grep -q` flakes when grep's early
+# exit SIGPIPEs nm mid-write (false "missing" as the API surface grew).
+symtab="$(nm -D --defined-only "$SO")"
 missing=$(
   grep -oE '^(int|void|long long|double|const char\*) hvdtpu_[a-z_0-9]+' \
       c_api.cc | awk '{print $NF}' | sort -u |
   while read -r sym; do
-    nm -D --defined-only "$SO" | grep -q " $sym\$" || echo "$sym"
+    printf '%s\n' "$symtab" | grep -q " $sym\$" || echo "$sym"
   done
 )
 if [ -n "$missing" ]; then
