@@ -1,0 +1,116 @@
+"""Cross-layer contract checker (static analysis) for horovod_tpu.
+
+The framework spans four hand-synchronized layers — the C exports in
+``native/src/c_api.cc``, the ctypes bindings in
+``native/controller.py``, the metric catalogue in
+``metrics/instruments.py``, and the env-var / chaos-site / doc
+registries.  Drift between them is a *silent-crash* class: a wrong
+``argtypes`` corrupts the native stack at call time, an uncatalogued
+chaos site is a fault rule that never fires, an undocumented knob is a
+knob nobody finds.  This package checks all of it in milliseconds with
+four stdlib-only passes:
+
+====== =====================================================
+pass   contract
+====== =====================================================
+c-api  c_api.cc declarations == every ctypes restype/argtypes
+env    HVD_TPU_* reads == docs/running.md rows; no raw parses
+metrics code-built names ⊆ instruments.py ⊆ docs/METRICS.md
+chaos  point() sites == native Decide sites == doc site table
+====== =====================================================
+
+Run it::
+
+    python -m horovod_tpu.analysis          # from an installed tree
+    python tools/check.py                   # bare box, no jax needed
+
+Never imports the framework — safe (and fast) on a box with nothing
+but a Python interpreter.  See docs/ANALYSIS.md for the suppression
+syntax and the sanitizer build modes that ship alongside this suite.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from . import c_api, chaos_sites, envvars, metrics_catalogue
+from ._common import Finding, Suppressions
+
+__all__ = ["Finding", "PASSES", "run_all", "main"]
+
+PASSES: Dict[str, Callable[[str], List[Finding]]] = {
+    "c-api": c_api.run,
+    "env": envvars.run,
+    "metrics": metrics_catalogue.run,
+    "chaos": chaos_sites.run,
+}
+
+
+def run_all(root: str, checks: Optional[Sequence[str]] = None,
+            suppress: bool = True) -> List[Finding]:
+    """Run the selected passes (default: all) against ``root`` and
+    return the surviving findings, allowlists applied."""
+    selected = list(checks) if checks else list(PASSES)
+    unknown = [c for c in selected if c not in PASSES]
+    if unknown:
+        raise ValueError(f"unknown pass(es) {unknown}; have {list(PASSES)}")
+    findings: List[Finding] = []
+    for name in selected:
+        findings.extend(PASSES[name](root))
+    if not suppress:
+        return findings
+    sup = Suppressions(root)
+    out = sup.filter(findings)
+    out.extend(sup.extra_findings)
+    if not checks:  # stale-entry audit only makes sense on a full run
+        out.extend(sup.stale_entries())
+    return sorted(out, key=lambda f: (f.file, f.line, f.check, f.key))
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.analysis",
+        description="horovod_tpu cross-layer contract checker",
+    )
+    parser.add_argument("checks", nargs="*",
+                        help=f"passes to run (default all): {list(PASSES)}")
+    parser.add_argument("--root", default=None,
+                        help="repo root (default: derived from this file)")
+    parser.add_argument("--list-c-symbols", action="store_true",
+                        help="print the hvdtpu_* symbols declared in "
+                        "c_api.cc, one per line, and exit (consumed by "
+                        "tools/rebuild_native.sh)")
+    parser.add_argument("-q", "--quiet", action="store_true",
+                        help="findings only, no summary line")
+    args = parser.parse_args(argv)
+
+    root = args.root
+    if root is None:
+        import os
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+
+    if args.list_c_symbols:
+        for sym in c_api.declared_symbols(root):
+            print(sym)
+        return 0
+
+    t0 = time.perf_counter()
+    try:
+        findings = run_all(root, args.checks or None)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    for f in findings:
+        print(f.render())
+    if not args.quiet:
+        n = len(args.checks or PASSES)
+        dt = time.perf_counter() - t0
+        verdict = (f"{len(findings)} finding(s)" if findings
+                   else "all contracts hold")
+        print(f"horovod_tpu.analysis: {n} pass(es), {verdict} "
+              f"({dt * 1000:.0f} ms)", file=sys.stderr)
+    return 1 if findings else 0

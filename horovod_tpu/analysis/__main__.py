@@ -1,0 +1,13 @@
+"""``python -m horovod_tpu.analysis`` — run the contract checker.
+
+Note: the ``-m`` spelling imports the full ``horovod_tpu`` package (and
+therefore jax) before this module runs; on a bare box or in the CI lint
+job use ``python tools/check.py``, which loads the analysis package
+standalone in milliseconds.
+"""
+
+import sys
+
+from . import main
+
+sys.exit(main())

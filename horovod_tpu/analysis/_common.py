@@ -1,0 +1,215 @@
+"""Shared plumbing for the static-analysis passes.
+
+Everything in ``horovod_tpu.analysis`` is stdlib-only and never imports
+the framework (no jax, no ctypes loads) — the suite must run on a bare
+CI box in well under a second and must be loadable standalone by
+``tools/check.py`` without executing ``horovod_tpu/__init__``.
+
+Suppression model (docs/ANALYSIS.md):
+
+* inline — the offending line (or the line directly above it) carries a
+  ``contract-ok: <check> -- <justification>`` marker in a comment
+  (``#``, ``//`` or ``<!-- -->``).  The justification is REQUIRED: a
+  bare marker is itself reported, so nobody can wave a finding through
+  silently.
+* allowlist file — entries ``<check>:<key> -- <justification>`` in the
+  file named by ``[tool.horovod_tpu.analysis] allowlist`` in
+  pyproject.toml (default ``tools/analysis_allowlist.txt``).  Stale
+  entries (matching nothing) and entries without a justification are
+  reported too, so the list can only shrink back to honest.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Tuple
+
+#: Relative layout anchors every pass shares (synthetic trees in the
+#: self-tests recreate exactly these paths under a tmp root).
+C_API_CC = "horovod_tpu/native/src/c_api.cc"
+CONTROLLER_PY = "horovod_tpu/native/controller.py"
+PACKAGE_DIR = "horovod_tpu"
+NATIVE_SRC_DIR = "horovod_tpu/native/src"
+INSTRUMENTS_PY = "horovod_tpu/metrics/instruments.py"
+CHAOS_INIT_PY = "horovod_tpu/chaos/__init__.py"
+RUNNING_MD = "docs/running.md"
+METRICS_MD = "docs/METRICS.md"
+FAULT_MD = "docs/FAULT_TOLERANCE.md"
+#: ctypes harnesses cross-checked against the C API (beyond the
+#: production binding in CONTROLLER_PY).
+CTYPES_HARNESSES = (
+    "tests/test_control_auth.py",
+    "tests/test_fault_native.py",
+)
+DEFAULT_ALLOWLIST = "tools/analysis_allowlist.txt"
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One contract violation.  ``key`` is the stable handle suppression
+    matches on (env-var name, metric name, chaos site, C symbol)."""
+
+    check: str
+    file: str      # path relative to the analysis root
+    line: int      # 1-based; 0 when the finding is file-scoped
+    key: str
+    message: str
+
+    def render(self) -> str:
+        loc = f"{self.file}:{self.line}" if self.line else self.file
+        return f"{loc}: [{self.check}] {self.message}"
+
+
+_MARKER_RE = re.compile(
+    r"contract-ok:\s*(?P<check>[\w*-]+)\s*(?:--\s*(?P<why>.*?))?\s*(?:-->)?\s*$"
+)
+
+
+def read_text(path: str) -> Optional[str]:
+    try:
+        with open(path, encoding="utf-8", errors="replace") as f:
+            return f.read()
+    except OSError:
+        return None
+
+
+def iter_py_files(root: str, subdir: str = PACKAGE_DIR,
+                  exclude_dirs: Tuple[str, ...] = ("analysis",
+                                                   "__pycache__"),
+                  ) -> List[str]:
+    """Relative paths of the package's .py files, sorted for stable
+    output.  The analysis package itself is excluded — its regex source
+    would otherwise trip the very patterns it searches for."""
+    base = os.path.join(root, subdir)
+    out: List[str] = []
+    for dirpath, dirnames, filenames in os.walk(base):
+        dirnames[:] = [d for d in dirnames if d not in exclude_dirs]
+        for fn in filenames:
+            if fn.endswith(".py"):
+                out.append(os.path.relpath(os.path.join(dirpath, fn), root))
+    return sorted(out)
+
+
+def iter_native_files(root: str) -> List[str]:
+    base = os.path.join(root, NATIVE_SRC_DIR)
+    if not os.path.isdir(base):
+        return []
+    return sorted(
+        os.path.join(NATIVE_SRC_DIR, fn)
+        for fn in os.listdir(base)
+        if fn.endswith((".h", ".cc"))
+    )
+
+
+def strip_comment(line: str, kind: str) -> str:
+    """Drop the trailing comment of one source line (naive but
+    sufficient: the tokens these passes search for never legitimately
+    contain ``#`` / ``//``)."""
+    marker = "//" if kind == "c" else "#"
+    idx = line.find(marker)
+    return line if idx < 0 else line[:idx]
+
+
+class Suppressions:
+    """Inline markers + the allowlist file, resolved per run."""
+
+    def __init__(self, root: str):
+        self.root = root
+        self._inline_cache: Dict[str, List[str]] = {}
+        self.extra_findings: List[Finding] = []
+        self._allow: Dict[Tuple[str, str], str] = {}
+        self._used: set = set()
+        self._allow_path = self._resolve_allowlist_path()
+        self._load_allowlist()
+
+    # -- allowlist file ------------------------------------------------------
+
+    def _resolve_allowlist_path(self) -> str:
+        """``[tool.horovod_tpu.analysis] allowlist = "..."`` from
+        pyproject.toml (regex scan — py3.10 has no tomllib)."""
+        text = read_text(os.path.join(self.root, "pyproject.toml")) or ""
+        in_section = False
+        for line in text.splitlines():
+            stripped = line.strip()
+            if stripped.startswith("["):
+                in_section = stripped == "[tool.horovod_tpu.analysis]"
+                continue
+            if in_section:
+                m = re.match(r'allowlist\s*=\s*"([^"]+)"', stripped)
+                if m:
+                    return m.group(1)
+        return DEFAULT_ALLOWLIST
+
+    def _load_allowlist(self) -> None:
+        text = read_text(os.path.join(self.root, self._allow_path))
+        if text is None:
+            return
+        for lineno, raw in enumerate(text.splitlines(), 1):
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            m = re.match(r"([\w-]+):(\S+)\s+--\s+(.+)$", line)
+            if not m:
+                self.extra_findings.append(Finding(
+                    "allowlist", self._allow_path, lineno, line,
+                    "malformed allowlist entry (want "
+                    "'<check>:<key> -- <justification>'): " + line,
+                ))
+                continue
+            self._allow[(m.group(1), m.group(2))] = m.group(3)
+
+    # -- inline markers ------------------------------------------------------
+
+    def _lines(self, relfile: str) -> List[str]:
+        if relfile not in self._inline_cache:
+            text = read_text(os.path.join(self.root, relfile)) or ""
+            self._inline_cache[relfile] = text.splitlines()
+        return self._inline_cache[relfile]
+
+    def _inline_marker(self, f: Finding) -> Optional[Tuple[str, str, int]]:
+        """(check, justification, lineno) of a marker on the finding's
+        line or the line above it."""
+        lines = self._lines(f.file)
+        for lineno in (f.line, f.line - 1):
+            if 1 <= lineno <= len(lines):
+                m = _MARKER_RE.search(lines[lineno - 1])
+                if m:
+                    return m.group("check"), (m.group("why") or "").strip(), \
+                        lineno
+        return None
+
+    # -- resolution ----------------------------------------------------------
+
+    def filter(self, findings: Iterable[Finding]) -> List[Finding]:
+        out: List[Finding] = []
+        for f in findings:
+            entry = self._allow.get((f.check, f.key))
+            if entry is not None:
+                self._used.add((f.check, f.key))
+                continue
+            marker = self._inline_marker(f)
+            if marker is not None and marker[0] in (f.check, "*"):
+                why, lineno = marker[1], marker[2]
+                if not why:
+                    out.append(Finding(
+                        "allowlist", f.file, lineno, f.key,
+                        f"contract-ok marker for [{f.check}] has no "
+                        "justification (write 'contract-ok: "
+                        f"{f.check} -- <why>')",
+                    ))
+                continue
+            out.append(f)
+        return out
+
+    def stale_entries(self) -> List[Finding]:
+        out = []
+        for (check, key), why in sorted(self._allow.items()):
+            if (check, key) not in self._used:
+                out.append(Finding(
+                    "allowlist", self._allow_path, 0, f"{check}:{key}",
+                    f"stale allowlist entry {check}:{key} (nothing "
+                    "matches it any more — delete the line)",
+                ))
+        return out

@@ -1,0 +1,310 @@
+"""C-API contract checker.
+
+Parses the ``extern "C"`` function definitions in
+``native/src/c_api.cc`` (name, arity, argument/return C types) and
+cross-checks every ctypes ``restype``/``argtypes`` declaration in the
+production binding (``native/controller.py``) and the ctypes test
+harnesses.  Drift here is the silent-crash class this suite exists for:
+a wrong ``argtypes`` list does not fail at import — ctypes happily
+marshals garbage and corrupts the native stack at call time.
+
+Rules:
+
+* a binding to a symbol c_api.cc does not declare is an error (the
+  load would AttributeError — or worse, hit a stale committed binary);
+* ``argtypes`` arity must equal the C declaration's arity, and each
+  position must map to the C parameter type;
+* ``restype`` must map to the C return type;
+* setting ``restype`` without ``argtypes`` is an error even for
+  zero-argument functions — a bare binding accepts (and silently
+  discards) arbitrary arguments, so arity drift goes unnoticed;
+* in ``native/controller.py`` additionally: every declared C function
+  must be bound (completeness — an unbound export is dead API).
+
+``tools/rebuild_native.sh`` reuses :func:`declared_symbols` for its nm
+export check, so the symbol list lives in exactly one parser.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, NamedTuple, Tuple
+
+from ._common import (
+    C_API_CC, CONTROLLER_PY, CTYPES_HARNESSES, Finding, read_text,
+)
+
+CHECK = "c-api"
+
+
+class CFunc(NamedTuple):
+    name: str
+    ret: str            # normalized C return type
+    args: Tuple[str, ...]  # normalized C parameter types
+    line: int
+
+
+_DEF_RE = re.compile(
+    r"^(int|void|long long|double|unsigned long long|const char\s*\*)\s+"
+    r"(hvdtpu_[a-z0-9_]+)\s*\(",
+    re.MULTILINE,
+)
+
+
+def _normalize_ctype(raw: str) -> str:
+    """``const  char *coord_host`` -> ``const char*`` (drop the
+    parameter name, collapse whitespace, glue ``*`` to the type)."""
+    s = raw.strip()
+    if "(*" in s:
+        return "funcptr"
+    # drop a trailing identifier (the parameter name) if present
+    m = re.match(r"^(.*?[\s*])([A-Za-z_]\w*)\s*$", s)
+    if m and not m.group(1).strip() == "":
+        s = m.group(1)
+    s = re.sub(r"\s+", " ", s).strip()
+    s = re.sub(r"\s*\*", "*", s)
+    return s
+
+
+def _split_top_level(argstr: str) -> List[str]:
+    parts, depth, cur = [], 0, []
+    for ch in argstr:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    if "".join(cur).strip():
+        parts.append("".join(cur))
+    return parts
+
+
+def parse_c_api(text: str) -> Dict[str, CFunc]:
+    """Every ``hvdtpu_*`` function defined at column 0 in c_api.cc."""
+    funcs: Dict[str, CFunc] = {}
+    for m in _DEF_RE.finditer(text):
+        ret = re.sub(r"\s*\*", "*", re.sub(r"\s+", " ", m.group(1))).strip()
+        name = m.group(2)
+        # scan the balanced parameter list starting at the open paren
+        i = m.end() - 1
+        depth, j = 0, i
+        while j < len(text):
+            if text[j] == "(":
+                depth += 1
+            elif text[j] == ")":
+                depth -= 1
+                if depth == 0:
+                    break
+            j += 1
+        argstr = text[i + 1:j]
+        parts = _split_top_level(argstr)
+        if len(parts) == 1 and parts[0].strip() in ("", "void"):
+            parts = []
+        args = tuple(_normalize_ctype(p) for p in parts)
+        line = text.count("\n", 0, m.start()) + 1
+        funcs[name] = CFunc(name, ret, args, line)
+    return funcs
+
+
+def declared_symbols(root: str) -> List[str]:
+    """Sorted hvdtpu_* symbol names declared in c_api.cc — the one
+    source of truth rebuild_native.sh and the .so export checks use."""
+    text = read_text(os.path.join(root, C_API_CC))
+    if text is None:
+        raise FileNotFoundError(os.path.join(root, C_API_CC))
+    return sorted(parse_c_api(text))
+
+
+# -- C type -> acceptable ctypes spellings ------------------------------------
+
+ARG_ACCEPT: Dict[str, Tuple[str, ...]] = {
+    "int": ("c_int",),
+    "long long": ("c_longlong",),
+    "unsigned long long": ("c_ulonglong",),
+    "double": ("c_double",),
+    "const char*": ("c_char_p",),
+    # writable byte buffer: c_void_p is the established binding (numpy
+    # .ctypes.data pointers), c_char_p would be immutable-leaning
+    "char*": ("c_void_p", "c_char_p"),
+    "void*": ("c_void_p",),
+    "const void**": ("POINTER(c_void_p)",),
+    "const int*": ("POINTER(c_int)",),
+    "const long long*": ("POINTER(c_longlong)",),
+    "const int64_t*": ("POINTER(c_int64)", "POINTER(c_longlong)"),
+    "const char* const*": ("POINTER(c_char_p)",),
+}
+
+RET_ACCEPT: Dict[str, Tuple[str, ...]] = {
+    "int": ("c_int",),
+    "void": ("None",),
+    "long long": ("c_longlong",),
+    "double": ("c_double",),
+    "unsigned long long": ("c_ulonglong",),
+    "const char*": ("c_char_p",),
+}
+
+_IDENT_RE = re.compile(r"^[A-Za-z_]\w*$")
+
+
+def _norm_py(token: str) -> str:
+    return token.replace("ctypes.", "").replace(" ", "").replace("\n", "")
+
+
+def _arg_ok(ctype: str, py: str) -> bool:
+    # a function-pointer parameter is bound through a module-level
+    # CFUNCTYPE object whose name we cannot resolve textually — accept
+    # any plain identifier that is not a primitive ctypes spelling
+    if ctype == "funcptr":
+        return bool(_IDENT_RE.match(py)) and not py.startswith("c_")
+    accept = ARG_ACCEPT.get(ctype)
+    if accept is None:
+        return False  # unknown C type: surfaced by the caller
+    return py in accept
+
+
+class Binding(NamedTuple):
+    symbol: str
+    # EVERY occurrence is kept and checked: the harnesses declare the
+    # same symbol once per embedded ``python -c`` blob, and a
+    # last-occurrence-wins scan would let drift in all but the final
+    # blob ship silently
+    restypes: List[Tuple[str, int]]        # (normalized value, line)
+    argtypes: List[Tuple[List[str], int]]  # (normalized items, line)
+
+
+_RESTYPE_RE = re.compile(r"\.(hvdtpu_[a-z0-9_]+)\.restype\s*=\s*([^\n#]+)")
+_ARGTYPES_RE = re.compile(
+    r"\.(hvdtpu_[a-z0-9_]+)\.argtypes\s*=\s*(\[[^\]]*\])", re.DOTALL
+)
+
+
+def scan_bindings(text: str) -> Dict[str, Binding]:
+    """All ``<x>.hvdtpu_*.restype/argtypes`` assignments in one Python
+    source file — including ones inside string-literal child programs
+    (the ctypes harnesses embed their declarations in ``python -c``
+    blobs), which is exactly why this is a textual scan, not an AST
+    walk."""
+    res: Dict[str, List[Tuple[str, int]]] = {}
+    args: Dict[str, List[Tuple[List[str], int]]] = {}
+    for m in _RESTYPE_RE.finditer(text):
+        line = text.count("\n", 0, m.start()) + 1
+        res.setdefault(m.group(1), []).append(
+            (_norm_py(m.group(2).strip()), line))
+    for m in _ARGTYPES_RE.finditer(text):
+        line = text.count("\n", 0, m.start()) + 1
+        inner = m.group(2)[1:-1]
+        items = [_norm_py(p) for p in _split_top_level(inner)
+                 if p.strip()]
+        args.setdefault(m.group(1), []).append((items, line))
+    return {
+        sym: Binding(sym, res.get(sym, []), args.get(sym, []))
+        for sym in sorted(set(res) | set(args))
+    }
+
+
+def _check_file(relfile: str, text: str, funcs: Dict[str, CFunc],
+                require_complete: bool) -> List[Finding]:
+    findings: List[Finding] = []
+    bindings = scan_bindings(text)
+    for sym, b in bindings.items():
+        decl = funcs.get(sym)
+        first_line = min(
+            [ln for _, ln in b.restypes] + [ln for _, ln in b.argtypes])
+        if decl is None:
+            findings.append(Finding(
+                CHECK, relfile, first_line, sym,
+                f"ctypes binding to {sym} but c_api.cc declares no such "
+                "function (stale binding or missing export)",
+            ))
+            continue
+        for restype, line in b.restypes:
+            accept = RET_ACCEPT.get(decl.ret, ())
+            if restype not in accept:
+                findings.append(Finding(
+                    CHECK, relfile, line, sym,
+                    f"{sym}.restype is {restype} but c_api.cc returns "
+                    f"'{decl.ret}' (want one of {list(accept)})",
+                ))
+        if len(b.argtypes) < max(len(b.restypes), 1):
+            findings.append(Finding(
+                CHECK, relfile, b.restypes[0][1] if b.restypes
+                else first_line, sym,
+                f"{sym} is declared {max(len(b.restypes), 1)} time(s) "
+                f"but carries only {len(b.argtypes)} argtypes "
+                "declaration(s) — a bare binding accepts arbitrary "
+                f"arguments; declare argtypes = "
+                f"{'[]' if not decl.args else '[...]'} matching "
+                f"c_api.cc:{decl.line} at every declaration site",
+            ))
+        if not b.restypes and b.argtypes and decl.ret != "int":
+            # ctypes defaults a missing restype to c_int: fine for int
+            # returns, silent truncation/garbage for anything else
+            findings.append(Finding(
+                CHECK, relfile, b.argtypes[0][1], sym,
+                f"{sym} has argtypes but no restype; c_api.cc:"
+                f"{decl.line} returns '{decl.ret}' and ctypes would "
+                "default to c_int (truncated/garbage values)",
+            ))
+        for argtypes, line in b.argtypes:
+            if len(argtypes) != len(decl.args):
+                findings.append(Finding(
+                    CHECK, relfile, line, sym,
+                    f"{sym}.argtypes has {len(argtypes)} entries but "
+                    f"c_api.cc:{decl.line} declares {len(decl.args)} "
+                    "parameters (arity drift corrupts the call stack)",
+                ))
+                continue
+            for i, (ctype, py) in enumerate(zip(decl.args, argtypes)):
+                if ctype not in ARG_ACCEPT and ctype != "funcptr":
+                    findings.append(Finding(
+                        CHECK, relfile, line, sym,
+                        f"{sym} parameter {i}: C type '{ctype}' is not "
+                        "in the checker's type map (extend ARG_ACCEPT "
+                        "in horovod_tpu/analysis/c_api.py)",
+                    ))
+                elif not _arg_ok(ctype, py):
+                    findings.append(Finding(
+                        CHECK, relfile, line, sym,
+                        f"{sym}.argtypes[{i}] is {py} but c_api.cc:"
+                        f"{decl.line} declares '{ctype}'",
+                    ))
+    if require_complete:
+        for sym, decl in sorted(funcs.items()):
+            if sym not in bindings:
+                findings.append(Finding(
+                    CHECK, relfile, 0, sym,
+                    f"c_api.cc:{decl.line} exports {sym} but "
+                    f"{relfile} never declares restype/argtypes for it",
+                ))
+    return findings
+
+
+def run(root: str) -> List[Finding]:
+    c_text = read_text(os.path.join(root, C_API_CC))
+    if c_text is None:
+        return [Finding(CHECK, C_API_CC, 0, "missing",
+                        "c_api.cc not found — cannot check the contract")]
+    funcs = parse_c_api(c_text)
+    if not funcs:
+        return [Finding(CHECK, C_API_CC, 0, "empty",
+                        "no extern \"C\" hvdtpu_* definitions parsed from "
+                        "c_api.cc (parser/style drift?)")]
+    findings: List[Finding] = []
+    ctrl = read_text(os.path.join(root, CONTROLLER_PY))
+    if ctrl is None:
+        findings.append(Finding(CHECK, CONTROLLER_PY, 0, "missing",
+                                "native/controller.py not found"))
+    else:
+        findings += _check_file(CONTROLLER_PY, ctrl, funcs,
+                                require_complete=True)
+    for rel in CTYPES_HARNESSES:
+        text = read_text(os.path.join(root, rel))
+        if text is not None:
+            findings += _check_file(rel, text, funcs,
+                                    require_complete=False)
+    return findings

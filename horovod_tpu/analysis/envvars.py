@@ -1,0 +1,171 @@
+"""Env-var registry lint.
+
+Every ``HVD_TPU_*`` variable the package reads must have a row in
+``docs/running.md``; every documented row must correspond to a live
+read (doc rot is drift too); and numeric parses must go through the
+validated ``env_*`` helpers — a raw ``int(os.environ[...])`` turns a
+typo'd knob into a process-killing ValueError at boot instead of a
+warning + default.
+
+Read detection covers the package's actual spellings:
+
+* direct reads: ``os.environ.get/pop/[...]``, ``os.getenv``;
+* the validated helpers: ``env_int(...)``, ``env_float(...)``;
+* name constants: a module-level ``SOME_ENV = "HVD_TPU_X"`` (the
+  constant exists to be read through);
+* ``utils/env_parser.py``'s prefixing ``_get*("NAME")`` calls
+  (resolved to ``HVD_TPU_NAME``);
+* native reads: ``getenv("HVD_TPU_...")`` in ``native/src``.
+
+Launcher *writes* (``env["HVD_TPU_X"] = ...``) are not reads and are
+not required to be documented individually; docs/running.md's
+worker-side list covers them, including the documented
+``HVD_TPU_ELASTIC_*`` wildcard family.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Set, Tuple
+
+from ._common import (
+    Finding, RUNNING_MD, iter_native_files, iter_py_files, read_text,
+    strip_comment,
+)
+
+CHECK = "env"
+ENV_PARSER_PY = "horovod_tpu/utils/env_parser.py"
+
+_READ_RES = (
+    re.compile(r"os\.environ\.get\(\s*\"(HVD_TPU_\w+)\""),
+    re.compile(r"os\.environ\.pop\(\s*\"(HVD_TPU_\w+)\""),
+    re.compile(r"os\.getenv\(\s*\"(HVD_TPU_\w+)\""),
+    re.compile(r"os\.environ\[\s*\"(HVD_TPU_\w+)\"\s*\](?!\s*=[^=])"),
+    re.compile(r"env_(?:int|float|str|bool)\(\s*\"(HVD_TPU_\w+)\""),
+    # keyword hand-off to a validated reader (metrics exposition)
+    re.compile(r"env_var\s*=\s*\"(HVD_TPU_\w+)\""),
+    # a name constant holding the variable (read through elsewhere)
+    re.compile(r"^\s*[A-Za-z_]\w*\s*=\s*\"(HVD_TPU_\w+)\"\s*$",
+               re.MULTILINE),
+)
+# std::getenv plus the validated native helpers (EnvSeconds & friends)
+_NATIVE_READ_RE = re.compile(r"(?:getenv|Env\w*)\(\s*\"(HVD_TPU_\w+)\"")
+_ENV_PARSER_GET_RE = re.compile(r"_get(?:_int|_float|_bool)?\(\s*\"(\w+)\"")
+_RAW_PARSE_RE = re.compile(r"\b(?:int|float)\s*\(\s*os\.(?:environ|getenv)")
+_CONST_DEF_RE = re.compile(r"^\s*([A-Za-z_]\w*)\s*=\s*\"(HVD_TPU_\w+)\"\s*$")
+_DOC_TOKEN_RE = re.compile(r"(HVD_TPU_[A-Z0-9_]+)(\*)?")
+
+
+def _strip_comments(text: str, kind: str) -> str:
+    """Comment-stripped text with line numbers preserved, so reads that
+    wrap across lines (black-style call breaks) still match."""
+    return "\n".join(strip_comment(ln, kind) for ln in text.splitlines())
+
+
+def _lineno(text: str, pos: int) -> int:
+    return text.count("\n", 0, pos) + 1
+
+
+def _scan_python(relfile: str, text: str,
+                 reads: Dict[str, List[Tuple[str, int]]],
+                 findings: List[Finding]) -> None:
+    # normalize quote style so single-quoted reads match the patterns
+    clean = _strip_comments(text, "py").replace("'", '"')
+    consts: Dict[str, str] = {
+        m.group(1): m.group(2)
+        for m in re.finditer(_CONST_DEF_RE.pattern, clean, re.MULTILINE)
+    }
+    for rx in _READ_RES:
+        for m in rx.finditer(clean):
+            reads.setdefault(m.group(1), []).append(
+                (relfile, _lineno(clean, m.start())))
+    if relfile.replace(os.sep, "/") == ENV_PARSER_PY:
+        for m in _ENV_PARSER_GET_RE.finditer(clean):
+            reads.setdefault("HVD_TPU_" + m.group(1), []).append(
+                (relfile, _lineno(clean, m.start())))
+    for m in _RAW_PARSE_RE.finditer(clean):
+        lineno = _lineno(clean, m.start())
+        # name the variable when the call shows it (literal or a known
+        # constant) so the allowlist key is stable
+        context = clean[m.start():m.start() + 200]
+        key = "raw"
+        lit = re.search(r"\"(HVD_TPU_\w+)\"", context)
+        if lit:
+            key = lit.group(1)
+        else:
+            for name, value in consts.items():
+                if re.search(rf"\b{re.escape(name)}\b", context):
+                    key = value
+                    break
+        findings.append(Finding(
+            CHECK, relfile, lineno, key,
+            "raw numeric parse of an environment variable "
+            f"({context.splitlines()[0].strip()[:60]}…) — use the "
+            "validated env_int/env_float helpers "
+            "(horovod_tpu.common.retry) so a garbled value warns and "
+            "defaults instead of killing the process",
+        ))
+
+
+def _documented(root: str) -> Tuple[Set[str], List[str], str]:
+    """(exact tokens, wildcard prefixes) mentioned in docs/running.md."""
+    text = read_text(os.path.join(root, RUNNING_MD))
+    if text is None:
+        return set(), [], ""
+    exact: Set[str] = set()
+    wild: List[str] = []
+    for m in _DOC_TOKEN_RE.finditer(text):
+        if m.group(2):  # HVD_TPU_FOO_* family
+            wild.append(m.group(1))
+        else:
+            exact.add(m.group(1))
+    return exact, wild, text
+
+
+def run(root: str) -> List[Finding]:
+    findings: List[Finding] = []
+    reads: Dict[str, List[Tuple[str, int]]] = {}
+    for rel in iter_py_files(root):
+        text = read_text(os.path.join(root, rel))
+        if text is not None:
+            _scan_python(rel, text, reads, findings)
+    for rel in iter_native_files(root):
+        text = read_text(os.path.join(root, rel))
+        if text is None:
+            continue
+        clean = _strip_comments(text, "c")
+        for m in _NATIVE_READ_RE.finditer(clean):
+            reads.setdefault(m.group(1), []).append(
+                (rel, _lineno(clean, m.start())))
+
+    exact, wild, doc_text = _documented(root)
+    if not doc_text:
+        findings.append(Finding(CHECK, RUNNING_MD, 0, "missing",
+                                "docs/running.md not found — the env-var "
+                                "registry has no documentation side"))
+        return findings
+
+    for var, sites in sorted(reads.items()):
+        if var in exact or any(var.startswith(w) for w in wild):
+            continue
+        relfile, lineno = sites[0]
+        findings.append(Finding(
+            CHECK, relfile, lineno, var,
+            f"{var} is read here but has no row in docs/running.md "
+            "(every knob must be documented)",
+        ))
+
+    doc_lines = doc_text.splitlines()
+    for var in sorted(exact):
+        if var in reads:
+            continue
+        lineno = next((i for i, ln in enumerate(doc_lines, 1)
+                       if var in ln), 0)
+        findings.append(Finding(
+            CHECK, RUNNING_MD, lineno, var,
+            f"docs/running.md documents {var} but nothing in the "
+            "package reads it (stale row, or the read uses an "
+            "unrecognized spelling)",
+        ))
+    return findings

@@ -138,8 +138,10 @@ def install_from_env(rank: int = 0) -> bool:
     plan for this process (called from ``hvd.init()``).  Returns whether
     any rule is active here."""
     global _log_path
+    from ..common.retry import env_int
+
     spec = os.environ.get(ENV_SPEC, "")
-    seed = int(os.environ.get(ENV_SEED, "0") or "0")
+    seed = env_int(ENV_SEED, 0)
     _log_path = os.environ.get(ENV_LOG) or None
     configure(spec, seed=seed, rank=rank)
     return active

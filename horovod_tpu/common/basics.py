@@ -26,6 +26,7 @@ import jax
 from ..utils.env_parser import Config
 from ..utils.logging import get_logger
 from . import topology as _topology
+from .retry import env_float, env_int
 from .exceptions import NotInitializedError
 from .process_sets import ProcessSetRegistry, global_process_set
 from .topology import Topology
@@ -73,7 +74,11 @@ def _maybe_init_distributed() -> None:
 
     if getattr(_jax_distributed.global_state, "client", None) is not None:
         return  # coordination service already joined (runtime or prior init)
+    # launcher-set world shape: a garbled value must fail loudly here —
+    # a silent default would desynchronize the fleet
+    # contract-ok: env -- launcher-set; garbage must crash, not default
     num = int(os.environ["HVD_TPU_NUM_PROCESSES"])
+    # contract-ok: env -- launcher-set; garbage must crash, not default
     pid = int(os.environ["HVD_TPU_PROCESS_ID"])
     if num <= 1:
         return
@@ -85,20 +90,18 @@ def _maybe_init_distributed() -> None:
     # full-suite run tripped the default while a peer compiled the TF
     # bridge).  The launcher also pre-builds the TF bridge before
     # fan-out, attacking the same failure from the other side.
-    boot_timeout = os.environ.get("HVD_TPU_BOOT_TIMEOUT")
-    if boot_timeout:
-        kwargs["initialization_timeout"] = int(float(boot_timeout))
+    boot_timeout = env_float("HVD_TPU_BOOT_TIMEOUT", 0.0)
+    if boot_timeout > 0:
+        kwargs["initialization_timeout"] = int(boot_timeout)
     if os.environ.get("HVD_TPU_ELASTIC") in ("1", "true"):
         # elastic mode: fail fast instead of blocking on dead peers — the
         # shutdown barrier must give up well before the heartbeat watchdog
         # would kill the surviving process (reference analog: NCCL abort
         # timeouts in the elastic error path, SURVEY.md §5.3)
-        kwargs["heartbeat_timeout_seconds"] = int(
-            os.environ.get("HVD_TPU_HEARTBEAT_TIMEOUT", "30")
-        )
-        kwargs["shutdown_timeout_seconds"] = int(
-            os.environ.get("HVD_TPU_SHUTDOWN_TIMEOUT", "8")
-        )
+        kwargs["heartbeat_timeout_seconds"] = env_int(
+            "HVD_TPU_HEARTBEAT_TIMEOUT", 30)
+        kwargs["shutdown_timeout_seconds"] = env_int(
+            "HVD_TPU_SHUTDOWN_TIMEOUT", 8)
     # older jax (< 0.5) lacks the heartbeat/shutdown timeout knobs on
     # initialize(); passing them would TypeError and kill every elastic
     # worker at boot — drop what this jax can't take and say so (the

@@ -29,15 +29,16 @@ from typing import Callable, Optional, Tuple, Type, TypeVar
 from ..metrics import instruments as _metrics
 from ..utils.logging import get_logger
 
-__all__ = ["retry_call", "env_float"]
+__all__ = ["retry_call", "env_float", "env_int"]
 
 T = TypeVar("T")
 
 
 def env_float(name: str, default: float) -> float:
-    """``float(os.environ[name])`` with a fall-through default — the
-    spelling every env-tunable timeout in the fault-tolerance path uses
-    (a garbled value falls back rather than killing the process)."""
+    """Validated float read of the environment variable ``name`` with a
+    fall-through default — the spelling every env-tunable number in the
+    package uses (a garbled value warns and falls back rather than
+    killing the process; ``tools/check.py`` enforces the convention)."""
     import os
 
     raw = os.environ.get(name)
@@ -47,6 +48,21 @@ def env_float(name: str, default: float) -> float:
         return float(raw)
     except ValueError:
         get_logger().warning("%s=%r is not a number; using %s",
+                             name, raw, default)
+        return default
+
+
+def env_int(name: str, default: int) -> int:
+    """Validated integer read of ``name`` (see :func:`env_float`)."""
+    import os
+
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        get_logger().warning("%s=%r is not an integer; using %s",
                              name, raw, default)
         return default
 

@@ -32,7 +32,7 @@ from typing import Optional
 
 from ..common import wire_auth
 from ..common.exceptions import HorovodInternalError, HostsUpdatedInterrupt
-from ..common.retry import env_float, retry_call
+from ..common.retry import env_float, env_int, retry_call
 from ..metrics import instruments as _metrics
 from ..metrics.exposition import register_health_source
 from ..utils.logging import get_logger
@@ -95,9 +95,7 @@ def _connect_driver(site: str, budget: float) -> socket.socket:
 # default leaves legitimate >10 s non-collective phases (eval, checkpoint
 # writes) a margin; raise it if such phases run longer, keeping it below
 # the heartbeat deadline.
-_FAILURE_GRACE = float(
-    os.environ.get("HVD_TPU_ELASTIC_FAILURE_GRACE_SECONDS", "10.0")
-)
+_FAILURE_GRACE = env_float("HVD_TPU_ELASTIC_FAILURE_GRACE_SECONDS", 10.0)
 
 # When the watchdog fires on a PLANNED membership change (failure=False),
 # the keep-state contract says live progress must survive.  The watchdog
@@ -105,9 +103,8 @@ _FAILURE_GRACE = float(
 # itself blocks (the main thread really is wedged in a collective the
 # change killed, and the snapshot needs that device) does it fall back to
 # the last committed snapshot.
-_PLANNED_SNAPSHOT_TIMEOUT = float(
-    os.environ.get("HVD_TPU_ELASTIC_PLANNED_SNAPSHOT_SECONDS", "30.0")
-)
+_PLANNED_SNAPSHOT_TIMEOUT = env_float(
+    "HVD_TPU_ELASTIC_PLANNED_SNAPSHOT_SECONDS", 30.0)
 
 
 def elastic_enabled() -> bool:
@@ -120,6 +117,7 @@ def _driver_addr() -> tuple:
 
 
 def _worker_id() -> int:
+    # contract-ok: env -- driver-assigned identity; garbage must crash
     return int(os.environ[ENV_WORKER_ID])
 
 
@@ -311,7 +309,7 @@ class WorkerNotificationManager:
         return not failure, {
             "pending_epoch": pending,
             "pending_failure": failure,
-            "worker_id": int(os.environ.get(ENV_WORKER_ID, -1)),
+            "worker_id": env_int(ENV_WORKER_ID, -1),
         }
 
     def report_failing(self, reason: str) -> None:
@@ -670,7 +668,7 @@ def _persist_and_exec(snap) -> None:
     except Exception:
         hosts_service = False
     if hosts_service:
-        grace = float(os.environ.get("HVD_TPU_ELASTIC_LEADER_GRACE", "2"))
+        grace = env_float("HVD_TPU_ELASTIC_LEADER_GRACE", 2.0)
         if grace > 0:
             get_logger().info(
                 "elastic: hosting the coordination service — delaying "
@@ -704,10 +702,7 @@ def _persist_and_exec(snap) -> None:
     # marked even with no snapshot: the post-boot wrapper must still fire
     # the user's reset callbacks (the restart IS the reset)
     os.environ[ENV_RESTARTED] = "1"
-    try:
-        count = int(os.environ.get(ENV_RESTART_COUNT, "0"))
-    except ValueError:
-        count = 0
+    count = env_int(ENV_RESTART_COUNT, 0)
     os.environ[ENV_RESTART_COUNT] = str(count + 1)
     for k in _ASSIGNMENT_ENV:
         os.environ.pop(k, None)
@@ -730,8 +725,10 @@ def maybe_restore_after_restart(state) -> None:
 
     restarted = os.environ.pop(ENV_RESTARTED, None) is not None
     t_exec = os.environ.pop(ENV_T_EXEC, None)
-    persist_s = float(os.environ.pop(ENV_T_PERSIST, 0) or 0)
-    snap_bytes = int(os.environ.pop(ENV_SNAP_BYTES, 0) or 0)
+    persist_s = env_float(ENV_T_PERSIST, 0.0)
+    snap_bytes = env_int(ENV_SNAP_BYTES, 0)
+    os.environ.pop(ENV_T_PERSIST, None)
+    os.environ.pop(ENV_SNAP_BYTES, None)
     # reboot = execv → wrapper entry: interpreter + jax import, boot
     # rendezvous, hvd.init against the new world
     reboot_s = (time.time() - float(t_exec)) if t_exec else 0.0
@@ -787,10 +784,7 @@ def maybe_restore_after_restart(state) -> None:
         # restore the CUMULATIVE restart count: execv replaced the process
         # image (and with it the fresh registry's zero), the env carried
         # the true total across the boundary
-        try:
-            total_restarts = int(os.environ.get(ENV_RESTART_COUNT, "1"))
-        except ValueError:
-            total_restarts = 1
+        total_restarts = env_int(ENV_RESTART_COUNT, 1)
         already = _metrics.ELASTIC_RESTARTS.get()
         if total_restarts > already:
             _metrics.ELASTIC_RESTARTS.inc(total_restarts - already)

@@ -106,11 +106,7 @@ class TelemetryCallback(keras.callbacks.Callback):
     def on_epoch_end(self, epoch, logs=None):
         if not self.log_metrics or not logs:
             return
-        g = _metrics.gauge(
-            "hvd_tpu_keras_epoch_metric",
-            "Last epoch-end value of each Keras logged metric",
-            ["metric"],
-        )
+        g = _metrics.KERAS_EPOCH_METRIC
         for key, value in logs.items():
             if isinstance(value, (int, float, np.floating, np.integer)):
                 g.labels(str(key)).set(float(value))

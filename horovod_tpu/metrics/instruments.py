@@ -230,6 +230,14 @@ GRAD_NORM = gauge(
     ["adapter"],
 )
 
+#: Last epoch-end value of each Keras logged metric
+#: (keras.callbacks.TelemetryCallback mirrors model.fit logs here).
+KERAS_EPOCH_METRIC = gauge(
+    "hvd_tpu_keras_epoch_metric",
+    "Last epoch-end value of each Keras logged metric",
+    ["metric"],
+)
+
 # -- process identity --------------------------------------------------------
 
 PROCESS_INFO = gauge(

@@ -211,19 +211,33 @@ class NativeController:
         ]
         lib.hvdtpu_remove_process_set.restype = ctypes.c_int
         lib.hvdtpu_remove_process_set.argtypes = [ctypes.c_int]
+        # zero-arg getters carry explicit argtypes = [] — a bare
+        # restype-only binding accepts (and silently discards) arbitrary
+        # arguments, so arity drift would go unnoticed until the native
+        # stack corrupted (tools/check.py c-api pass enforces this)
         lib.hvdtpu_shutdown.restype = None
+        lib.hvdtpu_shutdown.argtypes = []
         lib.hvdtpu_initialized.restype = ctypes.c_int
+        lib.hvdtpu_initialized.argtypes = []
         lib.hvdtpu_cache_hits.restype = ctypes.c_longlong
+        lib.hvdtpu_cache_hits.argtypes = []
         lib.hvdtpu_cache_misses.restype = ctypes.c_longlong
+        lib.hvdtpu_cache_misses.argtypes = []
         lib.hvdtpu_last_request_bytes.restype = ctypes.c_longlong
+        lib.hvdtpu_last_request_bytes.argtypes = []
         lib.hvdtpu_fusion_threshold.restype = ctypes.c_longlong
+        lib.hvdtpu_fusion_threshold.argtypes = []
         lib.hvdtpu_cycle_time_ms.restype = ctypes.c_double
+        lib.hvdtpu_cycle_time_ms.argtypes = []
         lib.hvdtpu_autotune_active.restype = ctypes.c_int
+        lib.hvdtpu_autotune_active.argtypes = []
         lib.hvdtpu_autotune_inject.restype = None
         lib.hvdtpu_autotune_inject.argtypes = [ctypes.c_double]
         lib.hvdtpu_pending_count.restype = ctypes.c_int
+        lib.hvdtpu_pending_count.argtypes = []
         try:
             lib.hvdtpu_loop_dead.restype = ctypes.c_int
+            lib.hvdtpu_loop_dead.argtypes = []
         except AttributeError:
             # core built before the liveness getter: /healthz then
             # reports liveness from the python-side entry table only
@@ -237,8 +251,11 @@ class NativeController:
                 ctypes.c_ulonglong,
             ]
             lib.hvdtpu_chaos_clear.restype = None
+            lib.hvdtpu_chaos_clear.argtypes = []
             lib.hvdtpu_chaos_injections.restype = ctypes.c_longlong
+            lib.hvdtpu_chaos_injections.argtypes = []
             lib.hvdtpu_heartbeat_misses.restype = ctypes.c_longlong
+            lib.hvdtpu_heartbeat_misses.argtypes = []
         except AttributeError:
             # core built before the chaos/heartbeat API: transport.*
             # injection rules won't fire and heartbeat misses read 0
@@ -251,6 +268,7 @@ class NativeController:
         lib.hvdtpu_start_timeline.restype = ctypes.c_int
         lib.hvdtpu_start_timeline.argtypes = [ctypes.c_char_p]
         lib.hvdtpu_stop_timeline.restype = ctypes.c_int
+        lib.hvdtpu_stop_timeline.argtypes = []
         lib.hvdtpu_pack.restype = None
         lib.hvdtpu_pack.argtypes = [
             ctypes.POINTER(ctypes.c_void_p),

@@ -41,8 +41,10 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from ..common.retry import env_int
+
 _LANES = 128
-_MAX_BM = int(os.environ.get('HVD_TPU_FUSED_BN_BM', 2048))
+_MAX_BM = env_int('HVD_TPU_FUSED_BN_BM', 2048)
 
 
 def _pick_bm(m: int) -> Optional[int]:

@@ -458,7 +458,9 @@ def main() -> int:
     with open(payload_path, "rb") as f:
         spec = pickle.load(f)
     store = _load_store(spec)
-    rank = int(os.environ.get("HVD_TPU_PROCESS_ID", "0"))
+    from horovod_tpu.common.retry import env_int
+
+    rank = env_int("HVD_TPU_PROCESS_ID", 0)
 
     import horovod_tpu as hvd
 

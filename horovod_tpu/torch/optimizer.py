@@ -143,8 +143,9 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             batch.append(self._ready_params.popleft())
         except IndexError:
             return  # an earlier drain already took this task's param
-        window_s = float(os.environ.get(
-            "HVD_TPU_TORCH_BATCH_WINDOW_MS", "1.0")) * 1e-3
+        from ..common.retry import env_float
+
+        window_s = env_float("HVD_TPU_TORCH_BATCH_WINDOW_MS", 1.0) * 1e-3
         from ..common import basics
         state = basics._state
         if (window_s > 0 and state.topology is not None

@@ -26,10 +26,12 @@ import pytest
 
 import horovod_tpu.runner.launch as launch
 from horovod_tpu.common import wire_auth
-from envguards import requires_multiprocess_collectives
+from envguards import (native_child_env, native_lib_path,
+                       requires_multiprocess_collectives)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "integration", "launcher_worker.py")
+NATIVE_LIB = native_lib_path(REPO)
 
 
 # -- wire_auth unit ----------------------------------------------------------
@@ -110,8 +112,7 @@ def test_auth_mode_mismatch_fails_fast():
     srv.bind(("127.0.0.1", 0))
     srv.listen(1)
     port = srv.getsockname()[1]
-    lib_path = os.path.join(REPO, "horovod_tpu", "native",
-                            "libhvd_tpu_core.so")
+    lib_path = NATIVE_LIB
     if not os.path.exists(lib_path):
         pytest.skip("native core not built")
     code = f"""
@@ -130,7 +131,7 @@ elapsed = time.time() - t0
 print("RC", rc, "ELAPSED", elapsed, flush=True)
 sys.exit(0 if rc != 0 and elapsed < 30 else 1)
 """
-    env = os.environ.copy()
+    env = native_child_env()
     env["HVD_TPU_SECRET"] = wire_auth.make_secret()
     proc = subprocess.Popen(
         [sys.executable, "-c", code], env=env,
@@ -199,8 +200,7 @@ def test_steady_state_frame_tamper_rejected():
     srv.bind(("127.0.0.1", 0))
     srv.listen(1)
     port = srv.getsockname()[1]
-    lib_path = os.path.join(REPO, "horovod_tpu", "native",
-                            "libhvd_tpu_core.so")
+    lib_path = NATIVE_LIB
     if not os.path.exists(lib_path):
         pytest.skip("native core not built")
     code = f"""
@@ -227,7 +227,7 @@ while time.time() < deadline:
 print("LOOP_STILL_ALIVE", flush=True)
 sys.exit(3)
 """
-    env = os.environ.copy()
+    env = native_child_env()
     env["HVD_TPU_SECRET"] = secret
     proc = subprocess.Popen(
         [sys.executable, "-c", code], env=env,
@@ -294,8 +294,7 @@ def test_replayed_frame_rejected():
     srv.bind(("127.0.0.1", 0))
     srv.listen(1)
     port = srv.getsockname()[1]
-    lib_path = os.path.join(REPO, "horovod_tpu", "native",
-                            "libhvd_tpu_core.so")
+    lib_path = NATIVE_LIB
     if not os.path.exists(lib_path):
         pytest.skip("native core not built")
     code = f"""
@@ -319,7 +318,7 @@ while time.time() < deadline:
     time.sleep(0.05)
 sys.exit(3)
 """
-    env = os.environ.copy()
+    env = native_child_env()
     env["HVD_TPU_SECRET"] = secret
     proc = subprocess.Popen(
         [sys.executable, "-c", code], env=env,

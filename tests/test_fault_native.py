@@ -17,8 +17,10 @@ import time
 
 import pytest
 
+from envguards import native_child_env, native_lib_path
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-LIB = os.path.join(REPO, "horovod_tpu", "native", "libhvd_tpu_core.so")
+LIB = native_lib_path(REPO)
 
 HB = struct.pack("<I", 0xFFFFFFFF)  # heartbeat frame (length sentinel)
 
@@ -67,6 +69,7 @@ lib.hvdtpu_init.argtypes = [
     ctypes.c_double, ctypes.c_double, ctypes.c_int, ctypes.c_char_p,
 ]
 lib.hvdtpu_heartbeat_misses.restype = ctypes.c_longlong
+lib.hvdtpu_heartbeat_misses.argtypes = []
 """
 
 
@@ -97,7 +100,7 @@ while time.time() < deadline:
 print("STILL_ALIVE", flush=True)
 sys.exit(4)
 """
-    env = os.environ.copy()
+    env = native_child_env()
     env.pop("HVD_TPU_SECRET", None)
     env["HVD_TPU_HEARTBEAT_INTERVAL"] = "0.5"
     env["HVD_TPU_HEARTBEAT_TIMEOUT"] = "2"
@@ -144,7 +147,7 @@ time.sleep(1.0)  # coordinator sends a real frame + closes -> loop ends
 lib.hvdtpu_shutdown()
 sys.exit(0 if alive else 3)
 """
-    env = os.environ.copy()
+    env = native_child_env()
     env.pop("HVD_TPU_SECRET", None)
     env["HVD_TPU_HEARTBEAT_INTERVAL"] = "0.5"
     env["HVD_TPU_HEARTBEAT_TIMEOUT"] = "1.5"
@@ -244,7 +247,7 @@ while time.time() < deadline:
 print("NO_ERROR", flush=True)
 sys.exit(4)
 """
-    env = os.environ.copy()
+    env = native_child_env()
     env.pop("HVD_TPU_SECRET", None)
     env["HVD_TPU_HEARTBEAT_INTERVAL"] = "0"  # blocking reads: pure stall
     proc = subprocess.Popen([sys.executable, "-c", code], env=env,

@@ -1,0 +1,454 @@
+"""Self-tests for the cross-layer contract checker (horovod_tpu.analysis).
+
+Two layers of proof:
+
+* the REAL repo passes every contract (so the suite gates tier-1), and
+  the whole run finishes far inside its 10-second budget;
+* on synthetic mini-trees, deliberately introducing one drift of each
+  class — a ctypes arity mismatch, an undocumented env var, an
+  uncatalogued metric name, an undocumented chaos site — is caught with
+  a finding naming the offending file, and the suppression machinery
+  (inline markers, allowlist file) behaves exactly as documented.
+
+The analysis package is stdlib-only, so these tests are cheap tier-1
+citizens (marker: ``analysis``).
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from horovod_tpu import analysis
+from horovod_tpu.analysis import _common, c_api
+
+pytestmark = pytest.mark.analysis
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write(root, rel, text):
+    path = os.path.join(str(root), rel)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write(text)
+    return path
+
+
+# -- the real repo ------------------------------------------------------------
+
+
+def test_repo_holds_every_contract_fast():
+    t0 = time.perf_counter()
+    findings = analysis.run_all(REPO)
+    elapsed = time.perf_counter() - t0
+    assert not findings, "\n".join(f.render() for f in findings)
+    assert elapsed < 10.0, f"analysis took {elapsed:.1f}s (budget 10s)"
+
+
+def test_check_py_standalone_runs_clean():
+    """tools/check.py must work without importing jax (bare-box CI lint
+    job): the bootstrap stubs the parent package."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "check.py")],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "all contracts hold" in proc.stderr
+
+
+# -- c-api pass on synthetic trees -------------------------------------------
+
+_SYN_C_API = """\
+extern "C" {
+
+int hvdtpu_foo(int a, long long b) { return 0; }
+
+long long hvdtpu_counter() { return 0; }
+
+}  // extern "C"
+"""
+
+
+def _syn_controller(argtypes, restype="ctypes.c_int",
+                    counter_args="\nlib.hvdtpu_counter.argtypes = []"):
+    return (
+        "import ctypes\n"
+        f"lib.hvdtpu_foo.restype = {restype}\n"
+        f"lib.hvdtpu_foo.argtypes = {argtypes}\n"
+        "lib.hvdtpu_counter.restype = ctypes.c_longlong"
+        f"{counter_args}\n"
+    )
+
+
+def test_c_api_clean_tree_passes(tmp_path):
+    _write(tmp_path, _common.C_API_CC, _SYN_C_API)
+    _write(tmp_path, _common.CONTROLLER_PY,
+           _syn_controller("[ctypes.c_int, ctypes.c_longlong]"))
+    assert analysis.run_all(str(tmp_path), ["c-api"]) == []
+
+
+def test_c_api_arity_drift_caught(tmp_path):
+    _write(tmp_path, _common.C_API_CC, _SYN_C_API)
+    _write(tmp_path, _common.CONTROLLER_PY,
+           _syn_controller("[ctypes.c_int]"))  # one arg short
+    findings = analysis.run_all(str(tmp_path), ["c-api"])
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.file == _common.CONTROLLER_PY and f.key == "hvdtpu_foo"
+    assert "1 entries" in f.message and "2 parameters" in f.message
+
+
+def test_c_api_type_and_restype_drift_caught(tmp_path):
+    _write(tmp_path, _common.C_API_CC, _SYN_C_API)
+    _write(tmp_path, _common.CONTROLLER_PY, _syn_controller(
+        "[ctypes.c_int, ctypes.c_int]",      # c_int where longlong due
+        restype="ctypes.c_double"))           # int return misdeclared
+    keys = {(f.key, "argtypes[1]" in f.message or "restype" in f.message)
+            for f in analysis.run_all(str(tmp_path), ["c-api"])}
+    assert keys == {("hvdtpu_foo", True)}
+
+
+def test_c_api_missing_argtypes_and_unknown_symbol_caught(tmp_path):
+    _write(tmp_path, _common.C_API_CC, _SYN_C_API)
+    _write(tmp_path, _common.CONTROLLER_PY, (
+        "import ctypes\n"
+        "lib.hvdtpu_foo.restype = ctypes.c_int\n"   # argtypes missing
+        "lib.hvdtpu_counter.restype = ctypes.c_longlong\n"
+        "lib.hvdtpu_counter.argtypes = []\n"
+        "lib.hvdtpu_ghost.restype = ctypes.c_int\n"  # not declared in C
+        "lib.hvdtpu_ghost.argtypes = []\n"
+    ))
+    found = {f.key: f.message
+             for f in analysis.run_all(str(tmp_path), ["c-api"])}
+    assert "only 0 argtypes" in found["hvdtpu_foo"]
+    assert "no such function" in found["hvdtpu_ghost"]
+
+
+def test_c_api_harness_checked_too(tmp_path):
+    """Drift inside an embedded ``python -c`` string literal in a test
+    harness is caught — the scan is textual by design."""
+    _write(tmp_path, _common.C_API_CC, _SYN_C_API)
+    _write(tmp_path, _common.CONTROLLER_PY,
+           _syn_controller("[ctypes.c_int, ctypes.c_longlong]"))
+    harness = _common.CTYPES_HARNESSES[0]
+    _write(tmp_path, harness, (
+        'code = f"""\n'
+        "lib.hvdtpu_foo.restype = ctypes.c_int\n"
+        "lib.hvdtpu_foo.argtypes = [ctypes.c_int, ctypes.c_int,\n"
+        "                           ctypes.c_int]\n"
+        '"""\n'
+    ))
+    findings = analysis.run_all(str(tmp_path), ["c-api"])
+    assert [f.file for f in findings] == [harness]
+    assert "3 entries" in findings[0].message
+
+
+def test_c_api_duplicate_declarations_all_checked(tmp_path):
+    """The harnesses declare the same symbol once per embedded blob; a
+    drifted EARLY declaration must be caught even when a later one is
+    correct (last-occurrence-wins would mask it)."""
+    _write(tmp_path, _common.C_API_CC, _SYN_C_API)
+    _write(tmp_path, _common.CONTROLLER_PY,
+           _syn_controller("[ctypes.c_int, ctypes.c_longlong]"))
+    harness = _common.CTYPES_HARNESSES[0]
+    _write(tmp_path, harness, (
+        "blob_a = '''\n"
+        "lib.hvdtpu_foo.restype = ctypes.c_int\n"
+        "lib.hvdtpu_foo.argtypes = [ctypes.c_int]\n"   # drifted
+        "'''\n"
+        "blob_b = '''\n"
+        "lib.hvdtpu_foo.restype = ctypes.c_int\n"
+        "lib.hvdtpu_foo.argtypes = [ctypes.c_int, ctypes.c_longlong]\n"
+        "'''\n"
+    ))
+    findings = analysis.run_all(str(tmp_path), ["c-api"])
+    assert [(f.file, f.key) for f in findings] == [(harness, "hvdtpu_foo")]
+    assert "1 entries" in findings[0].message
+
+
+def test_c_api_missing_restype_on_nonint_return_caught(tmp_path):
+    """argtypes without restype on a non-int-returning export: ctypes
+    silently defaults to c_int and truncates the long long."""
+    _write(tmp_path, _common.C_API_CC, _SYN_C_API)
+    _write(tmp_path, _common.CONTROLLER_PY, (
+        "import ctypes\n"
+        "lib.hvdtpu_foo.restype = ctypes.c_int\n"
+        "lib.hvdtpu_foo.argtypes = [ctypes.c_int, ctypes.c_longlong]\n"
+        "lib.hvdtpu_counter.argtypes = []\n"   # restype missing
+    ))
+    findings = analysis.run_all(str(tmp_path), ["c-api"])
+    assert [f.key for f in findings] == ["hvdtpu_counter"]
+    assert "default to c_int" in findings[0].message
+
+
+def test_real_c_api_parser_sees_full_surface():
+    """The parser must see every symbol the production binding binds —
+    anchored on a few that exercise tricky parses (function pointer,
+    multi-line params)."""
+    syms = c_api.declared_symbols(REPO)
+    for required in ("hvdtpu_init", "hvdtpu_set_exec_callback",
+                     "hvdtpu_enqueue_n", "hvdtpu_pack",
+                     "hvdtpu_chaos_set"):
+        assert required in syms
+    funcs = c_api.parse_c_api(
+        _common.read_text(os.path.join(REPO, _common.C_API_CC)))
+    assert funcs["hvdtpu_set_exec_callback"].args == ("funcptr", "void*")
+    assert len(funcs["hvdtpu_init"].args) == 12
+
+
+# -- env pass on synthetic trees ---------------------------------------------
+
+_SYN_RUNNING = """\
+| Variable | Meaning |
+|---|---|
+| `HVD_TPU_KNOWN` | documented knob |
+"""
+
+
+def test_env_clean_tree_passes(tmp_path):
+    _write(tmp_path, _common.RUNNING_MD, _SYN_RUNNING)
+    _write(tmp_path, "horovod_tpu/mod.py",
+           'import os\nv = os.environ.get("HVD_TPU_KNOWN")\n')
+    assert analysis.run_all(str(tmp_path), ["env"]) == []
+
+
+def test_env_undocumented_read_caught(tmp_path):
+    _write(tmp_path, _common.RUNNING_MD, _SYN_RUNNING)
+    _write(tmp_path, "horovod_tpu/mod.py", (
+        "import os\n"
+        'k = os.environ.get("HVD_TPU_KNOWN")\n'
+        'v = os.environ.get("HVD_TPU_SURPRISE")\n'
+    ))
+    findings = analysis.run_all(str(tmp_path), ["env"])
+    assert len(findings) == 1
+    assert findings[0].file == "horovod_tpu/mod.py"
+    assert findings[0].key == "HVD_TPU_SURPRISE"
+
+
+def test_env_stale_doc_row_caught(tmp_path):
+    _write(tmp_path, _common.RUNNING_MD,
+           _SYN_RUNNING + "| `HVD_TPU_GONE` | removed knob |\n")
+    _write(tmp_path, "horovod_tpu/mod.py",
+           'import os\nv = os.environ.get("HVD_TPU_KNOWN")\n')
+    findings = analysis.run_all(str(tmp_path), ["env"])
+    assert [f.key for f in findings] == ["HVD_TPU_GONE"]
+    assert findings[0].file == _common.RUNNING_MD
+
+
+def test_env_raw_parse_caught_and_wildcard_docs(tmp_path):
+    _write(tmp_path, _common.RUNNING_MD,
+           _SYN_RUNNING + "and the `HVD_TPU_FAM_*` family\n")
+    _write(tmp_path, "horovod_tpu/mod.py", (
+        "import os\n"
+        'n = int(os.environ.get("HVD_TPU_KNOWN", "1"))\n'
+        'f = os.environ.get("HVD_TPU_FAM_A")\n'  # wildcard-covered
+    ))
+    findings = analysis.run_all(str(tmp_path), ["env"])
+    assert len(findings) == 1
+    assert "raw numeric parse" in findings[0].message
+    assert findings[0].key == "HVD_TPU_KNOWN"
+
+
+def test_env_native_reads_scanned(tmp_path):
+    _write(tmp_path, _common.RUNNING_MD, _SYN_RUNNING)
+    _write(tmp_path, "horovod_tpu/mod.py",
+           'import os\nk = os.environ.get("HVD_TPU_KNOWN")\n')
+    _write(tmp_path, "horovod_tpu/native/src/x.h",
+           '#include <cstdlib>\nauto v = std::getenv("HVD_TPU_NATIVE_ONLY");\n')
+    findings = analysis.run_all(str(tmp_path), ["env"])
+    assert [f.key for f in findings] == ["HVD_TPU_NATIVE_ONLY"]
+    assert findings[0].file.endswith("x.h")
+
+
+# -- metrics pass on synthetic trees -----------------------------------------
+
+
+def _metrics_tree(tmp_path, instruments, docs, module=""):
+    _write(tmp_path, _common.INSTRUMENTS_PY, instruments)
+    _write(tmp_path, _common.METRICS_MD, docs)
+    if module:
+        _write(tmp_path, "horovod_tpu/mod.py", module)
+
+
+def test_metrics_clean_tree_passes(tmp_path):
+    _metrics_tree(
+        tmp_path,
+        'A = counter("hvd_tpu_a_total", "doc")\n',
+        "catalogue: `hvd_tpu_a_total`\n",
+    )
+    assert analysis.run_all(str(tmp_path), ["metrics"]) == []
+
+
+def test_metrics_uncatalogued_name_caught(tmp_path):
+    _metrics_tree(
+        tmp_path,
+        'A = counter("hvd_tpu_a_total", "doc")\n',
+        "catalogue: `hvd_tpu_a_total`\n",
+        module='r = counter("hvd_tpu_rogue_total", "undeclared")\n',
+    )
+    findings = analysis.run_all(str(tmp_path), ["metrics"])
+    assert [f.file for f in findings] == ["horovod_tpu/mod.py"]
+    assert findings[0].key == "hvd_tpu_rogue_total"
+
+
+def test_metrics_undocumented_and_stale_doc_caught(tmp_path):
+    _metrics_tree(
+        tmp_path,
+        'A = counter("hvd_tpu_a_total", "doc")\n'
+        'B = gauge("hvd_tpu_b", "doc")\n',
+        "catalogue: `hvd_tpu_a_total` and `hvd_tpu_vanished`\n",
+    )
+    found = {f.key: f.file
+             for f in analysis.run_all(str(tmp_path), ["metrics"])}
+    assert found == {
+        "hvd_tpu_b": _common.INSTRUMENTS_PY,       # not documented
+        "hvd_tpu_vanished": _common.METRICS_MD,    # documented, gone
+    }
+
+
+def test_metrics_brace_expansion_understood(tmp_path):
+    _metrics_tree(
+        tmp_path,
+        'H = gauge("hvd_tpu_cache_hits", "d")\n'
+        'M = gauge("hvd_tpu_cache_misses", "d")\n'
+        'S = gauge("hvd_tpu_t_seconds", "d", ["phase"])\n',
+        "`hvd_tpu_cache_{hits,misses}` and `hvd_tpu_t_seconds{phase}`\n",
+    )
+    assert analysis.run_all(str(tmp_path), ["metrics"]) == []
+
+
+# -- chaos pass on synthetic trees -------------------------------------------
+
+_SYN_CHAOS_INIT = """\
+SITES = (
+    "transport.frame.send",
+    "module.step",
+)
+"""
+
+_SYN_FAULT_MD = """\
+| site | layer |
+|---|---|
+| `transport.frame.send` | native |
+| `module.step` | python |
+"""
+
+
+def _chaos_tree(tmp_path, init=_SYN_CHAOS_INIT, doc=_SYN_FAULT_MD,
+                module='def f():\n    point("module.step")\n',
+                native='Decide("transport.frame.send");\n'):
+    _write(tmp_path, _common.CHAOS_INIT_PY, init)
+    _write(tmp_path, _common.FAULT_MD, doc)
+    _write(tmp_path, "horovod_tpu/mod.py", module)
+    _write(tmp_path, "horovod_tpu/native/src/t.h", native)
+
+
+def test_chaos_clean_tree_passes(tmp_path):
+    _chaos_tree(tmp_path)
+    assert analysis.run_all(str(tmp_path), ["chaos"]) == []
+
+
+def test_chaos_undocumented_site_caught(tmp_path):
+    _chaos_tree(tmp_path, doc="| site | layer |\n|---|---|\n"
+                              "| `transport.frame.send` | native |\n")
+    findings = analysis.run_all(str(tmp_path), ["chaos"])
+    assert [(f.key, f.file) for f in findings] == [
+        ("module.step", _common.CHAOS_INIT_PY)]
+    assert "site table" in findings[0].message
+
+
+def test_chaos_uncatalogued_point_and_dead_entry_caught(tmp_path):
+    _chaos_tree(tmp_path,
+                module='def f():\n    raise_point("rogue.site")\n')
+    found = {f.key: f for f in analysis.run_all(str(tmp_path), ["chaos"])}
+    assert found["rogue.site"].file == "horovod_tpu/mod.py"
+    # module.step lost its only call site -> dead catalogue entry
+    assert "dead catalogue entry" in found["module.step"].message
+
+
+def test_chaos_native_divergence_caught(tmp_path):
+    _chaos_tree(tmp_path,
+                native='Decide("transport.frame.send");\n'
+                       'Decide("transport.frame.recv");\n')
+    findings = analysis.run_all(str(tmp_path), ["chaos"])
+    assert [f.key for f in findings] == ["transport.frame.recv"]
+    assert findings[0].file.endswith("t.h")
+
+
+# -- suppression machinery ----------------------------------------------------
+
+
+def test_inline_marker_suppresses_with_justification(tmp_path):
+    _write(tmp_path, _common.RUNNING_MD, _SYN_RUNNING)
+    _write(tmp_path, "horovod_tpu/mod.py", (
+        "import os\n"
+        "# contract-ok: env -- launcher-set, garbage must crash\n"
+        'n = int(os.environ.get("HVD_TPU_KNOWN", "1"))\n'
+    ))
+    assert analysis.run_all(str(tmp_path), ["env"]) == []
+
+
+def test_inline_marker_without_justification_is_reported(tmp_path):
+    _write(tmp_path, _common.RUNNING_MD, _SYN_RUNNING)
+    _write(tmp_path, "horovod_tpu/mod.py", (
+        "import os\n"
+        "# contract-ok: env\n"
+        'n = int(os.environ.get("HVD_TPU_KNOWN", "1"))\n'
+    ))
+    findings = analysis.run_all(str(tmp_path), ["env"])
+    assert [f.check for f in findings] == ["allowlist"]
+    assert "no justification" in findings[0].message
+
+
+def test_allowlist_file_suppresses_and_audits(tmp_path):
+    _write(tmp_path, "pyproject.toml", (
+        "[tool.horovod_tpu.analysis]\n"
+        'allowlist = "allow.txt"\n'
+    ))
+    _write(tmp_path, "allow.txt", (
+        "# comment\n"
+        "env:HVD_TPU_SURPRISE -- vendor reads it, row lands next PR\n"
+        "env:HVD_TPU_NEVER_MATCHES -- stale entry\n"
+        "malformed line without separator\n"
+    ))
+    _write(tmp_path, _common.RUNNING_MD, _SYN_RUNNING)
+    _write(tmp_path, "horovod_tpu/mod.py", (
+        "import os\n"
+        'k = os.environ.get("HVD_TPU_KNOWN")\n'
+        'v = os.environ.get("HVD_TPU_SURPRISE")\n'
+    ))
+    findings = analysis.run_all(str(tmp_path), ["env"])
+    # the real finding is suppressed; the malformed line is reported
+    # (stale-entry audit runs only on full runs, not pass subsets)
+    assert [f.check for f in findings] == ["allowlist"]
+    assert "malformed" in findings[0].message
+    full = analysis.run_all(str(tmp_path))
+    stale = [f for f in full if "stale allowlist" in f.message]
+    assert [f.key for f in stale] == ["env:HVD_TPU_NEVER_MATCHES"]
+
+
+# -- entrypoint ---------------------------------------------------------------
+
+
+def test_main_exit_codes_and_rendering(tmp_path, capsys):
+    _write(tmp_path, _common.RUNNING_MD, "| Variable | Meaning |\n")
+    _write(tmp_path, "horovod_tpu/mod.py",
+           'import os\nv = os.environ.get("HVD_TPU_SURPRISE")\n')
+    rc = analysis.main(["--root", str(tmp_path), "env"])
+    out = capsys.readouterr()
+    assert rc == 1
+    assert "horovod_tpu/mod.py:2: [env]" in out.out
+    _write(tmp_path, _common.RUNNING_MD,
+           "| Variable | Meaning |\n"
+           "| `HVD_TPU_SURPRISE` | now documented |\n")
+    assert analysis.main(["--root", str(tmp_path), "env"]) == 0
+
+
+def test_list_c_symbols_matches_parser(capsys):
+    rc = analysis.main(["--root", REPO, "--list-c-symbols"])
+    assert rc == 0
+    out = capsys.readouterr().out.split()
+    assert out == c_api.declared_symbols(REPO)

@@ -5,34 +5,62 @@
 # rebuild can't slip into a commit.  CI/tooling entry point — `pip
 # install .` (setup.py build_ext) remains the user-facing build.
 #
-# Usage: tools/rebuild_native.sh [extra CXXFLAGS...]
-# Pairs with tests/test_native_build.py, which asserts the committed .so
-# exports exactly the hvdtpu_* C API surface declared in c_api.cc.
+# Usage: tools/rebuild_native.sh [--sanitize=thread|address] [extra CXXFLAGS...]
+#
+# --sanitize builds the instrumented twin (libhvd_tpu_core.tsan.so /
+# .asan.so — see docs/ANALYSIS.md) next to the production binary
+# instead of replacing it.
+#
+# Pairs with tests/test_native_build.py, which asserts the on-disk .so
+# exports exactly the hvdtpu_* C API surface declared in c_api.cc; the
+# export check below reuses the same parser
+# (horovod_tpu.analysis.c_api via tools/check.py --list-c-symbols), so
+# the symbol list lives in exactly one place.
 set -euo pipefail
 
-cd "$(dirname "$0")/../horovod_tpu/native/src"
+TOOLS_DIR="$(cd "$(dirname "$0")" && pwd)"
+cd "$TOOLS_DIR/../horovod_tpu/native/src"
+
+SANITIZE=""
+if [[ "${1:-}" == --sanitize=* ]]; then
+  SANITIZE="${1#--sanitize=}"
+  shift
+fi
 
 CXX="${CXX:-g++}"
 CXXFLAGS="-O2 -fPIC -std=c++17 -Wall -Wextra -Werror -pthread $*"
 
-echo "[rebuild_native] $CXX $CXXFLAGS" >&2
-make clean >/dev/null
-make CXX="$CXX" CXXFLAGS="$CXXFLAGS"
+case "$SANITIZE" in
+  "")
+    SO_NAME="libhvd_tpu_core.so"
+    make clean >/dev/null
+    ;;
+  thread)  SO_NAME="libhvd_tpu_core.tsan.so"; rm -f "../$SO_NAME" ;;
+  address) SO_NAME="libhvd_tpu_core.asan.so"; rm -f "../$SO_NAME" ;;
+  *)
+    echo "[rebuild_native] ERROR: --sanitize=$SANITIZE (want thread|address)" >&2
+    exit 2
+    ;;
+esac
 
-SO="$(cd .. && pwd)/libhvd_tpu_core.so"
+echo "[rebuild_native] $CXX $CXXFLAGS SANITIZE=${SANITIZE:-off}" >&2
+make CXX="$CXX" CXXFLAGS="$CXXFLAGS" SANITIZE="$SANITIZE"
+
+SO="$(cd .. && pwd)/$SO_NAME"
 echo "[rebuild_native] built $SO" >&2
-# sanity: every extern "C" symbol declared in c_api.cc must be exported —
-# including the hvdtpu_chaos_* / heartbeat surface.  Snapshot the symbol
-# table ONCE: under pipefail, `nm | grep -q` flakes when grep's early
-# exit SIGPIPEs nm mid-write (false "missing" as the API surface grew).
-symtab="$(nm -D --defined-only "$SO")"
-missing=$(
-  grep -oE '^(int|void|long long|double|const char\*) hvdtpu_[a-z_0-9]+' \
-      c_api.cc | awk '{print $NF}' | sort -u |
-  while read -r sym; do
-    printf '%s\n' "$symtab" | grep -q " $sym\$" || echo "$sym"
-  done
-)
+# sanity: every extern "C" symbol declared in c_api.cc must be exported.
+# The declared-symbol list comes from the shared C-API parser (the same
+# one the contract checker and test_native_build.py use).  Set
+# difference via comm over fully-materialized sorted lists — any
+# `... | grep -q` probe under pipefail SIGPIPE-flakes once the symtab
+# is large (the ASan build statically links a 14 MB runtime).
+declared="$(python3 "$TOOLS_DIR/check.py" --list-c-symbols | sort -u)"
+# `|| true`: zero exported hvdtpu_ symbols must fall through to the
+# report below, not kill the script via pipefail on grep's no-match
+exported="$(nm -D --defined-only "$SO" | awk '{print $NF}' \
+            | { grep '^hvdtpu_' || true; } | sort -u)"
+missing="$(comm -23 <(printf '%s\n' "$declared") \
+                    <(printf '%s\n' "$exported"))"
 if [ -n "$missing" ]; then
   echo "[rebuild_native] ERROR: symbols declared but not exported:" >&2
   echo "$missing" >&2
