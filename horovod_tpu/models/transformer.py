@@ -39,10 +39,12 @@ class TransformerConfig:
     num_heads: int = 12
     # GQA (Ainslie et al., 2023; the Llama-2-70B/Llama-3 layout): K/V
     # projections produce this many heads, shared by num_heads/num_kv_heads
-    # query heads each.  None (default) = MHA.  K/V heads are repeated to
-    # num_heads before attention, so every attention_impl (dot, flash,
-    # ring, ring_flash) works unchanged; the savings are in the K/V
-    # projection FLOPs/params and any KV cache, exactly as in the paper.
+    # query heads each.  None (default) = MHA.  Every attention_impl
+    # (dot, flash, ring, ring_flash) consumes the grouped K/V NATIVELY —
+    # the dense paths group their einsums and the pallas kernels share
+    # each K/V head across its query-head group in VMEM — so attention
+    # K/V bytes/FLOPs, ring comms, the K/V projections and any KV cache
+    # all shrink by num_heads/num_kv_heads; nothing is ever repeated.
     num_kv_heads: Optional[int] = None
     head_dim: int = 64
     mlp_ratio: int = 4
@@ -59,12 +61,13 @@ class TransformerConfig:
     # Mistral-style sliding-window attention: each token attends the last
     # `window` positions, itself included (q_pos - k_pos < window, the
     # Mistral/HF convention; symmetric reach when causal=False).  Exact on
-    # 'dot' and dense 'ring' (mask-level) and on 'flash', where
-    # out-of-window blocks are SKIPPED — compute O(S·window), the real
-    # Mistral training path.  'ring_flash' has no windowed merge yet and
-    # rejects it with guidance.
+    # every impl: mask-level on 'dot' and dense 'ring'; on 'flash' and
+    # 'ring_flash' out-of-window blocks are SKIPPED in the kernels —
+    # compute O(S·window), the real Mistral training path — and a causal
+    # window additionally truncates the ring rotation itself
+    # (parallel/ring_attention.py ring_window_steps), so out-of-window
+    # ring steps cost neither compute nor comms.
     window: Optional[int] = None
-    # (window support is validated at construction — see __post_init__)
     # rematerialize each decoder block in the backward pass: activation
     # memory drops from O(layers) to O(1) blocks at ~1/3 extra FLOPs —
     # the standard TPU memory/compute trade (jax.checkpoint) that lets
@@ -72,12 +75,6 @@ class TransformerConfig:
     remat: bool = False
 
     def __post_init__(self):
-        if self.window is not None and self.attention_impl == "ring_flash":
-            raise ValueError(
-                "sliding-window attention (window=) is supported by "
-                "'dot', 'flash' (windowed block-skip) and dense 'ring'; "
-                "the flash-block ring path has no windowed merge yet"
-            )
         kv = self.num_kv_heads
         if kv is not None and (kv <= 0 or self.num_heads % kv):
             raise ValueError(
@@ -125,14 +122,30 @@ def causal_dot_attention(q, k, v, *, q_offset=0, k_offset=0, causal=True,
                          window=None):
     """Standard attention; offsets support sequence-sharded blocks.
 
-    q, k, v: (B, S, H, D).  Softmax in float32 (TPU numerics), matmuls in
-    the input dtype so they hit the MXU in bf16.  ``causal=False`` is
-    the bidirectional (encoder / BERT-family) form — no mask at all.
-    ``window``: Mistral-style sliding window — each token attends the
-    last ``window`` positions, itself included (see ``sliding_mask``).
+    q: (B, S, H, D); k, v: (B, S, H_kv, D) with H_kv | H — under GQA
+    (H_kv < H) the einsums GROUP the contraction (query head
+    ``hk*g + j`` reads kv head ``hk``) instead of repeating K/V to full
+    heads, so no inflated K/V tensor is ever materialized.  Softmax in
+    float32 (TPU numerics), matmuls in the input dtype so they hit the
+    MXU in bf16.  ``causal=False`` is the bidirectional (encoder /
+    BERT-family) form — no mask at all.  ``window``: Mistral-style
+    sliding window — each token attends the last ``window`` positions,
+    itself included (see ``sliding_mask``).
     """
-    d = q.shape[-1]
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(d).astype(q.dtype)
+    b, s_q, h, d = q.shape
+    s_k, h_kv = k.shape[1], k.shape[2]
+    if h_kv <= 0 or h % h_kv:
+        raise ValueError(
+            f"query heads ({h}) must be a multiple of kv heads ({h_kv})"
+        )
+    if h_kv != h:
+        qg = q.reshape(b, s_q, h_kv, h // h_kv, d)
+        logits = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k).reshape(
+            b, h, s_q, s_k
+        ) / jnp.sqrt(d).astype(q.dtype)
+    else:
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(d).astype(
+            q.dtype)
     logits = logits.astype(jnp.float32)
     if causal or window is not None:
         mask = sliding_mask(
@@ -142,6 +155,11 @@ def causal_dot_attention(q, k, v, *, q_offset=0, k_offset=0, causal=True,
         )
         logits = jnp.where(mask[None, None], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    if h_kv != h:
+        return jnp.einsum(
+            "bhgqk,bkhd->bqhgd",
+            probs.reshape(b, h_kv, h // h_kv, s_q, s_k), v,
+        ).reshape(b, s_q, h, d)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
@@ -162,12 +180,11 @@ class Attention(nn.Module):
         v = dense(features=(kv_heads, cfg.head_dim), name="v")(x)
         q = rope(q, positions)
         k = rope(k, positions)
-        if kv_heads != cfg.num_heads:
-            # GQA: each K/V head serves num_heads/kv_heads query heads;
-            # repeat on the head axis so the attention kernels see MHA
-            rep = cfg.num_heads // kv_heads
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
+        # GQA needs no expansion: every impl consumes (B, S, H_kv, D)
+        # K/V natively — the kernels/einsums share each kv head across
+        # its query-head group, so the group factor is saved in
+        # attention HBM bytes, FLOPs and ring comms, not just in the
+        # projections.
         if cfg.attention_impl in ("ring", "ring_flash"):
             from ..parallel.ring_attention import ring_attention
 

@@ -7,7 +7,8 @@ FLOPs live, and this kernel keeps the whole online-softmax accumulation
 in VMEM next to the MXU instead of materializing the (S x S) logits in
 HBM.  Used by ``models.transformer`` (``attention_impl="flash"``) and as
 the local block of ring attention; numerically validated against
-``causal_dot_attention`` (tests/test_flash_attention.py).
+``causal_dot_attention`` (tests/test_flash_attention.py,
+tests/test_gqa_flash.py).
 
 Kernel shape (the standard TPU flash forward, per pallas_guide.md):
 grid = (batch*heads, Sq/block_q); each program holds one Q block in VMEM,
@@ -15,6 +16,21 @@ K/V for the whole (padded) sequence stream through VMEM block-by-block
 inside a ``fori_loop`` with running (max, sum, accumulator) statistics in
 float32; causal programs stop the loop at the diagonal block.  Matmuls
 run on the MXU with ``preferred_element_type=float32``.
+
+Grouped-query attention (GQA — Ainslie et al., 2023) is KERNEL-NATIVE:
+``k``/``v`` may carry ``num_kv_heads < num_heads`` heads and are folded
+per *kv* head; the BlockSpec index maps point each query-head program at
+``kv_head = q_head // group``, so K/V are fetched from HBM once per kv
+head and shared by the whole query-head group — K/V HBM reads and the
+dK/dV accumulation shrink by ``num_heads/num_kv_heads`` with no
+materialized repeat.
+
+All three kernels also take a traced ``kv_offset`` scalar (SMEM): the
+global position of the K block's first key minus the global position of
+the Q block's first query.  Ring attention passes the per-step shard
+offset so causal/sliding-window masks AND the block-skip bounds act on
+GLOBAL positions — this is what makes the windowed ring-flash merge
+exact (parallel/ring_attention.py).
 
 On non-TPU backends the same kernel runs in interpret mode (slow but
 exact), so the CPU test mesh exercises identical code.
@@ -29,48 +45,64 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+try:  # scalar params belong in SMEM on TPU; interpret mode accepts it too
+    from jax.experimental.pallas import tpu as _pltpu
+
+    _SCALAR_SPEC = pl.BlockSpec(memory_space=_pltpu.SMEM)
+except Exception:  # pragma: no cover - CPU-only images without pallas.tpu
+    _SCALAR_SPEC = pl.BlockSpec((1,), lambda *_: (0,))
+
 _NEG_INF = -1e30
 
 
-def _tile_mask(q_pos, k_pos, causal, window, seq_len):
+def _tile_mask(q_pos, k_pos, causal, window, seq_len, kv_off=0):
     """(block_q, block_k) bool mask — padding, causality, sliding window.
-    Must stay identical between the forward kernel and _recompute_p (the
-    backward recomputes the same probabilities from the saved lse)."""
-    mask = k_pos < seq_len  # padding beyond the true sequence
+    ``kv_off`` shifts the K positions into the Q block's frame (global
+    K start − global Q start); 0 for self-attention.  Must stay identical
+    between the forward kernel and _recompute_p (the backward recomputes
+    the same probabilities from the saved lse)."""
+    mask = k_pos < seq_len  # padding beyond the true (local) sequence
+    rel = q_pos - k_pos - kv_off  # GLOBAL q_pos − k_pos
     if causal:
-        mask = jnp.logical_and(mask, q_pos >= k_pos)
+        mask = jnp.logical_and(mask, rel >= 0)
     if window is not None:
-        mask = jnp.logical_and(mask, q_pos - k_pos < window)
+        mask = jnp.logical_and(mask, rel < window)
         if not causal:
-            mask = jnp.logical_and(mask, k_pos - q_pos < window)
+            mask = jnp.logical_and(mask, rel > -window)
     return mask
 
 
-def _kb_range(q_off, block_q, block_k, padded_kb, causal, window):
+def _kb_range(q_off, block_q, block_k, padded_kb, causal, window, kv_off=0):
     """K-block loop bounds for one Q block: skip blocks entirely outside
     the causal diagonal / sliding window (this skip is where the windowed
-    kernel's compute drops from O(S²) to O(S·W))."""
+    kernel's compute drops from O(S²) to O(S·W)).  ``kv_off`` is the
+    global K−Q offset (see _tile_mask); bounds may be traced and may
+    satisfy lo >= hi (an empty, fully-masked range — fori_loop runs zero
+    iterations and the caller's l==0 guard takes over)."""
+    hi = padded_kb
     if causal:
-        # clamp to padded_kb: when block_q > block_k the last Q block's
-        # diagonal bound can point one K block past the padded K extent
+        # last K block holding any k <= q for the block's last row
         hi = jnp.minimum(
-            padded_kb, jax.lax.div(q_off + block_q - 1, block_k) + 1)
+            hi, jnp.floor_divide(q_off + block_q - 1 - kv_off, block_k) + 1)
     elif window is not None:
+        # bidirectional: the forward reach k < q + window also bounds hi
         hi = jnp.minimum(
-            padded_kb,
-            jax.lax.div(q_off + block_q - 1 + window - 1, block_k) + 1)
-    else:
-        hi = padded_kb
+            hi,
+            jnp.floor_divide(
+                q_off + block_q - 1 + window - 1 - kv_off, block_k) + 1)
     if window is None:
         lo = 0
     else:  # first K block any row of this Q block can reach back to
-        lo = jnp.maximum(0, jax.lax.div(q_off - (window - 1), block_k))
+        lo = jnp.maximum(
+            0, jnp.floor_divide(q_off - (window - 1) - kv_off, block_k))
+    hi = jnp.maximum(hi, 0)
     return lo, hi
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
-                block_q, block_k, seq_len, window=None):
+def _fwd_kernel(kvoff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale,
+                causal, block_q, block_k, seq_len, window=None):
     qi = pl.program_id(1)
+    kv_off = kvoff_ref[0]
     head_dim = q_ref.shape[-1]
     q = q_ref[0].astype(jnp.float32) * sm_scale  # (block_q, D)
     q_off = qi * block_q
@@ -91,7 +123,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
         k_pos = k_off + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1
         )
-        mask = _tile_mask(q_pos, k_pos, causal, window, seq_len)
+        mask = _tile_mask(q_pos, k_pos, causal, window, seq_len, kv_off)
         s = jnp.where(mask, s, _NEG_INF)
         new_m = jnp.maximum(m, jnp.max(s, axis=-1))
         # explicit zeroing: a fully-masked row keeps new_m at the -inf
@@ -111,15 +143,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
     m = jnp.full((block_q,), _NEG_INF, jnp.float32)
     padded_len = k_ref.shape[1]
     lo_kb, n_kb = _kb_range(q_off, block_q, block_k,
-                            padded_len // block_k, causal, window)
+                            padded_len // block_k, causal, window, kv_off)
     acc, l, m = jax.lax.fori_loop(lo_kb, n_kb, body, (acc, l, m))
-    # rows past the true sequence are all-masked (l == 0): emit zeros
+    # rows past the true sequence (or wholly out of window) are
+    # all-masked (l == 0): emit zeros
     safe_l = jnp.where(l > 0, l, 1.0)
     o_ref[0] = (acc / safe_l[:, None]).astype(o_ref.dtype)
     # per-row logsumexp of the SCALED logits, for the backward's exact
-    # softmax recomputation; all-masked rows get 0 (their s is -inf, so
-    # exp(s - 0) = 0 keeps them inert)
-    lse_ref[0, :, 0] = jnp.where(l > 0, m + jnp.log(safe_l), 0.0)
+    # softmax recomputation and the ring merge; all-masked rows get the
+    # -inf sentinel so a logaddexp merge leaves them inert (the backward
+    # is protected by _recompute_p's explicit mask, not the sentinel)
+    lse_ref[0, :, 0] = jnp.where(l > 0, m + jnp.log(safe_l), _NEG_INF)
 
 
 def _pad_to(x, multiple, axis):
@@ -146,9 +180,30 @@ def _clamp_blocks(s, block_q, block_k):
     return min(block_q, s128), min(block_k, s128)
 
 
+def _group_of(q, k):
+    """Query-heads-per-kv-head group size; validates the GQA layout
+    (query head h reads kv head h // group — the repeat-expansion order)."""
+    h, h_kv = q.shape[2], k.shape[2]
+    if h_kv <= 0 or h % h_kv:
+        raise ValueError(
+            f"query heads ({h}) must be a multiple of kv heads ({h_kv})"
+        )
+    return h // h_kv
+
+
+def _off_arr(kv_offset):
+    """kv_offset scalar (possibly traced, possibly None) -> (1,) int32
+    array for the kernels' SMEM input."""
+    if kv_offset is None:
+        return jnp.zeros((1,), jnp.int32)
+    return jnp.asarray(kv_offset, jnp.int32).reshape(1)
+
+
 def _forward_impl(q, k, v, causal, block_q, block_k, interpret,
-                  with_lse=False, window=None):
+                  with_lse=False, window=None, kv_offset=None):
     b, s, h, d = q.shape
+    group = _group_of(q, k)
+    h_kv = h // group
     orig_s = s
     block_q, block_k = _clamp_blocks(s, block_q, block_k)
     qp = _pad_to(q, block_q, axis=1)
@@ -156,8 +211,8 @@ def _forward_impl(q, k, v, causal, block_q, block_k, interpret,
     vp = _pad_to(v, block_k, axis=1)
     s_q, s_k = qp.shape[1], kp.shape[1]
     qf = _fold(qp, b, h, d)
-    kf = _fold(kp, b, h, d)
-    vf = _fold(vp, b, h, d)
+    kf = _fold(kp, b, h_kv, d)
+    vf = _fold(vp, b, h_kv, d)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     kernel = functools.partial(
@@ -173,9 +228,15 @@ def _forward_impl(q, k, v, causal, block_q, block_k, interpret,
         kernel,
         grid=(b * h, s_q // block_q),
         in_specs=[
+            _SCALAR_SPEC,
             pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, s_k, d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, s_k, d), lambda bh, qi: (bh, 0, 0)),
+            # GQA: the whole query-head group reads ONE kv head's K/V —
+            # consecutive programs share the block, so it is fetched from
+            # HBM once per kv head, not once per query head
+            pl.BlockSpec((1, s_k, d),
+                         lambda bh, qi: (bh // group, 0, 0)),
+            pl.BlockSpec((1, s_k, d),
+                         lambda bh, qi: (bh // group, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
@@ -188,7 +249,7 @@ def _forward_impl(q, k, v, causal, block_q, block_k, interpret,
             jax.ShapeDtypeStruct((b * h, s_q, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(qf, kf, vf)
+    )(_off_arr(kv_offset), qf, kf, vf)
     out = _unfold(out, b, h, s_q, d)[:, :orig_s]
     if with_lse:
         return out, lse  # lse stays folded+padded: (B*H, S_q_padded)
@@ -196,9 +257,11 @@ def _forward_impl(q, k, v, causal, block_q, block_k, interpret,
 
 
 def _recompute_p(q_blk, k_blk, lse_blk, q_off, k_off, *, sm_scale, causal,
-                 seq_len, block_q, block_k, window=None):
+                 seq_len, block_q, block_k, window=None, kv_off=0):
     """Exact softmax probabilities of one (block_q, block_k) tile from
-    the saved logsumexp — shared by both backward kernels."""
+    the saved logsumexp — shared by both backward kernels.  Masked
+    entries are zeroed EXPLICITLY (not via the lse sentinel), so padded
+    rows and wholly-out-of-window rows stay inert whatever their lse."""
     s = jax.lax.dot_general(
         q_blk.astype(jnp.float32) * sm_scale, k_blk.astype(jnp.float32),
         dimension_numbers=(((1,), (1,)), ((), ())),
@@ -211,17 +274,17 @@ def _recompute_p(q_blk, k_blk, lse_blk, q_off, k_off, *, sm_scale, causal,
         jnp.int32, (block_q, block_k), 1
     )
     mask = jnp.logical_and(
-        _tile_mask(q_pos, k_pos, causal, window, seq_len),
+        _tile_mask(q_pos, k_pos, causal, window, seq_len, kv_off),
         q_pos < seq_len,
     )
-    s = jnp.where(mask, s, _NEG_INF)
-    return jnp.exp(s - lse_blk[:, None])  # masked entries: exp(-inf-.)=0
+    return jnp.where(mask, jnp.exp(s - lse_blk[:, None]), 0.0)
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   *, sm_scale, causal, block_q, block_k, seq_len,
-                   window=None):
+def _bwd_dq_kernel(kvoff_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                   delta_ref, dq_ref, *, sm_scale, causal, block_q,
+                   block_k, seq_len, window=None):
     qi = pl.program_id(1)
+    kv_off = kvoff_ref[0]
     q_off = qi * block_q
     q = q_ref[0]
     do = do_ref[0].astype(jnp.float32)
@@ -235,7 +298,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         p = _recompute_p(
             q, k_blk, lse, q_off, k_off, sm_scale=sm_scale, causal=causal,
             seq_len=seq_len, block_q=block_q, block_k=block_k,
-            window=window,
+            window=window, kv_off=kv_off,
         )
         dp = jax.lax.dot_general(
             do, v_blk.astype(jnp.float32),
@@ -250,120 +313,149 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         )
 
     lo_kb, n_kb = _kb_range(q_off, block_q, block_k,
-                            k_ref.shape[1] // block_k, causal, window)
+                            k_ref.shape[1] // block_k, causal, window,
+                            kv_off)
     dq = jax.lax.fori_loop(
         lo_kb, n_kb, body, jnp.zeros((block_q, q.shape[-1]), jnp.float32)
     )
     dq_ref[0] = (dq * sm_scale).astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, sm_scale, causal, block_q, block_k,
-                    seq_len, window=None):
+def _bwd_dkv_kernel(kvoff_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                    delta_ref, dk_ref, dv_ref, *, sm_scale, causal,
+                    block_q, block_k, seq_len, window=None, group=1):
+    """dK/dV for ONE kv head's K block: the q-side operands arrive with
+    the whole query-head group concatenated on the row axis
+    ((1, group*s_q, d) blocks), and the group's contributions accumulate
+    into the same (block_k, d) dK/dV — this is the GQA dK/dV reduction
+    done in VMEM, with K/V loaded once per kv head."""
     ki = pl.program_id(1)
+    kv_off = kvoff_ref[0]
     k_off = ki * block_k
     k_blk = k_ref[0]
     v_blk = v_ref[0]
     d = k_blk.shape[-1]
+    s_q = q_ref.shape[1] // group  # per-query-head padded length
+    n_qb = s_q // block_q
 
-    def body(qb, carry):
-        dk, dv = carry
-        q_off = qb * block_q
-        q_blk = q_ref[0, pl.ds(q_off, block_q), :]
-        do_blk = do_ref[0, pl.ds(q_off, block_q), :].astype(jnp.float32)
-        lse_blk = lse_ref[0, pl.ds(q_off, block_q), 0]
-        delta_blk = delta_ref[0, pl.ds(q_off, block_q), 0]
-        p = _recompute_p(
-            q_blk, k_blk, lse_blk, q_off, k_off, sm_scale=sm_scale,
-            causal=causal, seq_len=seq_len, block_q=block_q,
-            block_k=block_k, window=window,
-        )
-        dv = dv + jax.lax.dot_general(
-            p, do_blk,
-            dimension_numbers=(((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        dp = jax.lax.dot_general(
-            do_blk, v_blk.astype(jnp.float32),
-            dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        ds = p * (dp - delta_blk[:, None])
-        dk = dk + jax.lax.dot_general(
-            ds, q_blk.astype(jnp.float32),
-            dimension_numbers=(((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        return dk, dv
-
-    n_qb = q_ref.shape[1] // block_q
     # Which Q blocks can see this K block = _kb_range with the q/k roles
-    # transposed (the window reach is symmetric).  Causality is NOT
-    # symmetric: it becomes a LOWER bound here (the first Q block at or
-    # after the diagonal), overriding the transposed call's start.
+    # transposed (the offset flips sign, the window reach is symmetric).
+    # Causality is NOT symmetric: it becomes a LOWER bound here (the
+    # first Q block at or after the shifted diagonal), joined by max.
     qb_start, qb_stop = _kb_range(k_off, block_k, block_q, n_qb,
-                                  False, window)
+                                  False, window, -kv_off)
     if causal:
-        qb_start = k_off // block_q
-    dk, dv = jax.lax.fori_loop(
-        qb_start, qb_stop, body,
-        (jnp.zeros((block_k, d), jnp.float32),
-         jnp.zeros((block_k, d), jnp.float32)),
-    )
+        qb_start = jnp.maximum(
+            qb_start,
+            jnp.maximum(0, jnp.floor_divide(k_off + kv_off, block_q)))
+
+    dk = jnp.zeros((block_k, d), jnp.float32)
+    dv = jnp.zeros((block_k, d), jnp.float32)
+    for g in range(group):  # static unroll over the query-head group
+        base = g * s_q
+
+        def body(qb, carry, base=base):
+            dk, dv = carry
+            q_off = qb * block_q
+            q_blk = q_ref[0, pl.ds(base + q_off, block_q), :]
+            do_blk = do_ref[0, pl.ds(base + q_off, block_q), :].astype(
+                jnp.float32)
+            lse_blk = lse_ref[0, pl.ds(base + q_off, block_q), 0]
+            delta_blk = delta_ref[0, pl.ds(base + q_off, block_q), 0]
+            p = _recompute_p(
+                q_blk, k_blk, lse_blk, q_off, k_off, sm_scale=sm_scale,
+                causal=causal, seq_len=seq_len, block_q=block_q,
+                block_k=block_k, window=window, kv_off=kv_off,
+            )
+            dv = dv + jax.lax.dot_general(
+                p, do_blk,
+                dimension_numbers=(((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            dp = jax.lax.dot_general(
+                do_blk, v_blk.astype(jnp.float32),
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - delta_blk[:, None])
+            dk = dk + jax.lax.dot_general(
+                ds, q_blk.astype(jnp.float32),
+                dimension_numbers=(((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            return dk, dv
+
+        dk, dv = jax.lax.fori_loop(qb_start, qb_stop, body, (dk, dv))
     dk_ref[0] = (dk * sm_scale).astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
 def _backward_folded(qf, kf, vf, gf, lse_f, delta_f, *, orig_s, causal,
-                     block_q, block_k, interpret, window=None):
+                     block_q, block_k, interpret, window=None,
+                     kv_offset=None):
     """Backward kernels over already folded+padded operands — the ring
     calls this directly so the fold/pad of the step-invariant q/g/lse/
     delta happens once, not once per ring step.  Shapes: qf/gf
-    (BH, s_q, d), kf/vf (BH, s_k, d), lse_f/delta_f (BH, s_q, 1).
-    Returns folded (dq, dk, dv)."""
+    (B*H, s_q, d), kf/vf (B*H_kv, s_k, d) with H_kv | H (GQA),
+    lse_f/delta_f (B*H, s_q, 1).  Returns folded (dq, dk, dv) with
+    dk/dv per KV head."""
     bh, s_q, d = qf.shape
+    bh_kv = kf.shape[0]
+    if bh_kv <= 0 or bh % bh_kv:
+        raise ValueError(f"folded q heads ({bh}) must be a multiple of "
+                         f"folded kv heads ({bh_kv})")
+    group = bh // bh_kv
     s_k = kf.shape[1]
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    off = _off_arr(kv_offset)
     kw = dict(sm_scale=1.0 / (d ** 0.5), causal=causal, block_q=block_q,
               block_k=block_k, seq_len=orig_s, window=window)
-    b_h = bh  # grid leading dim
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, **kw),
-        grid=(b_h, s_q // block_q),
+        grid=(bh, s_q // block_q),
         in_specs=[
+            _SCALAR_SPEC,
             pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, s_k, d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, s_k, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, s_k, d), lambda bh, qi: (bh // group, 0, 0)),
+            pl.BlockSpec((1, s_k, d), lambda bh, qi: (bh // group, 0, 0)),
             pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
             pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0)),
             pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b_h, s_q, d), qf.dtype),
+        out_shape=jax.ShapeDtypeStruct((bh, s_q, d), qf.dtype),
         interpret=interpret,
-    )(qf, kf, vf, gf, lse_f, delta_f)
+    )(off, qf, kf, vf, gf, lse_f, delta_f)
+    # dK/dV per KV head: regroup the q-side operands so each kv-head
+    # program sees its whole query-head group on the row axis — a free
+    # reshape of the head-major fold (B, H_kv, G, s_q, d contiguity)
+    qg = qf.reshape(bh_kv, group * s_q, d)
+    gg = gf.reshape(bh_kv, group * s_q, d)
+    lse_g = lse_f.reshape(bh_kv, group * s_q, 1)
+    delta_g = delta_f.reshape(bh_kv, group * s_q, 1)
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, **kw),
-        grid=(b_h, s_k // block_k),
+        functools.partial(_bwd_dkv_kernel, group=group, **kw),
+        grid=(bh_kv, s_k // block_k),
         in_specs=[
-            pl.BlockSpec((1, s_q, d), lambda bh, ki: (bh, 0, 0)),
+            _SCALAR_SPEC,
+            pl.BlockSpec((1, group * s_q, d), lambda bh, ki: (bh, 0, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, s_q, d), lambda bh, ki: (bh, 0, 0)),
-            pl.BlockSpec((1, s_q, 1), lambda bh, ki: (bh, 0, 0)),
-            pl.BlockSpec((1, s_q, 1), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, group * s_q, d), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, group * s_q, 1), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, group * s_q, 1), lambda bh, ki: (bh, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b_h, s_k, d), kf.dtype),
-            jax.ShapeDtypeStruct((b_h, s_k, d), vf.dtype),
+            jax.ShapeDtypeStruct((bh_kv, s_k, d), kf.dtype),
+            jax.ShapeDtypeStruct((bh_kv, s_k, d), vf.dtype),
         ],
         interpret=interpret,
-    )(qf, kf, vf, gf, lse_f, delta_f)
+    )(off, qg, kf, vf, gg, lse_g, delta_g)
     return dq, dk, dv
 
 
@@ -386,14 +478,15 @@ def _fold_bwd_invariants(q, out, lse, g, block_q):
 def _backward_impl(q, k, v, out, lse, g, causal, block_q, block_k,
                    interpret, window=None):
     b, s, h, d = q.shape
+    h_kv = k.shape[2]
     orig_s = s
     block_q, block_k = _clamp_blocks(s, block_q, block_k)
     # lse arrives from the forward already folded and padded to the same
     # s_q (identical block clamp on identical shapes) — _fold_bwd_
     # invariants' pad is then a no-op on it
     qf, gf, lse_f, delta_f = _fold_bwd_invariants(q, out, lse, g, block_q)
-    kf = _fold(_pad_to(k, block_k, axis=1), b, h, d)
-    vf = _fold(_pad_to(v, block_k, axis=1), b, h, d)
+    kf = _fold(_pad_to(k, block_k, axis=1), b, h_kv, d)
+    vf = _fold(_pad_to(v, block_k, axis=1), b, h_kv, d)
     s_q, s_k = qf.shape[1], kf.shape[1]
     dq, dk, dv = _backward_folded(
         qf, kf, vf, gf, lse_f, delta_f, orig_s=orig_s, causal=causal,
@@ -401,8 +494,8 @@ def _backward_impl(q, k, v, out, lse, g, causal, block_q, block_k,
         window=window,
     )
     dq = _unfold(dq, b, h, s_q, d)[:, :orig_s]
-    dk = _unfold(dk, b, h, s_k, d)[:, :orig_s]
-    dv = _unfold(dv, b, h, s_k, d)[:, :orig_s]
+    dk = _unfold(dk, b, h_kv, s_k, d)[:, :orig_s]
+    dv = _unfold(dv, b, h_kv, s_k, d)[:, :orig_s]
     return dq, dk, dv
 
 
@@ -417,12 +510,17 @@ def _backward_impl(q, k, v, out, lse, g, causal, block_q, block_k,
 
 
 def flash_block_forward(q, k, v, causal, block_q=256, block_k=256,
-                        interpret=None):
+                        interpret=None, window=None, kv_offset=None):
     """Returns (out, lse) with out (B,S,H,D) normalized within this KV
-    block and lse (B,S,H) float32 = log-sum-exp of this block's logits."""
+    block and lse (B,S,H) float32 = log-sum-exp of this block's logits
+    (the -inf sentinel for rows this block cannot reach, so a logaddexp
+    merge leaves them untouched).  ``kv_offset`` is the global position
+    of k[0] minus the global position of q[0] — the ring passes the
+    per-step shard offset so ``window`` masks global positions."""
     b, s, h, d = q.shape
     out, lse_f = _forward_impl(
-        q, k, v, causal, block_q, block_k, interpret, with_lse=True
+        q, k, v, causal, block_q, block_k, interpret, with_lse=True,
+        window=window, kv_offset=kv_offset,
     )
     lse = lse_f[:, :, 0].reshape(b, h, -1)[:, :, :s].transpose(0, 2, 1)
     return out, lse
@@ -475,6 +573,13 @@ def flash_attention(
     numerics contract as ``models.transformer.causal_dot_attention``:
     softmax statistics in float32, output in the input dtype).
 
+    GQA: ``k``/``v`` may carry ``H_kv`` heads with ``H_kv | H`` (query
+    head ``h`` reads kv head ``h // (H/H_kv)``, the Llama-3 layout) —
+    the kernels share each K/V head across its query-head group, so K/V
+    HBM reads and the dK/dV accumulation shrink by ``H/H_kv``; never
+    materialize a repeat.  Gradients for k/v come back in their
+    own (B, S, H_kv, D) shape.
+
     Sequences that don't divide the block sizes are zero-padded and the
     pad keys masked out, so any S works.  Default 256-blocks are the
     robust v5e choice across chip-load conditions (tools/flash_bench.py;
@@ -491,4 +596,7 @@ def flash_attention(
     """
     if window is not None and window < 1:
         raise ValueError(f"window must be >= 1, got {window}")
+    if k.shape != v.shape:
+        raise ValueError(f"k/v shapes differ: {k.shape} vs {v.shape}")
+    _group_of(q, k)  # validate the GQA head split early
     return _flash(q, k, v, causal, block_q, block_k, interpret, window)

@@ -5,7 +5,9 @@ tensor parallelism, expert-parallel MoE and pipeline parallelism
 exist because on TPU the same mesh machinery makes them cheap and they
 are first-class in this framework's scope."""
 
-from .ring_attention import ring_attention, ring_flash_attention  # noqa: F401
+from .ring_attention import (  # noqa: F401
+    ring_attention, ring_flash_attention, ring_window_steps,
+)
 from .ulysses import heads_to_seq, seq_to_heads, ulysses_attention  # noqa: F401
 from .tensor_parallel import (  # noqa: F401
     ColumnParallelDense, RowParallelDense, TensorParallelAttention,

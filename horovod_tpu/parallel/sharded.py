@@ -49,8 +49,20 @@ class MultiAxisTransformer(nn.Module):
     """Decoder-only LM over the (dp, sp, tp) mesh.
 
     Inside shard_map, inputs arrive as the local (B/dp, S/sp) token
-    shard; attention composes TP head-sharding with Ulysses sequence
-    all-to-alls, so local head count H/tp must divide by sp.
+    shard; attention composes TP head-sharding with the selected
+    sequence-parallel scheme over ``sp``:
+
+      * ``attention_impl='ulysses'`` (default) — all-to-all re-shards
+        sequence↔heads around local attention, so the local head count
+        H/tp must divide by sp;
+      * ``'ring'`` / ``'ring_flash'`` — the sequence stays sharded and
+        K/V rotate over the sp axis (dense einsum blocks or pallas
+        flash blocks); no head-divisibility constraint on sp, and
+        ``window`` additionally truncates the causal rotation
+        (ring_window_steps) — the long-context composition the
+        flagship transformer exposes single-axis.
+
+    ``window`` (Mistral sliding window) routes into every impl.
     """
 
     vocab: int
@@ -59,6 +71,9 @@ class MultiAxisTransformer(nn.Module):
     num_layers: int
     seq_len: int  # GLOBAL sequence length
     dtype: jnp.dtype = jnp.float32
+    attention_impl: str = "ulysses"  # 'ulysses' | 'ring' | 'ring_flash'
+    causal: bool = True
+    window: Optional[int] = None
 
     @nn.compact
     def __call__(self, tokens):
@@ -80,10 +95,26 @@ class MultiAxisTransformer(nn.Module):
         def attn_fn(q, k, v):
             # SP_AXIS always exists on the (dp, sp, tp) mesh (size 1 when
             # sp folded away, where ulysses degenerates to local
-            # attention); passing None here would make ulysses look for
-            # the unbound world axis and crash at sp=1, tp>1
+            # attention and the ring to the single-chip kernels); passing
+            # None here would make either scheme look for the unbound
+            # world axis and crash at sp=1, tp>1
+            if self.attention_impl in ("ring", "ring_flash"):
+                from .ring_attention import ring_attention
+
+                return ring_attention(
+                    q, k, v, axis_name=SP_AXIS,
+                    impl="flash" if self.attention_impl == "ring_flash"
+                    else "dense",
+                    causal=self.causal, window=self.window,
+                )
+            if self.attention_impl != "ulysses":
+                raise ValueError(
+                    f"unknown attention_impl {self.attention_impl!r}; "
+                    "expected 'ulysses', 'ring' or 'ring_flash'"
+                )
             return ulysses_attention(
-                q, k, v, axis_name=SP_AXIS
+                q, k, v, axis_name=SP_AXIS, causal=self.causal,
+                window=self.window,
             )
 
         for i in range(self.num_layers):

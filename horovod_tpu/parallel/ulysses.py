@@ -52,22 +52,27 @@ def ulysses_attention(
     attn_fn: Optional[Callable] = None,
     impl: str = "dense",
     causal: bool = True,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Exact attention over a sequence-sharded axis via two all-to-alls.
 
     Args:
       q, k, v: (B, S_local, H, D) — the local sequence shard.  H must be
-        divisible by the axis size.
+        divisible by the axis size (under GQA, so must the K/V head
+        count H_kv: the all-to-all splits BOTH head dims).
       axis_name: mesh axis the sequence is sharded over (bound inside
         shard_map); defaults to the world axis.
       attn_fn: local attention callable ``(q, k, v) -> out`` on
         full-sequence, head-sharded tensors; overrides ``impl`` (and
-        ``causal`` — apply your own masking).
+        ``causal``/``window`` — apply your own masking).
       impl: with no ``attn_fn``, ``"dense"`` uses exact dot attention
         and ``"flash"`` the pallas flash kernel (the local attention runs
         over the FULL sequence with H/n heads, so flash's no-(S×S)-in-HBM
         property matters even more here than per ring block).
       causal: True = decoder mask; False = encoder/bidirectional.
+      window: Mistral-style sliding window, forwarded to the local
+        attention (global positions are local here — the all-to-all
+        restores the full sequence before attention runs).
     Returns:
       (B, S_local, H, D) output, sequence-sharded like the input.
     """
@@ -77,19 +82,22 @@ def ulysses_attention(
         if impl == "flash":
             from ..ops.flash_attention import flash_attention
 
-            attn_fn = functools.partial(flash_attention, causal=causal)
+            attn_fn = functools.partial(flash_attention, causal=causal,
+                                        window=window)
         elif impl == "dense":
             from ..models.transformer import causal_dot_attention
 
-            attn_fn = functools.partial(causal_dot_attention, causal=causal)
+            attn_fn = functools.partial(causal_dot_attention,
+                                        causal=causal, window=window)
         else:
             raise ValueError(f"unknown ulysses attention impl {impl!r}")
     if n == 1:
         return attn_fn(q, k, v)
-    h = q.shape[2]
-    if h % n:
+    h, h_kv = q.shape[2], k.shape[2]
+    if h % n or h_kv % n:
         raise ValueError(
-            f"ulysses needs heads ({h}) divisible by axis size ({n})"
+            f"ulysses needs query heads ({h}) and kv heads ({h_kv}) "
+            f"divisible by axis size ({n})"
         )
     q, k, v = (seq_to_heads(t, axis) for t in (q, k, v))
     out = attn_fn(q, k, v)  # (B, S, H/n, D), full sequence locally

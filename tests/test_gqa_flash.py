@@ -1,0 +1,269 @@
+"""Kernel-native GQA + windowed flash oracle tests.
+
+The flash kernels consume (B, S, H_kv, D) K/V directly (query head h
+reads kv head h // group); the oracle here is the PRE-GQA-native
+semantics — ``jnp.repeat`` K/V to full heads, then the unchanged MHA
+dense path — so any grouping bug in the kernels or the grouped dense
+einsums shows up as a numeric diff.  Gradients through the repeat
+oracle sum each kv head's group automatically (autodiff of repeat is
+the grouped sum), which pins the kernels' in-VMEM dK/dV accumulation.
+
+Also here: the `_kb_range` block-skip property test (the bounds the
+windowed kernels AND the bench's modeled columns both rely on) and the
+modeled-attention-bytes pin for the ~num_heads/num_kv_heads K/V
+traffic reduction (ISSUE 5 acceptance).
+"""
+
+import importlib.util
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu.models.transformer import causal_dot_attention
+from horovod_tpu.ops.flash_attention import (
+    _kb_range, flash_attention,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_flash_bench():
+    spec = importlib.util.spec_from_file_location(
+        "flash_bench", os.path.join(_REPO, "tools", "flash_bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _qkv(b, s, h, h_kv, d, dtype=jnp.float32, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    mk = lambda kk, heads: jax.random.normal(
+        kk, (b, s, heads, d), jnp.float32).astype(dtype)
+    return mk(ks[0], h), mk(ks[1], h_kv), mk(ks[2], h_kv)
+
+
+def repeat_oracle(q, k, v, causal=True, window=None):
+    """Pre-GQA-native semantics: expand K/V to full heads, MHA dense."""
+    g = q.shape[2] // k.shape[2]
+    return causal_dot_attention(
+        q, jnp.repeat(k, g, axis=2), jnp.repeat(v, g, axis=2),
+        causal=causal, window=window,
+    )
+
+
+def test_dense_gqa_matches_repeat_oracle():
+    """The grouped dense einsum (no materialized repeat) is numerically
+    the repeat+MHA computation."""
+    q, k, v = _qkv(2, 48, 4, 2, 16, seed=11)
+    for causal, window in ((True, None), (True, 7), (False, None),
+                           (False, 7)):
+        out = causal_dot_attention(q, k, v, causal=causal, window=window)
+        ref = repeat_oracle(q, k, v, causal=causal, window=window)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5,
+            err_msg=f"causal={causal} window={window}")
+
+
+def test_dense_rejects_bad_head_split():
+    q, k, v = _qkv(1, 8, 4, 3, 8)
+    with pytest.raises(ValueError, match="multiple"):
+        causal_dot_attention(q, k, v)
+    with pytest.raises(ValueError, match="multiple"):
+        flash_attention(q, k, v)
+
+
+@pytest.mark.parametrize("ratio", [2, 4])
+@pytest.mark.parametrize("causal,window", [(True, None), (True, 120),
+                                           (False, 120)])
+def test_flash_gqa_matches_oracle(ratio, causal, window):
+    """Grouped flash forward vs the repeat-dense reference across the
+    causal x window x ratio grid (S=320 crosses 128-block boundaries,
+    W=120 crosses them within a window)."""
+    q, k, v = _qkv(1, 320, 4, 4 // ratio, 32, seed=ratio)
+    out = flash_attention(q, k, v, causal=causal, window=window,
+                          block_q=128, block_k=128)
+    ref = repeat_oracle(q, k, v, causal=causal, window=window)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("window", [None, 150])
+def test_flash_gqa_gradients_match_oracle(window):
+    """GQA backward: dq per query head, dk/dv per KV head (the in-VMEM
+    group accumulation) vs autodiff through the repeat oracle — whose
+    repeat-transpose IS the grouped sum."""
+    q, k, v = _qkv(1, 320, 4, 2, 32, seed=5)
+
+    gf = jax.grad(
+        lambda a, b, c: (flash_attention(
+            a, b, c, window=window, block_q=128, block_k=128) ** 2).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    gd = jax.grad(
+        lambda a, b, c: (repeat_oracle(a, b, c, window=window) ** 2).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    assert gf[1].shape == k.shape and gf[2].shape == v.shape
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-3)
+
+
+def test_flash_gqa_bf16():
+    q, k, v = _qkv(1, 256, 4, 1, 32, dtype=jnp.bfloat16, seed=7)
+    out = flash_attention(q, k, v, block_q=128, block_k=128)
+    ref = repeat_oracle(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        rtol=2e-2, atol=2e-2)
+
+
+@pytest.mark.slow
+def test_flash_gqa_exhaustive_grid():
+    """Full causal x window x ratio x dtype sweep (slow tier; the fast
+    tier covers the representative corners above)."""
+    for ratio in (1, 2, 4):
+        for causal in (True, False):
+            for window in (None, 1, 33, 120):
+                for dtype, tol in ((jnp.float32, 2e-5),
+                                   (jnp.bfloat16, 2e-2)):
+                    q, k, v = _qkv(1, 272, 4, 4 // ratio, 16,
+                                   dtype=dtype, seed=ratio)
+                    out = flash_attention(q, k, v, causal=causal,
+                                          window=window, block_q=128,
+                                          block_k=128)
+                    ref = repeat_oracle(q, k, v, causal=causal,
+                                        window=window)
+                    np.testing.assert_allclose(
+                        np.asarray(out, np.float32),
+                        np.asarray(ref, np.float32), rtol=tol, atol=tol,
+                        err_msg=f"ratio={ratio} causal={causal} "
+                                f"window={window} dtype={dtype}")
+
+
+# -- _kb_range block-skip bounds --------------------------------------------
+
+
+def _brute_blocks(q_off, block_q, block_k, padded_kb, causal, window,
+                  kv_off):
+    """Brute-force: K blocks holding >= 1 (q, k) pair unmasked by the
+    causal/window terms (padding excluded — _kb_range doesn't see it)."""
+    blocks = set()
+    for kb in range(padded_kb):
+        hit = False
+        for qp in range(q_off, q_off + block_q):
+            for kp in range(kb * block_k, (kb + 1) * block_k):
+                rel = qp - kp - kv_off
+                if causal and rel < 0:
+                    continue
+                if window is not None:
+                    if rel >= window or (not causal and rel <= -window):
+                        continue
+                hit = True
+                break
+            if hit:
+                break
+        if hit:
+            blocks.add(kb)
+    return blocks
+
+
+def _bounds_int(fn, *args):
+    lo, hi = fn(*args)
+    return int(lo), int(hi)
+
+
+def test_kb_range_bounds_property():
+    """kv_off=0 (self/diagonal attention): [lo, hi) covers EXACTLY the
+    causal/window-unmasked K blocks — no block skipped that has work,
+    no empty block visited at either edge.  The bench's pure-python
+    mirror (tools/flash_bench.kb_bounds) must agree bit-for-bit."""
+    fb = _load_flash_bench()
+    for block_q, block_k in ((64, 64), (128, 64), (64, 128)):
+        for padded_kb in (2, 3):
+            s_k = padded_kb * block_k
+            for q_off in range(0, s_k, block_q):
+                for causal in (True, False):
+                    for window in (None, 1, 17, 100, 1000):
+                        want = _brute_blocks(q_off, block_q, block_k,
+                                             padded_kb, causal, window, 0)
+                        lo, hi = _bounds_int(_kb_range, q_off, block_q,
+                                             block_k, padded_kb, causal,
+                                             window, 0)
+                        got = set(range(lo, hi))
+                        assert got == want, (
+                            f"bq={block_q} bk={block_k} kb={padded_kb} "
+                            f"q_off={q_off} causal={causal} "
+                            f"window={window}: {sorted(got)} != "
+                            f"{sorted(want)}")
+                        assert (lo, hi) == fb.kb_bounds(
+                            q_off, block_q, block_k, padded_kb, causal,
+                            window, 0)
+
+
+def test_kb_range_bounds_with_offset():
+    """kv_off != 0 (ring off-diagonal blocks): the bounds must CONTAIN
+    every unmasked block (correctness — a skipped block with work would
+    silently drop attention mass), and the bench mirror agrees."""
+    fb = _load_flash_bench()
+    rng = np.random.RandomState(0)
+    for _ in range(200):
+        block_q = int(rng.choice([32, 64]))
+        block_k = int(rng.choice([32, 64]))
+        padded_kb = int(rng.randint(1, 4))
+        q_off = int(rng.randint(0, 3)) * block_q
+        causal = bool(rng.randint(2))
+        window = [None, 1, 9, 50][rng.randint(4)]
+        kv_off = int(rng.randint(-3, 4)) * 32
+        want = _brute_blocks(q_off, block_q, block_k, padded_kb, causal,
+                             window, kv_off)
+        lo, hi = _bounds_int(_kb_range, q_off, block_q, block_k,
+                             padded_kb, causal, window, kv_off)
+        assert want <= set(range(lo, hi)), (
+            f"bq={block_q} bk={block_k} kb={padded_kb} q_off={q_off} "
+            f"causal={causal} window={window} kv_off={kv_off}: "
+            f"{sorted(want)} not within [{lo}, {hi})")
+        assert (lo, hi) == fb.kb_bounds(q_off, block_q, block_k,
+                                        padded_kb, causal, window, kv_off)
+
+
+# -- modeled K/V traffic (ISSUE 5 acceptance pin) ---------------------------
+
+
+def test_modeled_kv_bytes_shrink_by_group():
+    """The bench's modeled-bytes column: flash GQA K/V HBM reads are
+    exactly num_heads/num_kv_heads smaller than MHA, and the total
+    (incl. the repeat materialization the old path paid) shrinks
+    accordingly."""
+    fb = _load_flash_bench()
+    b, s, h, d = 4, 2048, 8, 128
+    mha = fb.modeled_attention_bytes(b, s, h, h, d)
+    for h_kv in (4, 2, 1):
+        gqa = fb.modeled_attention_bytes(b, s, h, h_kv, d)
+        assert gqa["kv_bytes"] * (h // h_kv) == mha["kv_bytes"]
+        baseline = fb.modeled_repeat_baseline_bytes(b, s, h, h_kv, d)
+        # old path: repeat materialization + MHA-sized kernel reads
+        assert baseline["kv_bytes"] == mha["kv_bytes"]
+        assert baseline["repeat_io_bytes"] > 0
+        assert baseline["total_bytes"] > mha["total_bytes"]
+        assert gqa["total_bytes"] < mha["total_bytes"]
+    # MHA "baseline" pays no repeat traffic (repeat(1) is a no-op)
+    assert fb.modeled_repeat_baseline_bytes(
+        b, s, h, h, d)["repeat_io_bytes"] == 0
+
+
+def test_modeled_flops_drop_with_window():
+    fb = _load_flash_bench()
+    full = fb.modeled_attention_flops(1, 4096, 8, 128, causal=True,
+                                      window=None)
+    prev = full
+    for w in (2048, 1024, 512, 256):
+        f = fb.modeled_attention_flops(1, 4096, 8, 128, causal=True,
+                                       window=w)
+        assert f <= prev
+        prev = f
+    # O(S·W): at W=256 with 256-blocks, each Q block visits <= 3 K blocks
+    assert prev <= 4 * 1 * 8 * 256 * 256 * 128 * (4096 // 256) * 3

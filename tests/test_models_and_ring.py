@@ -438,8 +438,8 @@ def test_ring_attention_windowed_matches_dense():
 def test_window_config_plumbing():
     """TransformerConfig.window reaches the mask (windowed logits differ
     from unwindowed), the flash impl agrees with dot under a window, and
-    the unsupported ring_flash path rejects it with guidance."""
-    import pytest as _pytest
+    ring_flash+window — rejected before the windowed merge landed — now
+    constructs."""
     from horovod_tpu.models.transformer import TransformerConfig
 
     tokens = jnp.asarray([[3, 1, 4, 1, 5, 9, 2, 6]])
@@ -457,11 +457,13 @@ def test_window_config_plumbing():
         logits(window=2, attention_impl="flash"), logits(window=2),
         rtol=1e-4, atol=1e-5)
 
-    with _pytest.raises(ValueError, match="window"):
-        TransformerConfig(
-            vocab_size=32, num_layers=1, num_heads=2, head_dim=8,
-            max_seq_len=8, window=2, attention_impl="ring_flash",
-            seq_axis_name="hvd")
+    # the windowed ring-flash merge composes at config time now; the
+    # numerics are pinned by test_transformer_ring_flash_windowed_parity
+    cfg = TransformerConfig(
+        vocab_size=32, num_layers=1, num_heads=2, head_dim=8,
+        max_seq_len=8, window=2, attention_impl="ring_flash",
+        seq_axis_name="hvd")
+    assert cfg.window == 2
 
 
 def test_gqa_attention():
@@ -515,8 +517,8 @@ def test_gqa_attention():
 
 
 def test_gqa_under_ring_attention():
-    """The config comment claims every impl works unchanged under GQA
-    (K/V repeated to full heads before the kernels) — pin it for ring:
+    """Every impl consumes GQA K/V natively (grouped einsums — only the
+    kv heads rotate the ring, no repeat) — pin it for ring:
     sharded-ring logits match the single-device dot model."""
     from horovod_tpu.models.transformer import TransformerConfig
 
@@ -546,6 +548,242 @@ def test_gqa_under_ring_attention():
         out.reshape((s_global,) + out.shape[2:]), 0, 1)
     np.testing.assert_allclose(np.asarray(ring_logits), dense_logits,
                                rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("W", [3, 6])
+def test_ring_flash_windowed_matches_dense(W):
+    """Windowed flash-block ring (per-step kv_offset into the kernels +
+    truncated rotation) vs the single-device windowed dot oracle; both
+    windows cross the 4-wide shard boundaries (W=6) or sit inside one
+    (W=3, where the rotation truncates to 2 of 8 steps)."""
+    b, s_global, h, d = 1, 32, 2, 8
+    s_local = s_global // N
+    key = jax.random.PRNGKey(29)
+    q = jax.random.normal(jax.random.fold_in(key, 0), (b, s_global, h, d))
+    k = jax.random.normal(jax.random.fold_in(key, 1), (b, s_global, h, d))
+    v = jax.random.normal(jax.random.fold_in(key, 2), (b, s_global, h, d))
+
+    dense = causal_dot_attention(q, k, v, window=W)
+
+    def per_rank(r):
+        sl = lambda t: jax.lax.dynamic_slice_in_dim(
+            t, r * s_local, s_local, axis=1
+        )
+        out = ring_attention(sl(q), sl(k), sl(v), impl="flash", window=W)
+        return jnp.swapaxes(out, 0, 1)
+
+    out = hvd.run_per_rank(per_rank)
+    ring = jnp.moveaxis(out.reshape((s_global,) + out.shape[2:]), 0, 1)
+    np.testing.assert_allclose(
+        np.asarray(ring), np.asarray(dense), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_ring_flash_windowed_bidirectional_matches_dense():
+    """Bidirectional window through the flash-block ring: symmetric
+    global-position reach, no rotation truncation (shards must transit
+    the full ring), per-chip kernel masking only."""
+    b, s_global, h, d = 1, 32, 2, 8
+    s_local = s_global // N
+    key = jax.random.PRNGKey(31)
+    q = jax.random.normal(jax.random.fold_in(key, 0), (b, s_global, h, d))
+    k = jax.random.normal(jax.random.fold_in(key, 1), (b, s_global, h, d))
+    v = jax.random.normal(jax.random.fold_in(key, 2), (b, s_global, h, d))
+    W = 6
+
+    dense = causal_dot_attention(q, k, v, causal=False, window=W)
+
+    def per_rank(r):
+        sl = lambda t: jax.lax.dynamic_slice_in_dim(
+            t, r * s_local, s_local, axis=1
+        )
+        out = ring_attention(sl(q), sl(k), sl(v), impl="flash",
+                             causal=False, window=W)
+        return jnp.swapaxes(out, 0, 1)
+
+    out = hvd.run_per_rank(per_rank)
+    ring = jnp.moveaxis(out.reshape((s_global,) + out.shape[2:]), 0, 1)
+    np.testing.assert_allclose(
+        np.asarray(ring), np.asarray(dense), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_ring_flash_windowed_gradients_match_dense():
+    """Windowed flash-block ring backward: per-step kv_offset in both
+    backward kernels, truncated rotation, and the home-shift ppermute
+    returning the traveling dk/dv accumulators (steps < n exercises the
+    non-trivial shift)."""
+    b, s_global, h, d = 1, 16, 1, 8
+    s_local = s_global // N
+    W = 6  # steps = min(8, (6-2)//2 + 2) = 4 < 8: truncation active
+    key = jax.random.PRNGKey(33)
+    q = jax.random.normal(jax.random.fold_in(key, 0), (b, s_global, h, d))
+    k = jax.random.normal(jax.random.fold_in(key, 1), (b, s_global, h, d))
+    v = jax.random.normal(jax.random.fold_in(key, 2), (b, s_global, h, d))
+    w = jax.random.normal(jax.random.fold_in(key, 3), (b, s_global, h, d))
+
+    def dense_loss(q_, k_, v_):
+        return jnp.sum(causal_dot_attention(q_, k_, v_, window=W) * w)
+
+    want = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+
+    def per_rank(r):
+        sl = lambda t: jax.lax.dynamic_slice_in_dim(
+            t, r * s_local, s_local, axis=1
+        )
+
+        def loss(q_, k_, v_):
+            out = ring_attention(q_, k_, v_, impl="flash", window=W)
+            return jnp.sum(out * sl(w))
+
+        gq, gk, gv = jax.grad(loss, argnums=(0, 1, 2))(sl(q), sl(k), sl(v))
+        return jnp.swapaxes(jnp.stack([gq, gk, gv]), 1, 2)
+
+    out = hvd.run_per_rank(per_rank)  # (N, 3, s_local, b, h, d)
+    got = jnp.moveaxis(
+        out.transpose(1, 0, 2, 3, 4, 5).reshape(
+            (3, s_global) + out.shape[3:]
+        ), 1, 2,
+    )
+    for g_got, g_want in zip(got, want):
+        np.testing.assert_allclose(
+            np.asarray(g_got), np.asarray(g_want), rtol=1e-3, atol=1e-4
+        )
+
+
+def test_ring_flash_gqa_windowed_matches_oracle():
+    """Maximum composition: GQA (kv heads only rotate) x causal sliding
+    window x flash-block ring vs the repeat-expanded single-device dot
+    oracle."""
+    b, s_global, h, h_kv, d = 1, 32, 4, 2, 8
+    s_local = s_global // N
+    W = 6
+    key = jax.random.PRNGKey(37)
+    q = jax.random.normal(jax.random.fold_in(key, 0), (b, s_global, h, d))
+    k = jax.random.normal(jax.random.fold_in(key, 1),
+                          (b, s_global, h_kv, d))
+    v = jax.random.normal(jax.random.fold_in(key, 2),
+                          (b, s_global, h_kv, d))
+
+    dense = causal_dot_attention(
+        q, jnp.repeat(k, h // h_kv, axis=2),
+        jnp.repeat(v, h // h_kv, axis=2), window=W)
+
+    def per_rank(r):
+        sl = lambda t: jax.lax.dynamic_slice_in_dim(
+            t, r * s_local, s_local, axis=1
+        )
+        out = ring_attention(sl(q), sl(k), sl(v), impl="flash", window=W)
+        return jnp.swapaxes(out, 0, 1)
+
+    out = hvd.run_per_rank(per_rank)
+    ring = jnp.moveaxis(out.reshape((s_global,) + out.shape[2:]), 0, 1)
+    np.testing.assert_allclose(
+        np.asarray(ring), np.asarray(dense), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_ring_window_steps_truncation():
+    """The causal-window ring schedule skips whole out-of-window steps:
+    (a) ring_window_steps matches a brute force over which steps hold
+    any in-window (q, k) pair; (b) the step count is ASSERTED in the
+    traced program — the ring's rotation loop is the jaxpr's single
+    scan, whose static length is steps-1."""
+    import re
+
+    from jax.sharding import Mesh, PartitionSpec as P
+    from horovod_tpu.parallel.ring_attention import (
+        ring_flash_attention, ring_window_steps,
+    )
+
+    def brute(n, s_local, window):
+        steps = 1  # the resident/diagonal step always runs
+        for t in range(1, n):
+            if (t - 1) * s_local + 1 <= window - 1:
+                steps = t + 1
+        return min(steps, n)
+
+    for n in (2, 4, 8):
+        for s_local in (1, 2, 4, 8):
+            assert ring_window_steps(n, s_local, True, None) == n
+            assert ring_window_steps(n, s_local, False, 3) == n
+            for window in range(1, 3 * n * s_local):
+                assert ring_window_steps(n, s_local, True, window) == \
+                    brute(n, s_local, window), \
+                    f"n={n} s_local={s_local} window={window}"
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("x",))
+    s_local = 4
+
+    def scan_length(window):
+        def f(q):
+            return jax.shard_map(
+                lambda a: ring_flash_attention(
+                    a, a, a, axis_name="x", window=window,
+                    block_q=128, block_k=128),
+                mesh=mesh, in_specs=P(None, "x"), out_specs=P(None, "x"),
+                check_vma=False,
+            )(q)
+        q = jnp.zeros((1, 8 * s_local, 2, 8), jnp.float32)
+        lengths = re.findall(r"length=(\d+)", str(jax.make_jaxpr(f)(q)))
+        assert len(lengths) == 1  # the rotation loop is the only scan
+        return int(lengths[0])
+
+    assert scan_length(None) == 7  # full rotation: n-1 hops
+    assert scan_length(1) == 0  # W=1 attends self only: no hops at all
+    assert scan_length(6) == ring_window_steps(8, s_local, True, 6) - 1
+    assert scan_length(2 * 8 * s_local) == 7  # window >= S: full again
+
+
+def test_transformer_ring_flash_windowed_parity():
+    """ISSUE 5 acceptance: TransformerConfig(attention_impl='ring_flash',
+    window=W) constructs and TRAINS — sharded logits match the dense
+    single-device windowed model and a grad step is finite."""
+    import optax
+    from horovod_tpu.models.transformer import TransformerConfig
+
+    s_global = 32
+    s_local = s_global // N
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(41), (1, s_global), 0, 32)
+
+    def cfg_of(**kw):
+        return TransformerConfig(
+            vocab_size=32, num_layers=1, num_heads=4, num_kv_heads=2,
+            head_dim=8, max_seq_len=s_global, dtype=jnp.float32,
+            window=6, **kw)
+
+    model_d = Transformer(cfg_of())
+    params = model_d.init(jax.random.PRNGKey(42), tokens)
+    dense_logits = np.asarray(model_d.apply(params, tokens))
+
+    model_r = Transformer(
+        cfg_of(attention_impl="ring_flash", seq_axis_name="hvd"))
+
+    def per_rank(r):
+        local = jax.lax.dynamic_slice_in_dim(
+            tokens, r * s_local, s_local, axis=1
+        )
+
+        def loss_fn(p):
+            logits = model_r.apply(p, local)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, local).mean()
+
+        logits = model_r.apply(params, local)
+        loss, g = jax.value_and_grad(loss_fn)(params)
+        gnorm = sum(jnp.sum(x.astype(jnp.float32) ** 2)
+                    for x in jax.tree_util.tree_leaves(g))
+        return jnp.swapaxes(logits, 0, 1), loss, gnorm
+
+    logits, loss, gnorm = hvd.run_per_rank(per_rank)
+    ring_logits = jnp.moveaxis(
+        logits.reshape((s_global,) + logits.shape[2:]), 0, 1)
+    np.testing.assert_allclose(np.asarray(ring_logits), dense_logits,
+                               rtol=2e-3, atol=2e-3)
+    assert np.all(np.isfinite(np.asarray(loss)))
+    assert np.all(np.isfinite(np.asarray(gnorm)))
+    assert float(jnp.max(gnorm)) > 0
 
 
 def test_transformer_remat_matches_no_remat():

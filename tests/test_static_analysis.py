@@ -228,6 +228,22 @@ def test_env_undocumented_read_caught(tmp_path):
     assert findings[0].key == "HVD_TPU_SURPRISE"
 
 
+def test_env_tools_reads_scoped(tmp_path):
+    """tools/ scripts legitimize doc rows but never raise hygiene
+    findings: an undocumented tools-only read is ignored, and a row
+    backed only by a tools read is not stale."""
+    _write(tmp_path, _common.RUNNING_MD,
+           _SYN_RUNNING + "| `HVD_TPU_TOOL_DOCED` | bench knob |\n")
+    _write(tmp_path, "horovod_tpu/mod.py",
+           'import os\nv = os.environ.get("HVD_TPU_KNOWN")\n')
+    _write(tmp_path, "tools/bench.py", (
+        "import os\n"
+        'a = os.environ.get("HVD_TPU_TOOL_DOCED")\n'
+        'b = os.environ.get("HVD_TPU_TOOL_SURPRISE")\n'
+    ))
+    assert analysis.run_all(str(tmp_path), ["env"]) == []
+
+
 def test_env_stale_doc_row_caught(tmp_path):
     _write(tmp_path, _common.RUNNING_MD,
            _SYN_RUNNING + "| `HVD_TPU_GONE` | removed knob |\n")

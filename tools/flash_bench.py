@@ -1,8 +1,32 @@
 #!/usr/bin/env python
-"""Flash-attention kernel benchmark on the real chip: pallas vs the XLA
-dense attention (materialized S x S logits).  Chained iterations with a
-scalar fetch as the sync (axon contract, see PERF.md)."""
+"""Flash-attention kernel benchmark: pallas vs the XLA dense attention
+(materialized S x S logits), plus GQA-ratio and window-sweep legs.
 
+Every leg emits ONE bench-style JSON line on stdout (human summary on
+stderr) so the numbers are regression-trackable round over round.  The
+GQA legs carry a MODELED attention-bytes column — the HBM traffic the
+kernel's BlockSpecs imply (K/V fetched once per KV head, Q/out once per
+query head) — so the ``num_heads/num_kv_heads`` K/V-read reduction is
+pinned even on a CPU box where wall-clock runs in interpret mode; chip
+legs re-run when a TPU tunnel is attached.  The window legs carry the
+modeled-FLOPs column from the same block-skip bounds the kernels use
+(``kb_bounds`` mirrors ``ops.flash_attention._kb_range`` and is
+property-tested against it in tests/test_gqa_flash.py).
+
+Timing uses chained iterations with a scalar fetch as the sync (axon
+contract, see PERF.md).  ``HVD_TPU_BENCH_ITERS`` / ``HVD_TPU_BENCH_WARMUP``
+override the iteration counts (docs/running.md).
+
+Usage:
+  flash_bench.py                 # chip kernel legs (dense vs flash)
+  flash_bench.py --gqa           # GQA ratio sweep (1/2/4/8)
+  flash_bench.py --window        # window sweep at fixed S
+  flash_bench.py --smoke         # tiny interpret-mode pass of all legs
+                                 #  (CI: runs on the CPU workflow)
+"""
+
+import argparse
+import json
 import os
 import sys
 import time
@@ -12,11 +36,103 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 
+from horovod_tpu.common.retry import env_int  # noqa: E402
 from horovod_tpu.models.transformer import causal_dot_attention  # noqa: E402
-from horovod_tpu.ops.flash_attention import flash_attention  # noqa: E402
+from horovod_tpu.ops.flash_attention import (  # noqa: E402
+    _clamp_blocks, flash_attention,
+)
 
 
-def bench(fn, q, k, v, iters=20, warmup=3):
+# -- traffic / FLOP models ---------------------------------------------------
+#
+# _clamp_blocks is the KERNEL's clamp (imported, not mirrored), so the
+# modeled columns track exactly the tiling the kernels execute.
+
+
+def _pad(s, m):
+    return s + (-s) % m
+
+
+def kb_bounds(q_off, block_q, block_k, padded_kb, causal, window, kv_off=0):
+    """Pure-python mirror of ``ops.flash_attention._kb_range``: [lo, hi)
+    K-block loop bounds for one Q block (the windowed/causal block skip).
+    Property-tested against the kernel's version, so the modeled columns
+    below track exactly what the kernels execute."""
+    hi = padded_kb
+    if causal:
+        hi = min(hi, (q_off + block_q - 1 - kv_off) // block_k + 1)
+    elif window is not None:
+        hi = min(
+            hi, (q_off + block_q - 1 + window - 1 - kv_off) // block_k + 1)
+    if window is None:
+        lo = 0
+    else:
+        lo = max(0, (q_off - (window - 1) - kv_off) // block_k)
+    return lo, max(hi, 0)
+
+
+def _kv_tiles(s, causal, window, block_q, block_k):
+    """Total (Q block, K block) tile pairs the forward kernel visits."""
+    bq, bk = _clamp_blocks(s, block_q, block_k)
+    sq, sk = _pad(s, bq), _pad(s, bk)
+    tiles = 0
+    for qi in range(sq // bq):
+        lo, hi = kb_bounds(qi * bq, bq, bk, sk // bk, causal, window)
+        tiles += max(0, hi - lo)
+    return tiles
+
+
+def modeled_attention_bytes(b, s, h, h_kv, d,
+                            block_q=256, block_k=256, dtype_bytes=2):
+    """Modeled HBM bytes of ONE flash forward: Q and out stream once per
+    query head, K/V once per KV head (the GQA BlockSpec sharing), lse is
+    one f32 per row.  Returns a dict with the K/V component split out —
+    that component is what shrinks by num_heads/num_kv_heads.
+    Deliberately window-independent: the kernel streams the whole K/V
+    extent per program (the window's block-skip saves COMPUTE, not
+    bytes — see modeled_attention_flops)."""
+    bq, bk = _clamp_blocks(s, block_q, block_k)
+    sq, sk = _pad(s, bq), _pad(s, bk)
+    q_bytes = b * h * sq * d * dtype_bytes
+    kv_bytes = 2 * b * h_kv * sk * d * dtype_bytes
+    out_bytes = b * h * sq * d * dtype_bytes + b * h * sq * 4
+    return {
+        "q_bytes": q_bytes,
+        "kv_bytes": kv_bytes,
+        "out_bytes": out_bytes,
+        "total_bytes": q_bytes + kv_bytes + out_bytes,
+    }
+
+
+def modeled_repeat_baseline_bytes(b, s, h, h_kv, d,
+                                  block_q=256, block_k=256, dtype_bytes=2):
+    """The pre-GQA-native baseline: repeat K/V to full heads (read H_kv
+    heads, write H heads), then run the MHA kernel (which reads the
+    repeated H heads)."""
+    m = modeled_attention_bytes(b, s, h, h, d, block_q, block_k,
+                                dtype_bytes)
+    bq, bk = _clamp_blocks(s, block_q, block_k)
+    sk = _pad(s, bk)
+    # repeat(1) is a no-op — the MHA "baseline" pays no extra IO
+    repeat_io = (0 if h == h_kv
+                 else 2 * b * (h_kv + h) * sk * d * dtype_bytes)
+    return {**m, "repeat_io_bytes": repeat_io,
+            "total_bytes": m["total_bytes"] + repeat_io}
+
+
+def modeled_attention_flops(b, s, h, d, causal=True, window=None,
+                            block_q=256, block_k=256):
+    """MXU FLOPs of one flash forward from the block-skip bounds: two
+    (bq x d) @ (d x bk) matmuls per visited tile."""
+    bq, bk = _clamp_blocks(s, block_q, block_k)
+    tiles = _kv_tiles(s, causal, window, block_q, block_k)
+    return 4 * b * h * bq * bk * d * tiles
+
+
+# -- timing ------------------------------------------------------------------
+
+
+def bench(fn, q, k, v, iters, warmup):
     out = None
     for _ in range(warmup):
         out = fn(q, k, v)
@@ -30,29 +146,140 @@ def bench(fn, q, k, v, iters=20, warmup=3):
     return (time.perf_counter() - t0) / iters * 1e3
 
 
-def main():
-    print("backend:", jax.default_backend(), file=sys.stderr)
+def _qkv(b, s, h, h_kv, d, dtype=jnp.bfloat16, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    mk = lambda kk, heads: jax.random.normal(
+        kk, (b, s, heads, d), jnp.float32).astype(dtype)
+    return mk(ks[0], h), mk(ks[1], h_kv), mk(ks[2], h_kv)
+
+
+def _emit(rec, human):
+    rec["backend"] = jax.default_backend()
+    print(json.dumps(rec))
+    print(human, file=sys.stderr)
+
+
+# -- legs --------------------------------------------------------------------
+
+
+def leg_kernel(shapes, iters, warmup, interpret):
+    """Dense (materialized logits) vs flash at MHA shapes."""
     dense = jax.jit(causal_dot_attention)
-    for (b, s, h, d) in [(4, 1024, 8, 128), (4, 2048, 8, 128),
-                         (2, 4096, 8, 128)]:
-        ks = jax.random.split(jax.random.PRNGKey(0), 3)
-        q, k, v = (
-            jax.random.normal(kk, (b, s, h, d), jnp.float32)
-            .astype(jnp.bfloat16) for kk in ks
-        )
-        t_dense = bench(dense, q, k, v)
+    for (b, s, h, d) in shapes:
+        q, k, v = _qkv(b, s, h, h, d)
+        t_dense = bench(dense, q, k, v, iters, warmup)
         t_flash = bench(
-            lambda a, b_, c: flash_attention(a, b_, c, block_q=256,
-                                             block_k=256),
-            q, k, v,
+            lambda a, b_, c: flash_attention(a, b_, c, interpret=interpret),
+            q, k, v, iters, warmup,
         )
-        # causal attention FLOPs: ~0.5 * 2 * 2 * B*H*S^2*D (QK^T + PV)
         flops = 2 * b * h * s * s * d  # two matmuls, halved by causality
-        print(
+        _emit(
+            {"bench": "flash_kernel", "b": b, "s": s, "h": h, "d": d,
+             "dense_ms": round(t_dense, 3), "flash_ms": round(t_flash, 3),
+             "speedup": round(t_dense / t_flash, 3),
+             "flash_tflops": round(flops / (t_flash / 1e3) / 1e12, 2)},
             f"B{b} S{s} H{h} D{d}: dense {t_dense:7.2f} ms  "
-            f"flash {t_flash:7.2f} ms  speedup {t_dense / t_flash:4.2f}x  "
-            f"flash {flops / (t_flash / 1e3) / 1e12:.1f} TFLOP/s"
+            f"flash {t_flash:7.2f} ms  speedup {t_dense / t_flash:4.2f}x",
         )
+
+
+def leg_gqa(b, s, h, d, ratios, iters, warmup, interpret):
+    """GQA ratio sweep: kernel-native grouped K/V vs the repeat baseline
+    (materialize K/V at full heads, then the MHA kernel)."""
+    for ratio in ratios:
+        if h % ratio:
+            continue
+        h_kv = h // ratio
+        q, k, v = _qkv(b, s, h, h_kv, d)
+
+        def native(q_, k_, v_):
+            return flash_attention(q_, k_, v_, interpret=interpret)
+
+        @jax.jit
+        def repeat_baseline(q_, k_, v_):
+            k_ = jnp.repeat(k_, ratio, axis=2)
+            v_ = jnp.repeat(v_, ratio, axis=2)
+            return flash_attention(q_, k_, v_, interpret=interpret)
+
+        t_native = bench(native, q, k, v, iters, warmup)
+        t_repeat = bench(repeat_baseline, q, k, v, iters, warmup)
+        m = modeled_attention_bytes(b, s, h, h_kv, d)
+        m_rep = modeled_repeat_baseline_bytes(b, s, h, h_kv, d)
+        _emit(
+            {"bench": "flash_gqa", "b": b, "s": s, "h": h, "h_kv": h_kv,
+             "d": d, "ratio": ratio,
+             "native_ms": round(t_native, 3),
+             "repeat_ms": round(t_repeat, 3),
+             "kv_bytes": m["kv_bytes"],
+             "kv_bytes_repeat": m_rep["kv_bytes"] + m_rep["repeat_io_bytes"],
+             "attn_bytes": m["total_bytes"],
+             "attn_bytes_repeat": m_rep["total_bytes"],
+             "bytes_ratio": round(m_rep["total_bytes"] / m["total_bytes"],
+                                  3)},
+            f"GQA {h}/{h_kv} (x{ratio}): native {t_native:7.2f} ms  "
+            f"repeat {t_repeat:7.2f} ms  "
+            f"modeled bytes {m['total_bytes']:.3g} vs "
+            f"{m_rep['total_bytes']:.3g}",
+        )
+
+
+def leg_window(b, s, h, d, windows, iters, warmup, interpret,
+               block_q=256, block_k=256):
+    """Window sweep at fixed S: block-skip compute scaling."""
+    full_flops = modeled_attention_flops(b, s, h, d, causal=True,
+                                         window=None, block_q=block_q,
+                                         block_k=block_k)
+    for w in windows:
+        q, k, v = _qkv(b, s, h, h, d)
+        t = bench(
+            lambda a, b_, c: flash_attention(a, b_, c, window=w,
+                                             block_q=block_q,
+                                             block_k=block_k,
+                                             interpret=interpret),
+            q, k, v, iters, warmup,
+        )
+        flops = modeled_attention_flops(b, s, h, d, causal=True, window=w,
+                                        block_q=block_q, block_k=block_k)
+        _emit(
+            {"bench": "flash_window", "b": b, "s": s, "h": h, "d": d,
+             "window": w, "ms": round(t, 3), "modeled_flops": flops,
+             "flops_frac": round(flops / full_flops, 4)},
+            f"window {str(w):>6}: {t:7.2f} ms  "
+            f"modeled flops {flops / full_flops:5.1%} of full",
+        )
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--gqa", action="store_true")
+    ap.add_argument("--window", action="store_true")
+    ap.add_argument("--kernel", action="store_true")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny interpret-mode pass of every leg (CI)")
+    args = ap.parse_args(argv)
+
+    iters = env_int("HVD_TPU_BENCH_ITERS", 20)
+    warmup = env_int("HVD_TPU_BENCH_WARMUP", 3)
+    print("backend:", jax.default_backend(), file=sys.stderr)
+
+    if args.smoke:
+        # interpret mode, tiny shapes: proves the legs + JSON schema on
+        # any box; chip numbers come from the un-smoked legs on TPU
+        leg_kernel([(1, 256, 2, 64)], 2, 1, True)
+        leg_gqa(1, 256, 4, 64, (1, 2, 4), 2, 1, True)
+        leg_window(1, 384, 2, 64, (None, 128), 2, 1, True,
+                   block_q=128, block_k=128)
+        return 0
+
+    run_all = not (args.gqa or args.window or args.kernel)
+    if args.kernel or run_all:
+        leg_kernel([(4, 1024, 8, 128), (4, 2048, 8, 128),
+                    (2, 4096, 8, 128)], iters, warmup, None)
+    if args.gqa or run_all:
+        leg_gqa(4, 2048, 8, 128, (1, 2, 4, 8), iters, warmup, None)
+    if args.window or run_all:
+        leg_window(2, 4096, 8, 128,
+                   (None, 2048, 1024, 512, 256), iters, warmup, None)
     return 0
 
 
