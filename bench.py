@@ -371,6 +371,28 @@ def worker(mode: str, args) -> int:
     else:
         pipeline = {"mode": "device_resident"}
         input_wait_ms = 0.0
+    # memory-per-rank record (ISSUE 6): live-buffer accounting plus the
+    # optimizer-state split PERF.md's capacity arithmetic reasons about —
+    # `opt_state_bytes` is this run's replicated per-rank cost and
+    # `opt_state_bytes_zero` the modeled ZeRO-1 shard
+    # (horovod_tpu.optim.ZeroDistributedOptimizer) at this world size
+    from horovod_tpu.optim import state_bytes as _state_bytes
+
+    world = max(jax.device_count(), 1)
+    memory_per_rank = {
+        "params_bytes": int(_state_bytes(state.params)),
+        "opt_state_bytes": int(_state_bytes(state.opt_state)),
+        "opt_state_bytes_zero": int(-(-_state_bytes(state.opt_state)
+                                      // world)),
+        "world": world,
+    }
+    try:
+        memory_per_rank["live_buffer_bytes"] = int(sum(
+            int(getattr(a, "nbytes", 0) or 0) for a in jax.live_arrays()
+        ))
+    except Exception as e:  # accounting must never sink the bench line
+        print(f"[bench] live-array accounting unavailable: {e}",
+              file=sys.stderr)
     result = {
         "metric": "resnet50_synthetic_train_throughput",
         "value": round(img_per_sec, 2),
@@ -386,6 +408,7 @@ def worker(mode: str, args) -> int:
         "input_wait_pct": round(
             100.0 * input_wait_ms / max(dt / iters * 1e3, 1e-9), 2),
         "pipeline": pipeline,
+        "memory_per_rank": memory_per_rank,
     }
     if not on_tpu:
         # the record must say WHY it is a CPU number (probe failure or a

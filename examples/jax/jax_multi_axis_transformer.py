@@ -31,6 +31,9 @@ def main():
     p.add_argument("--heads", type=int, default=8)
     p.add_argument("--seq", type=int, default=256)
     p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--remat", default=None,
+                   help="activation-remat policy per block "
+                        "(none/dots/dots_no_batch/full — docs/OPTIM.md)")
     args = p.parse_args()
 
     hvd.init()
@@ -38,6 +41,7 @@ def main():
     model = sh.MultiAxisTransformer(
         vocab=1024, d_model=args.d_model, num_heads=args.heads,
         num_layers=args.layers, seq_len=args.seq, dtype=jnp.bfloat16,
+        remat_policy=args.remat,
     )
     variables, specs = sh.init_sharded(
         model, mesh, jax.random.PRNGKey(0), local_batch=2

@@ -97,8 +97,11 @@ from .compression import Compression
 from .sync_batch_norm import SyncBatchNorm
 from .optim import (
     DistributedOptimizer,
+    ZeroDistributedOptimizer,
+    ZeroSpmdOptimizer,
     allreduce_gradients,
     with_gradient_accumulation,
+    zero_opt_state_specs,
 )
 
 __version__ = "0.1.0"

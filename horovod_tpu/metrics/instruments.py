@@ -55,6 +55,28 @@ OP_LATENCY = histogram(
     ["op"],
 )
 
+# -- sharded optimizer (optim.py ZeRO wrappers) ------------------------------
+
+#: Flattened-gradient bytes submitted to the ZeRO reduce-scatter (padded
+#: buffer bytes per exchange; incremented at submission).
+OPTIM_RS_BYTES = counter(
+    "hvd_tpu_optim_reducescatter_bytes_total",
+    "Flattened gradient bytes submitted to the ZeRO reduce-scatter",
+)
+
+#: Updated-parameter shard bytes submitted to the ZeRO allgather.
+OPTIM_AG_BYTES = counter(
+    "hvd_tpu_optim_allgather_bytes_total",
+    "Updated parameter-shard bytes submitted to the ZeRO allgather",
+)
+
+#: This rank's sharded optimizer-state bytes (the ZeRO partition — about
+#: 1/world_size of the replicated state; set at wrapper init).
+OPTIM_STATE_SHARD_BYTES = gauge(
+    "hvd_tpu_optim_state_shard_bytes",
+    "Sharded optimizer-state bytes held by this rank (ZeRO partition)",
+)
+
 # -- native controller (native/controller.py) --------------------------------
 
 #: Entries currently awaiting a fused response (TensorQueue + pending

@@ -32,6 +32,61 @@ import jax.numpy as jnp
 import numpy as np
 
 
+# Named activation-remat policies for the decoder blocks (Chen et al.,
+# 2016 sublinear memory; jax.checkpoint / jax.checkpoint_policies).  What
+# the backward pass may READ from the forward without recomputing:
+#   none          — every intermediate saved (no remat; fastest, most HBM)
+#   dots          — MXU (matmul) outputs saved, elementwise/norm/softmax
+#                   recomputed (jax.checkpoint_policies.checkpoint_dots)
+#   dots_no_batch — only batch-free matmul outputs saved; in a decoder
+#                   block every dot carries the batch dim, so this
+#                   recomputes the whole block from its input (the
+#                   historical `remat=True` policy)
+#   full          — save nothing but the block input (jax.checkpoint's
+#                   default policy): minimum memory, ~1/3 extra FLOPs
+REMAT_POLICIES = {
+    "none": None,
+    "dots": "checkpoint_dots",
+    "dots_no_batch": "checkpoint_dots_with_no_batch_dims",
+    "full": None,
+}
+
+
+def _checkpoint_policy(name: str):
+    """The jax.checkpoint_policies member for a policy name (None = save
+    nothing, i.e. jax.checkpoint's default)."""
+    attr = REMAT_POLICIES[name]
+    return getattr(jax.checkpoint_policies, attr) if attr else None
+
+
+def resolve_remat_policies(policy, num_layers: int,
+                           default: str = "none"):
+    """Normalize a remat-policy selection to one name per block.
+
+    ``policy`` may be None (→ ``default`` everywhere), a single policy
+    name applied to every block, or a sequence of ``num_layers`` names
+    selecting per block (e.g. remat only the deep half of the stack).
+    """
+    if policy is None:
+        policy = default
+    if isinstance(policy, str):
+        policies = (policy,) * num_layers
+    else:
+        policies = tuple(policy)
+        if len(policies) != num_layers:
+            raise ValueError(
+                f"per-block remat policy needs {num_layers} entries, "
+                f"got {len(policies)}"
+            )
+    for p in policies:
+        if p not in REMAT_POLICIES:
+            raise ValueError(
+                f"unknown remat policy {p!r}; expected one of "
+                f"{sorted(REMAT_POLICIES)}"
+            )
+    return policies
+
+
 @dataclasses.dataclass(frozen=True)
 class TransformerConfig:
     vocab_size: int = 32000
@@ -71,8 +126,15 @@ class TransformerConfig:
     # rematerialize each decoder block in the backward pass: activation
     # memory drops from O(layers) to O(1) blocks at ~1/3 extra FLOPs —
     # the standard TPU memory/compute trade (jax.checkpoint) that lets
-    # long-context and large-batch configs fit HBM
+    # long-context and large-batch configs fit HBM.  Legacy boolean
+    # switch: True ≡ remat_policy="dots_no_batch" (kept for callers
+    # predating configurable policies).
     remat: bool = False
+    # Configurable activation-remat policy (docs/OPTIM.md policy
+    # matrix): None (derive from `remat`), a REMAT_POLICIES name applied
+    # to every block, or a tuple of num_layers names selecting PER
+    # BLOCK — e.g. ("none",)*6 + ("full",)*6 remats only the deep half.
+    remat_policy: Any = None
 
     def __post_init__(self):
         kv = self.num_kv_heads
@@ -81,6 +143,23 @@ class TransformerConfig:
                 f"num_heads ({self.num_heads}) must be a multiple of "
                 f"num_kv_heads ({kv})"
             )
+        if self.remat_policy is not None:
+            # normalize early so invalid names fail at config build, and
+            # store a hashable tuple (the dataclass is frozen/hashable)
+            object.__setattr__(
+                self, "remat_policy",
+                self.remat_policy if isinstance(self.remat_policy, str)
+                else tuple(self.remat_policy),
+            )
+            resolve_remat_policies(self.remat_policy, self.num_layers)
+
+    def block_remat_policies(self):
+        """Per-block policy names (``remat_policy`` resolved, with the
+        legacy ``remat`` bool as the default)."""
+        return resolve_remat_policies(
+            self.remat_policy, self.num_layers,
+            default="dots_no_batch" if self.remat else "none",
+        )
 
     @property
     def d_model(self) -> int:
@@ -262,19 +341,68 @@ class Transformer(nn.Module):
             dtype=cfg.dtype, name="embed",
         )
         x = emb(tokens)
-        block_cls = Block
-        if cfg.remat and train:
-            # save only MXU outputs at block boundaries; everything else
-            # recomputes in backward (flax-aware checkpoint transform)
-            block_cls = nn.remat(
-                Block,
-                policy=jax.checkpoint_policies
-                .checkpoint_dots_with_no_batch_dims,
-            )
+        # per-block remat policy (flax-aware checkpoint transform); one
+        # lifted class per distinct policy so identical policies share a
+        # transform
+        policies = cfg.block_remat_policies() if train else None
+        block_cls_for = {"none": Block}
         for i in range(cfg.num_layers):
+            pol = policies[i] if policies is not None else "none"
+            block_cls = block_cls_for.get(pol)
+            if block_cls is None:
+                block_cls = nn.remat(
+                    Block, policy=_checkpoint_policy(pol)
+                )
+                block_cls_for[pol] = block_cls
             x = block_cls(cfg, name=f"layer_{i}")(x, positions)
         x = nn.RMSNorm(dtype=cfg.dtype, epsilon=1e-5, name="ln_f")(x)
         return emb.attend(x.astype(jnp.float32))
+
+
+def modeled_activation_bytes(cfg: TransformerConfig, batch: int,
+                             seq: Optional[int] = None) -> dict:
+    """Modeled forward-to-backward activation bytes under the config's
+    remat policies — the capacity arithmetic PERF.md round 6 reasons
+    with (batch 1024 = "remat territory"), pinned by
+    tests/test_remat_policies.py.
+
+    Counts, per block, the tensors the backward READS without
+    recomputation (matmul inputs/outputs and nonlinear intermediates in
+    the activation dtype; attention-impl-agnostic — flash never
+    materializes the S×S probabilities, so no quadratic term appears):
+
+      none          — block input, ln1/ln2 outputs, q, k, v, attention
+                      context, gate, up, silu(gate)*up
+      dots          — block input + matmul outputs only (q, k, v,
+                      context, o-proj, gate, up, down-proj)
+      dots_no_batch — block input only (every decoder dot carries the
+                      batch dim, so the policy saves none of them)
+      full          — block input only
+
+    Returns ``{"total_bytes", "per_block_bytes": {policy: bytes},
+    "policies"}``; ``total_bytes`` sums the per-block figure over the
+    resolved per-block policies.
+    """
+    s = int(seq if seq is not None else cfg.max_seq_len)
+    act = jnp.dtype(cfg.dtype).itemsize
+    kv_heads = cfg.num_kv_heads or cfg.num_heads
+    bsd = batch * s * cfg.d_model * act          # one (B, S, D) tensor
+    kv = 2 * batch * s * kv_heads * cfg.head_dim * act   # K and V
+    f = batch * s * cfg.d_model * cfg.mlp_ratio * act    # one MLP hidden
+    per_block = {
+        "none": 5 * bsd + kv + 3 * f,   # input, ln1, q, ctx, ln2 + k,v
+                                        # + gate, up, silu(gate)*up
+        "dots": 5 * bsd + kv + 2 * f,   # input, q, ctx, o, down + k,v
+                                        # + gate, up
+        "dots_no_batch": bsd,           # block input only
+        "full": bsd,                    # block input only
+    }
+    policies = cfg.block_remat_policies()
+    return {
+        "total_bytes": sum(per_block[p] for p in policies),
+        "per_block_bytes": per_block,
+        "policies": policies,
+    }
 
 
 # Named sizes (flagship family; Llama-ish shapes for the pretrain config).

@@ -583,10 +583,23 @@ def reducescatter_async(
         )
     eng = _engine()
     with _span(name, "reducescatter", tensor):
-        result = jax.tree_util.tree_map(
-            lambda x: eng.reducescatter(jnp.asarray(x), op, process_set),
-            tensor,
-        )
+        leaves, treedef = jax.tree_util.tree_flatten(tensor)
+        multi = None
+        if len(leaves) > 1 and not _contains_tracer(leaves):
+            # multi-leaf burst (e.g. ZeRO's per-dtype gradient buffers):
+            # one compiled program for the whole pytree — the same
+            # fused/cached treatment allreduce gets via allreduce_multi
+            multi = eng.reducescatter_multi(
+                [jnp.asarray(x) for x in leaves], op, process_set
+            )
+        if multi is not None:
+            result = jax.tree_util.tree_unflatten(treedef, multi)
+        else:
+            result = jax.tree_util.tree_map(
+                lambda x: eng.reducescatter(jnp.asarray(x), op,
+                                            process_set),
+                tensor,
+            )
     return Handle(result)
 
 

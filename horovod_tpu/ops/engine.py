@@ -675,6 +675,64 @@ class CollectiveEngine:
             self._run(compiled, self._stacked_global(x, ctx))
         )
 
+    def reducescatter_multi(
+        self,
+        xs: Sequence[jax.Array],
+        op: ReduceOp = ReduceOp.SUM,
+        process_set: Optional[ProcessSet] = None,
+        max_signatures: int = 64,
+    ) -> Optional[List[jax.Array]]:
+        """N reducescatters in ONE compiled program — the reducescatter
+        sibling of :meth:`allreduce_multi`, giving the sharded-optimizer
+        burst (one flat gradient buffer per dtype, every step) the same
+        single-executable treatment the allreduce path has.  Returns
+        None when the caller should fall back to the per-tensor path:
+        non-SUM/AVERAGE ops, bool leaves, uneven dim0s, or more than
+        ``max_signatures`` distinct compositions already compiled (the
+        recompile-churn guard)."""
+        if op not in (ReduceOp.AVERAGE, ReduceOp.SUM):
+            return None
+        ctx = self._member_ctx(process_set)
+        xs = [jnp.asarray(x) for x in xs]
+        if any(x.dtype == jnp.bool_ or x.ndim == 0 for x in xs):
+            return None
+        if any(x.shape[0] % ctx.n for x in xs):
+            return None  # per-tensor path raises the descriptive error
+        if ctx.n == 1:
+            return list(xs)
+        n, me = ctx.n, ctx.me
+        key = (
+            "reducescatter_multi",
+            tuple((x.shape, str(x.dtype)) for x in xs),
+            int(op), me,
+        )
+        if key + (ctx.set_id,) not in self._cache:
+            n_sigs = sum(
+                1 for k in self._cache if k[0] == "reducescatter_multi"
+            )
+            if n_sigs >= max_signatures:
+                return None
+        chunks = [x.shape[0] // n for x in xs]
+        ones = [jnp.asarray(1.0, x.dtype) for x in xs]
+
+        def fn(*aa):
+            outs = []
+            for a, chunk, one in zip(aa, chunks, ones):
+                u = self._unique_rows(a, ctx)
+                r = _reduce_unique(u, op, n, one, one)
+                outs.append(
+                    jax.lax.dynamic_slice_in_dim(
+                        r, me * chunk, chunk, axis=0
+                    )
+                )
+            return tuple(outs)
+
+        compiled = self._compile(key, fn, ctx)
+        g = self._run(
+            compiled, *[self._stacked_global(x, ctx) for x in xs]
+        )
+        return [self._local_view(o) for o in g]
+
     def barrier(self, process_set: Optional[ProcessSet] = None) -> None:
         """Reference: BarrierOp (collective_operations.cc)."""
         ctx = self._member_ctx(process_set)
@@ -686,6 +744,16 @@ class CollectiveEngine:
         )
 
     # -- helpers ------------------------------------------------------------
+
+    def member_info(
+        self, process_set: Optional[ProcessSet] = None
+    ) -> Tuple[int, int]:
+        """(member count, this process's member index) of the set — the
+        (world, rank) a per-process sharded partition (ZeRO) is keyed
+        by.  The index order matches allgather's concatenation order and
+        reducescatter's chunk assignment (ascending process index)."""
+        ctx = self._member_ctx(process_set)
+        return ctx.n, ctx.me
 
     def _root_slot(self, root_rank: int, ctx: "_SetCtx" = None) -> int:
         """Slot of the world chip ``root_rank`` inside the set's device

@@ -13,7 +13,7 @@ the same mesh machinery the data-parallel core uses.
 
 from __future__ import annotations
 
-from typing import Any, Optional, Tuple
+from typing import Any, Callable, Optional, Tuple
 
 import flax.linen as nn
 import jax
@@ -23,6 +23,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..common import basics
+from ..models.transformer import _checkpoint_policy, resolve_remat_policies
 from ._mesh_utils import axis_size_or_1 as _axis_size_or_1
 from .tensor_parallel import TensorParallelAttention, TensorParallelMlp
 from .ulysses import ulysses_attention
@@ -45,6 +46,35 @@ def multi_axis_mesh(dp: int, sp: int = 1, tp: int = 1,
     return Mesh(arr, (DP_AXIS, SP_AXIS, TP_AXIS))
 
 
+class _MultiAxisBlock(nn.Module):
+    """One pre-norm decoder block of :class:`MultiAxisTransformer` —
+    factored out of the layer loop so ``nn.remat`` can lift it per
+    block (the configurable activation-remat policies of
+    docs/OPTIM.md)."""
+
+    d_model: int
+    num_heads: int
+    head_dim: int
+    dtype: jnp.dtype
+    attn_fn: Callable
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
+        h = TensorParallelAttention(
+            num_heads=self.num_heads, head_dim=self.head_dim,
+            axis=TP_AXIS, attn_fn=self.attn_fn, dtype=self.dtype,
+            name="attn",
+        )(h)
+        x = x + h
+        h = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
+        h = TensorParallelMlp(
+            d_model=self.d_model, d_ff=4 * self.d_model, axis=TP_AXIS,
+            dtype=self.dtype, name="mlp",
+        )(h)
+        return x + h
+
+
 class MultiAxisTransformer(nn.Module):
     """Decoder-only LM over the (dp, sp, tp) mesh.
 
@@ -63,6 +93,12 @@ class MultiAxisTransformer(nn.Module):
         flagship transformer exposes single-axis.
 
     ``window`` (Mistral sliding window) routes into every impl.
+
+    Param-tree layout: each layer lives under ``block_{i}/{ln1, attn,
+    ln2, mlp}`` (the per-block module ``nn.remat`` lifts).  Checkpoints
+    from before the remat-policy change (flat ``ln1_{i}``/``attn_{i}``/
+    … names) need a one-time key rewrite; ``param_specs`` matches by
+    substring and is layout-agnostic.
     """
 
     vocab: int
@@ -74,6 +110,10 @@ class MultiAxisTransformer(nn.Module):
     attention_impl: str = "ulysses"  # 'ulysses' | 'ring' | 'ring_flash'
     causal: bool = True
     window: Optional[int] = None
+    # activation-remat policy per block: None (no remat), a
+    # models.transformer.REMAT_POLICIES name for every block, or a
+    # num_layers tuple of names (docs/OPTIM.md policy matrix)
+    remat_policy: Any = None
 
     @nn.compact
     def __call__(self, tokens):
@@ -117,19 +157,23 @@ class MultiAxisTransformer(nn.Module):
                 window=self.window,
             )
 
+        policies = resolve_remat_policies(
+            self.remat_policy, self.num_layers
+        )
+        block_cls_for = {"none": _MultiAxisBlock}
         for i in range(self.num_layers):
-            h = nn.LayerNorm(dtype=self.dtype, name=f"ln1_{i}")(x)
-            h = TensorParallelAttention(
-                num_heads=self.num_heads, head_dim=head_dim, axis=TP_AXIS,
-                attn_fn=attn_fn, dtype=self.dtype, name=f"attn_{i}",
-            )(h)
-            x = x + h
-            h = nn.LayerNorm(dtype=self.dtype, name=f"ln2_{i}")(x)
-            h = TensorParallelMlp(
-                d_model=self.d_model, d_ff=4 * self.d_model, axis=TP_AXIS,
-                dtype=self.dtype, name=f"mlp_{i}",
-            )(h)
-            x = x + h
+            pol = policies[i]
+            block_cls = block_cls_for.get(pol)
+            if block_cls is None:
+                block_cls = nn.remat(
+                    _MultiAxisBlock, policy=_checkpoint_policy(pol)
+                )
+                block_cls_for[pol] = block_cls
+            x = block_cls(
+                d_model=self.d_model, num_heads=self.num_heads,
+                head_dim=head_dim, dtype=self.dtype, attn_fn=attn_fn,
+                name=f"block_{i}",
+            )(x)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         return jnp.dot(x, emb.T.astype(self.dtype))  # tied head
 

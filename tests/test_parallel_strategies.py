@@ -404,10 +404,10 @@ def test_param_specs_layout():
                                     num_layers=1, seq_len=8)
     variables, specs = sh.init_sharded(model, mesh, jax.random.PRNGKey(0))
     p = specs["params"]
-    assert p["attn_0"]["qkv"]["kernel"] == P(None, "tp")
-    assert p["attn_0"]["proj"]["kernel"] == P("tp", None)
-    assert p["mlp_0"]["wi"]["kernel"] == P(None, "tp")
-    assert p["mlp_0"]["wo"]["kernel"] == P("tp", None)
+    assert p["block_0"]["attn"]["qkv"]["kernel"] == P(None, "tp")
+    assert p["block_0"]["attn"]["proj"]["kernel"] == P("tp", None)
+    assert p["block_0"]["mlp"]["wi"]["kernel"] == P(None, "tp")
+    assert p["block_0"]["mlp"]["wo"]["kernel"] == P("tp", None)
     assert p["embed"] == P()
 
 
@@ -420,7 +420,7 @@ def test_init_sharded_tp_shards_differ():
     model = sh.MultiAxisTransformer(vocab=32, d_model=16, num_heads=4,
                                     num_layers=1, seq_len=8)
     variables, specs = sh.init_sharded(model, mesh, jax.random.PRNGKey(0))
-    wi = variables["params"]["mlp_0"]["wi"]["kernel"]
+    wi = variables["params"]["block_0"]["mlp"]["wi"]["kernel"]
     shards = [np.asarray(s.data) for s in wi.addressable_shards]
     tp_shards = shards[:2]  # same (dp, sp), tp=0 vs tp=1
     assert not np.array_equal(tp_shards[0], tp_shards[1])

@@ -1,0 +1,180 @@
+"""Configurable activation-remat policy tests (ISSUE 6): policy
+resolution/validation, numeric transparency (remat must never change
+values, only the memory/compute schedule), and the modeled
+activation-bytes arithmetic the bench legs report.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import horovod_tpu as hvd  # noqa: F401  (session init fixture)
+from horovod_tpu.models.transformer import (
+    REMAT_POLICIES,
+    Transformer,
+    TransformerConfig,
+    modeled_activation_bytes,
+    resolve_remat_policies,
+)
+
+CFG_KW = dict(vocab_size=64, num_layers=2, num_heads=2, head_dim=8,
+              max_seq_len=16, dtype=jnp.float32)
+
+
+def _data():
+    rng = np.random.RandomState(0)
+    tok = jnp.asarray(rng.randint(0, 64, (2, 16)))
+    tgt = jnp.asarray(rng.randint(0, 64, (2, 16)))
+    return tok, tgt
+
+
+# -- resolution / validation -------------------------------------------------
+
+
+def test_resolve_remat_policies():
+    assert resolve_remat_policies(None, 3) == ("none",) * 3
+    assert resolve_remat_policies("full", 2) == ("full", "full")
+    assert resolve_remat_policies(("none", "dots"), 2) == ("none", "dots")
+    with pytest.raises(ValueError, match="unknown remat policy"):
+        resolve_remat_policies("everything", 2)
+    with pytest.raises(ValueError, match="2 entries"):
+        resolve_remat_policies(("full",), 2)
+
+
+def test_config_validates_policy_at_build():
+    with pytest.raises(ValueError, match="unknown remat policy"):
+        TransformerConfig(remat_policy="bogus", **CFG_KW)
+    with pytest.raises(ValueError, match="entries"):
+        TransformerConfig(remat_policy=("full",), **CFG_KW)
+    # lists normalize to (hashable) tuples — the config stays usable as
+    # a static jit argument
+    cfg = TransformerConfig(remat_policy=["full", "dots"], **CFG_KW)
+    assert cfg.remat_policy == ("full", "dots")
+    assert hash(cfg) == hash(
+        TransformerConfig(remat_policy=("full", "dots"), **CFG_KW))
+
+
+def test_legacy_remat_bool_maps_to_dots_no_batch():
+    cfg = TransformerConfig(remat=True, **CFG_KW)
+    assert cfg.block_remat_policies() == ("dots_no_batch",) * 2
+    cfg = TransformerConfig(**CFG_KW)
+    assert cfg.block_remat_policies() == ("none",) * 2
+
+
+# -- numeric transparency ----------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "policy", ["full", "dots", "dots_no_batch", ("none", "full")]
+)
+def test_remat_policy_is_numerically_transparent(policy):
+    """Remat changes WHAT is recomputed, never the result: loss and
+    every gradient must match the no-remat model (same params — the
+    lifted transform must not move parameter paths either)."""
+    tok, tgt = _data()
+    base = TransformerConfig(**CFG_KW)
+    params = Transformer(base).init(jax.random.PRNGKey(0), tok)["params"]
+
+    def loss_fn(p, cfg):
+        logits = Transformer(cfg).apply({"params": p}, tok)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, tgt).mean()
+
+    l0, g0 = jax.value_and_grad(loss_fn)(params, base)
+    cfg = TransformerConfig(remat_policy=policy, **CFG_KW)
+    l1, g1 = jax.value_and_grad(loss_fn)(params, cfg)
+    assert np.allclose(float(l0), float(l1), rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(g0),
+                    jax.tree_util.tree_leaves(g1)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+def test_multi_axis_transformer_remat_trains_and_matches():
+    """MultiAxisTransformer threads the same policies: a remat'd model
+    must produce the no-remat loss on the same params and still train."""
+    from horovod_tpu.parallel import sharded as sh
+
+    mesh = sh.multi_axis_mesh(dp=2, sp=2, tp=2)
+
+    def build(policy):
+        return sh.MultiAxisTransformer(
+            vocab=32, d_model=16, num_heads=4, num_layers=2, seq_len=8,
+            remat_policy=policy,
+        )
+
+    variables, specs = sh.init_sharded(
+        build(None), mesh, jax.random.PRNGKey(0), local_batch=2)
+    opt = optax.sgd(0.3, momentum=0.9)
+    opt_state, ospecs = sh.init_opt_sharded(opt, variables, mesh, specs)
+    rng = np.random.RandomState(0)
+    tok = jnp.asarray(rng.randint(0, 32, (4, 8)))
+    tgt = jnp.asarray(rng.randint(0, 32, (4, 8)))
+
+    step_n = sh.make_sharded_train_step(build(None), opt, mesh, specs,
+                                        ospecs)
+    step_r = sh.make_sharded_train_step(build("full"), opt, mesh, specs,
+                                        ospecs)
+    copy = jax.tree_util.tree_map(jnp.copy, (variables, opt_state))
+    _, _, loss_n = step_n(*copy, tok, tgt)  # donated — use the copy
+    v, o, loss_r = step_r(variables, opt_state, tok, tgt)
+    np.testing.assert_allclose(float(loss_n), float(loss_r), rtol=1e-5)
+    losses = [float(loss_r)]
+    for _ in range(5):
+        v, o, loss = step_r(v, o, tok, tgt)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+# -- modeled activation bytes ------------------------------------------------
+
+
+def test_modeled_activation_bytes_arithmetic():
+    """Pin the model: per-block saved-tensor accounting for a config
+    small enough to hand-check (B=2, S=16, D=16, H=2, Hkv=1, Dh=8,
+    F=64, fp32)."""
+    cfg = TransformerConfig(num_kv_heads=1, **CFG_KW)
+    out = modeled_activation_bytes(cfg, batch=2)
+    bsd = 2 * 16 * 16 * 4          # one (B, S, D) fp32 tensor = 2048
+    kv = 2 * 2 * 16 * 1 * 8 * 4    # K and V at one kv head = 2048
+    f = 2 * 16 * 64 * 4            # one MLP hidden tensor = 8192
+    assert out["per_block_bytes"]["none"] == 5 * bsd + kv + 3 * f
+    assert out["per_block_bytes"]["dots"] == 5 * bsd + kv + 2 * f
+    assert out["per_block_bytes"]["dots_no_batch"] == bsd
+    assert out["per_block_bytes"]["full"] == bsd
+    # default policy = none on both blocks
+    assert out["total_bytes"] == 2 * (5 * bsd + kv + 3 * f)
+    assert out["policies"] == ("none", "none")
+
+
+def test_modeled_activation_bytes_drop_under_each_policy():
+    """The ISSUE acceptance: modeled activation bytes DROP under every
+    remat policy relative to none, monotonically with policy strength."""
+    per = modeled_activation_bytes(
+        TransformerConfig(**CFG_KW), batch=4)["per_block_bytes"]
+    assert per["none"] > per["dots"] > per["dots_no_batch"]
+    assert per["dots_no_batch"] == per["full"]
+    # per-block selection sums exactly
+    mixed = TransformerConfig(remat_policy=("none", "full"), **CFG_KW)
+    assert modeled_activation_bytes(mixed, batch=4)["total_bytes"] == \
+        per["none"] + per["full"]
+
+
+def test_modeled_activation_bytes_tracks_gqa_and_dtype():
+    bf16 = modeled_activation_bytes(
+        TransformerConfig(**{**CFG_KW, "dtype": jnp.bfloat16}), batch=2)
+    fp32 = modeled_activation_bytes(TransformerConfig(**CFG_KW), batch=2)
+    assert 2 * bf16["total_bytes"] == fp32["total_bytes"]
+    gqa = modeled_activation_bytes(
+        TransformerConfig(num_kv_heads=1, **CFG_KW), batch=2)
+    mha = modeled_activation_bytes(TransformerConfig(**CFG_KW), batch=2)
+    assert gqa["total_bytes"] < mha["total_bytes"]  # K/V shrink by group
+
+
+def test_policy_names_are_closed():
+    """The bench sweep, docs matrix and config validation share one
+    registry."""
+    assert set(REMAT_POLICIES) == {"none", "dots", "dots_no_batch",
+                                   "full"}

@@ -1,0 +1,374 @@
+"""ZeRO-style sharded optimizer tests (optim.ZeroDistributedOptimizer /
+ZeroSpmdOptimizer — ISSUE 6, ROADMAP item 1).
+
+The load-bearing guarantee is BIT-EQUALITY: the sharded update must equal
+the replicated update exactly (fp32), because the inner transformation is
+elementwise over the flat partition and reduce-scatter hands each rank
+the same reduced values an allreduce would.  The parity tests therefore
+use exact-dyadic gradients (every partial sum representable, so the
+reduction order cannot round) and assert with assert_array_equal, never
+allclose — any drift is a real contract break, not noise.
+
+Reduce-scatter oracle style mirrors test_spmd_collectives: per-rank
+tensors over the 8-device virtual mesh, reference computed as
+allreduce-then-slice.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu import training
+from horovod_tpu.optim import (
+    ZeroPlan,
+    ZeroState,
+    sharded_state_bytes_per_rank,
+    state_bytes,
+    zero_opt_state_specs,
+)
+
+W = 8
+
+
+def _dyadic_params():
+    """Parameter pytree with exact-dyadic fp32 values whose total size
+    (3*2 + 7 = 13) does NOT divide the 8-rank world — the
+    padding/unflatten bookkeeping is always live."""
+    rng = np.random.RandomState(0)
+    return {
+        "w": jnp.asarray(rng.randint(-4, 5, (3, 2)).astype(np.float32) / 8),
+        "b": jnp.zeros((7,), jnp.float32),
+    }
+
+
+def _dyadic_batch(n):
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randint(-8, 9, (n, 3)).astype(np.float32) / 16)
+    y = jnp.asarray(rng.randint(-8, 9, (n, 2)).astype(np.float32) / 16)
+    return x, y
+
+
+def _loss(p, xs, ys):
+    pred = xs @ p["w"] + p["b"][:2]
+    return jnp.mean((pred - ys) ** 2)
+
+
+def _train(opt, params, x, y, steps):
+    """Run `steps` updates under shard_map with the batch sharded over
+    the world axis; params stay replicated (ZeRO allgathers its updates,
+    the replicated wrapper allreduces its grads)."""
+
+    @functools.partial(
+        jax.shard_map, mesh=hvd.world_mesh(),
+        in_specs=(P(), P("hvd"), P("hvd")), out_specs=P(),
+        check_vma=False,
+    )
+    def run(p, xs, ys):
+        st = opt.init(p)
+        for _ in range(steps):
+            g = jax.grad(_loss)(p, xs, ys)
+            u, st = opt.update(g, st, p)
+            p = optax.apply_updates(p, u)
+        return p
+
+    return run(params, x, y)
+
+
+def test_zero_spmd_parity_bit_equal_fp32():
+    """ROADMAP item 1 acceptance: sharded-vs-replicated parameter
+    updates bit-equal per step (3 steps of adamw, non-divisible flat
+    size)."""
+    params = _dyadic_params()
+    x, y = _dyadic_batch(W * 4)
+    inner = optax.adamw(1e-2)
+    pz = _train(hvd.ZeroSpmdOptimizer(inner), params, x, y, steps=3)
+    pr = _train(hvd.DistributedOptimizer(inner), params, x, y, steps=3)
+    for k in params:
+        np.testing.assert_array_equal(np.asarray(pz[k]), np.asarray(pr[k]))
+
+
+def test_zero_spmd_parity_with_gradient_accumulation():
+    """The ISSUE-named composition: backward_passes_per_step accumulates
+    the FULL local gradient (optax.MultiSteps) and the sharded exchange
+    runs on the k-th microbatch — parity must hold bit-exactly."""
+    params = _dyadic_params()
+    x, y = _dyadic_batch(W * 4)
+    inner = optax.adamw(1e-2)
+    zopt = optax.MultiSteps(
+        hvd.ZeroSpmdOptimizer(inner), every_k_schedule=2
+    )
+    ropt = hvd.DistributedOptimizer(inner, backward_passes_per_step=2)
+    pz = _train(zopt, params, x, y, steps=4)  # 4 microbatches, 2 updates
+    pr = _train(ropt, params, x, y, steps=4)
+    for k in params:
+        np.testing.assert_array_equal(np.asarray(pz[k]), np.asarray(pr[k]))
+
+
+def test_zero_spmd_sum_op_parity():
+    params = _dyadic_params()
+    x, y = _dyadic_batch(W * 4)
+    inner = optax.sgd(0.25)
+    pz = _train(hvd.ZeroSpmdOptimizer(inner, op=hvd.Sum),
+                params, x, y, steps=2)
+    pr = _train(hvd.DistributedOptimizer(inner, op=hvd.Sum),
+                params, x, y, steps=2)
+    for k in params:
+        np.testing.assert_array_equal(np.asarray(pz[k]), np.asarray(pr[k]))
+
+
+def test_zero_eager_single_process_equals_inner():
+    """np=1 eager degenerate (reference np=1 semantics): the flat
+    partition must be arithmetically invisible — bit-equal to the plain
+    inner optimizer on the structured tree."""
+    params = _dyadic_params()
+    x, y = _dyadic_batch(8)
+    inner = optax.adamw(1e-2)
+    zopt = hvd.ZeroDistributedOptimizer(inner)
+    grads = jax.grad(_loss)(params, x, y)
+    zs = zopt.init(params)
+    uz, _ = zopt.update(grads, zs, params)
+    ui, _ = inner.update(grads, inner.init(params), params)
+    for k in params:
+        np.testing.assert_array_equal(
+            np.asarray(optax.apply_updates(params, uz)[k]),
+            np.asarray(optax.apply_updates(params, ui)[k]),
+        )
+
+
+def test_zero_eager_requires_params():
+    zopt = hvd.ZeroDistributedOptimizer(optax.adam(1e-3))
+    with pytest.raises(ValueError, match="params"):
+        zopt.init(None)
+
+
+def test_zero_rejects_non_sum_ops():
+    with pytest.raises(ValueError):
+        hvd.ZeroDistributedOptimizer(optax.adam(1e-3), op=hvd.Min)
+    with pytest.raises(ValueError):
+        hvd.ZeroSpmdOptimizer(optax.adam(1e-3), op=hvd.Adasum)
+
+
+def test_zero_eager_min_total_bytes_fallback_matches(monkeypatch):
+    """Below the sharding threshold the wrapper keeps replicated state +
+    one allreduce; the numbers must be identical either way."""
+    params = _dyadic_params()
+    x, y = _dyadic_batch(8)
+    grads = jax.grad(_loss)(params, x, y)
+    inner = optax.adam(1e-2)
+    outs = []
+    for min_bytes in (0, 10 ** 9):
+        zopt = hvd.ZeroDistributedOptimizer(
+            inner, min_total_bytes=min_bytes)
+        u, _ = zopt.update(grads, zopt.init(params), params)
+        outs.append(u)
+    for k in params:
+        np.testing.assert_array_equal(
+            np.asarray(outs[0][k]), np.asarray(outs[1][k]))
+    # the env default parses through env_int
+    monkeypatch.setenv("HVD_TPU_ZERO_MIN_BYTES", "4096")
+    zopt = hvd.ZeroDistributedOptimizer(inner)
+    u, _ = zopt.update(grads, zopt.init(params), params)
+    for k in params:
+        np.testing.assert_array_equal(
+            np.asarray(u[k]), np.asarray(outs[0][k]))
+
+
+def test_zero_eager_with_gradient_accumulation_single_process():
+    """backward_passes_per_step composes on the eager wrapper exactly as
+    on DistributedOptimizer (MultiSteps traces the inner update through
+    lax.cond — the collective path must stay traceable at np=1)."""
+    params = _dyadic_params()
+    x, y = _dyadic_batch(8)
+    grads = jax.grad(_loss)(params, x, y)
+    zopt = hvd.ZeroDistributedOptimizer(
+        optax.sgd(1.0), backward_passes_per_step=2)
+    st = zopt.init(params)
+    u1, st = zopt.update(grads, st, params)
+    for leaf in jax.tree_util.tree_leaves(u1):
+        np.testing.assert_array_equal(np.asarray(leaf),
+                                      np.zeros_like(np.asarray(leaf)))
+    u2, st = zopt.update(grads, st, params)
+    np.testing.assert_array_equal(
+        np.asarray(u2["w"]), np.asarray(-grads["w"]))
+
+
+# -- ZeroPlan bookkeeping ----------------------------------------------------
+
+
+def test_zero_plan_roundtrip_mixed_dtypes_non_divisible():
+    leaves = [
+        jnp.arange(5, dtype=jnp.float32),
+        jnp.ones((3, 3), jnp.bfloat16),
+        jnp.arange(7, dtype=jnp.float32).reshape(7, 1),
+        jnp.zeros((2,), jnp.bfloat16),
+    ]
+    plan = ZeroPlan(leaves, W)
+    assert len(plan.buckets) == 2  # one per dtype
+    for padded in plan.padded_sizes:
+        assert padded % W == 0
+    bufs = plan.flatten(leaves)
+    for buf, padded in zip(bufs, plan.padded_sizes):
+        assert buf.shape == (padded,)
+    out = plan.unflatten(bufs)
+    for a, b in zip(leaves, out):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert a.dtype == b.dtype
+
+
+def test_zero_plan_is_deterministic():
+    leaves = [jnp.zeros((11,)), jnp.zeros((4, 2), jnp.bfloat16)]
+    p1, p2 = ZeroPlan(leaves, W), ZeroPlan(leaves, W)
+    assert p1.buckets == p2.buckets
+    assert p1.shard_sizes == p2.shard_sizes
+    assert p1.total_bytes == 11 * 4 + 8 * 2
+    assert p1.shard_bytes * W == p1.padded_bytes
+
+
+def test_zero_opt_state_specs_layout():
+    """Adam m/v over the shard buffers are axis-sharded; the step count
+    stays replicated."""
+    params = _dyadic_params()
+    specs = zero_opt_state_specs(optax.adam(1e-3), params, W)
+    assert isinstance(specs, ZeroState)
+    adam_state = specs.inner[0]
+    assert adam_state.count == P()
+    for leaf in jax.tree_util.tree_leaves(adam_state.mu):
+        assert leaf == P("hvd")
+    for leaf in jax.tree_util.tree_leaves(adam_state.nu):
+        assert leaf == P("hvd")
+
+
+def test_sharded_state_bytes_per_rank_accounting():
+    params = _dyadic_params()
+    inner = optax.adam(1e-3)
+    specs = zero_opt_state_specs(inner, params, W)
+    plan = ZeroPlan(jax.tree_util.tree_leaves(params), W)
+    # global sharded state: count () + mu/nu over (W*shard,) buffers
+    global_state = ZeroState(
+        inner=inner.init([jnp.zeros((plan.padded_sizes[0],))]))
+    per_rank = sharded_state_bytes_per_rank(global_state, specs, W)
+    expected = 4 + 2 * plan.shard_bytes  # int32 count + mu + nu
+    assert per_rank == expected
+
+
+# -- reduce-scatter oracle (allreduce-then-slice reference) ------------------
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("size", [64, 17, 5])
+def test_reducescatter_oracle_spmd(dtype, size):
+    """SPMD reduce-scatter over the padded ZeRO layout == allreduce then
+    slice, bit-exact, for divisible and non-divisible sizes in fp32 and
+    bf16 (values chosen so every partial sum is representable — the
+    reduction order cannot round)."""
+    pad = (-size) % W
+    s = (size + pad) // W
+
+    def per_rank(r):
+        base = jnp.arange(size, dtype=jnp.float32) % 4
+        return (base + r.astype(jnp.float32) * 0.5).astype(dtype)
+
+    def rs(r):
+        t = per_rank(r)
+        buf = (jnp.concatenate([t, jnp.zeros((pad,), t.dtype)])
+               if pad else t)
+        return hvd.spmd.reducescatter(buf, op=hvd.Sum)
+
+    out = np.asarray(jax.device_get(hvd.run_per_rank(rs)))  # (W, s)
+
+    # reference: allreduce (sum over ranks) then slice rank chunks
+    vals = np.stack([
+        np.asarray((np.arange(size) % 4 + r * 0.5), np.float64)
+        for r in range(W)
+    ])
+    full = np.zeros(size + pad)
+    full[:size] = vals.sum(axis=0)
+    ref = full.astype(np.asarray(jnp.zeros(0, dtype)).dtype)
+    for r in range(W):
+        np.testing.assert_array_equal(out[r], ref[r * s:(r + 1) * s])
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("size", [16, 7])
+def test_reducescatter_oracle_eager(dtype, size):
+    """Eager/native-path oracle: reducescatter == allreduce-then-slice
+    through the public API (native controller when built — bf16 rides
+    the wire enum and the multi-leaf pytree exercises per-leaf naming).
+    Written against member_info so it holds at any world size; at np=1
+    both sides degenerate identically (reference np=1 semantics)."""
+    eng = hvd.common.basics._require_init().engine
+    n, me = eng.member_info()
+    pad = (-size) % n
+    x = (jnp.arange(size, dtype=jnp.float32) % 4 / 2).astype(dtype)
+    buf = jnp.concatenate([x, jnp.zeros((pad,), x.dtype)]) if pad else x
+    tree = {"a": buf, "b": buf * 2}
+    rs = hvd.reducescatter(tree, op=hvd.Sum, name="rs_oracle")
+    ar = hvd.allreduce(tree, op=hvd.Sum, name="rs_oracle_ref")
+    s = buf.shape[0] // n
+    for k in tree:
+        np.testing.assert_array_equal(
+            np.asarray(rs[k]),
+            np.asarray(ar[k][me * s:(me + 1) * s]),
+        )
+
+
+def test_engine_reducescatter_multi_fallbacks():
+    """The one-compiled-program multi path must decline (None) exactly
+    where the per-tensor path's error/bool handling is authoritative."""
+    eng = hvd.common.basics._require_init().engine
+    xs = [jnp.ones((8,)), jnp.ones((16,))]
+    # Sum/Average accepted: identity at one contributor
+    out = eng.reducescatter_multi(xs, hvd.Sum)
+    assert out is not None
+    for a, b in zip(out, xs):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert eng.reducescatter_multi(xs, hvd.Min) is None
+    assert eng.reducescatter_multi(
+        [jnp.array([True, False])], hvd.Sum) is None
+    assert eng.reducescatter_multi([jnp.asarray(1.0)], hvd.Sum) is None
+
+
+def test_eager_reducescatter_pytree_multi_path():
+    """A multi-leaf pytree rides the reducescatter_multi branch of
+    collective_ops and still returns the per-leaf results."""
+    tree = [jnp.arange(8.0), jnp.arange(16.0) * 2]
+    out = hvd.reducescatter(tree, op=hvd.Sum)
+    for a, b in zip(out, tree):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# -- end-to-end trainer ------------------------------------------------------
+
+
+def test_zero_train_setup_descends_and_shards_state():
+    from horovod_tpu.models.transformer import Transformer, gpt_tiny
+
+    cfg = gpt_tiny(dtype=jnp.float32)
+    model = Transformer(cfg)
+    rng = np.random.RandomState(0)
+    tok = jnp.asarray(rng.randint(0, 256, (8, 32)))
+    tgt = jnp.asarray(rng.randint(0, 256, (8, 32)))
+    inner = optax.adamw(1e-3)
+    state, step, ospecs = training.zero_train_setup(
+        model, inner, jax.random.PRNGKey(0), tok[:1])
+    losses = []
+    for _ in range(4):
+        state, loss = step(state, tok, tgt)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+    # the acceptance column: per-rank optimizer state ~ 1/world of the
+    # replicated baseline (exact up to padding + the replicated count)
+    zb = sharded_state_bytes_per_rank(state.opt_state, ospecs, W)
+    rstate = training.create_train_state(
+        model, inner, jax.random.PRNGKey(0), tok[:1])
+    rb = state_bytes(rstate.opt_state)
+    assert zb < rb / (W - 1)
+    assert zb > rb / (W + 1)
