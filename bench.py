@@ -393,6 +393,46 @@ def worker(mode: str, args) -> int:
     except Exception as e:  # accounting must never sink the bench line
         print(f"[bench] live-array accounting unavailable: {e}",
               file=sys.stderr)
+    # per-step gradient-exchange byte record (ISSUE 7): the modeled
+    # per-tier traffic of allreducing every parameter gradient once,
+    # flat vs this topology's routing (ops/comm_model.py; one entry per
+    # tier + the DCN wire dtype the HVD_TPU_* env selects) — what
+    # hierarchical routing + DCN compression exist to shrink
+    from horovod_tpu.common import basics as _basics
+    from horovod_tpu.ops.comm_model import (
+        modeled_collective_bytes as _comm_bytes,
+    )
+
+    _st = _basics._state
+    _topo = _st.topology if _st is not None else None
+    n_slices = _topo.num_slices if _topo is not None else 1
+    _cfg = _st.config if _st is not None else None
+    hier_on = bool(
+        _cfg is not None and _cfg.hierarchical_allreduce and n_slices > 1
+    )
+    wire = None
+    if hier_on:
+        from horovod_tpu.compression import dcn_compression_from_name
+
+        _comp = dcn_compression_from_name(_cfg.dcn_wire_dtype)
+        wire = str(_comp.wire_dtype) if _comp is not None else None
+        n_ici = _topo.slice_size
+    else:
+        n_ici = 1 if n_slices > 1 else world
+    comm = {"ici": 0, "dcn": 0}
+    try:
+        for leaf in jax.tree_util.tree_leaves(state.params):
+            m = _comm_bytes(np.shape(leaf), world, n_ici,
+                            wire_dtype=wire, dtype=str(leaf.dtype))
+            comm["ici"] += m["ici_bytes"]
+            comm["dcn"] += m["dcn_bytes"]
+    except Exception as e:  # accounting must never sink the bench line
+        print(f"[bench] comm-bytes accounting unavailable: {e}",
+              file=sys.stderr)
+        comm = {"ici": 0, "dcn": 0}
+    comm["wire_dtype"] = wire
+    comm["routing"] = "hierarchical" if hier_on else "flat"
+
     result = {
         "metric": "resnet50_synthetic_train_throughput",
         "value": round(img_per_sec, 2),
@@ -409,6 +449,7 @@ def worker(mode: str, args) -> int:
             100.0 * input_wait_ms / max(dt / iters * 1e3, 1e-9), 2),
         "pipeline": pipeline,
         "memory_per_rank": memory_per_rank,
+        "comm_bytes": comm,
     }
     if not on_tpu:
         # the record must say WHY it is a CPU number (probe failure or a

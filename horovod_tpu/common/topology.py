@@ -20,7 +20,7 @@ communicators used by NCCLHierarchicalAllreduce
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Sequence
+from typing import List, Optional, Sequence
 
 import jax
 import numpy as np
@@ -31,6 +31,30 @@ WORLD_AXIS = "hvd"
 #: Axis names of the hierarchical 2-D mesh (inter-slice DCN x intra-slice ICI).
 DCN_AXIS = "dcn"
 ICI_AXIS = "ici"
+
+
+def _detect_slice_ids(devices: Sequence) -> Optional[List[int]]:
+    """Per-device physical slice ids, when the runtime exposes them.
+
+    Real multislice TPU runtimes tag each PJRT device with its slice
+    (``slice_index`` on current jaxlib; ``coords``-less multislice pods
+    expose only that attribute).  Returns None only when the tags carry
+    no usable information: a device missing the attribute (CPU, an older
+    runtime — unknown, let the caller fall back) or ids that do not
+    partition the world into equal groups (an unequal split cannot form
+    the rectangular (dcn, ici) mesh).  A UNIFORM tag is authoritative,
+    not unknown: the runtime is explicitly reporting one slice, and the
+    per-process fallback must not fabricate a DCN tier on a multi-host
+    single-slice pod (chips there are ICI-linked across hosts).
+    """
+    ids = [getattr(d, "slice_index", None) for d in devices]
+    if any(i is None for i in ids):
+        return None
+    uniq = sorted(set(ids))
+    counts = {u: ids.count(u) for u in uniq}
+    if len(set(counts.values())) != 1:
+        return None
+    return list(ids)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -86,14 +110,114 @@ class Topology:
         """The 1-D world mesh: every chip on axis ``"hvd"``."""
         return Mesh(np.asarray(self.devices, dtype=object), (WORLD_AXIS,))
 
+    def slice_ids(self) -> List[int]:
+        """Physical fabric-tier id of every device, in world order.
+
+        Resolution order (docs/COLLECTIVES.md):
+          1. ``HVD_TPU_SLICE_SIZE`` — explicit chips-per-slice override;
+             world order is grouped into consecutive runs of that size.
+             This is how virtual CPU meshes (and tests) model a
+             multislice fabric, and how an operator corrects a runtime
+             that doesn't tag devices.
+          2. the runtime's own ``slice_index`` device attribute (real
+             multislice TPU jobs).
+          3. one slice per process when processes partition the world
+             evenly (each host's chips share ICI; DCN links hosts — the
+             reference's intra-node/inter-node split).
+          4. a single slice (flat world; no DCN tier).
+        """
+        from .retry import env_int  # deferred: retry pulls in metrics
+
+        override = env_int("HVD_TPU_SLICE_SIZE", 0)
+        if override > 0:
+            if self.size % override != 0:
+                raise ValueError(
+                    f"HVD_TPU_SLICE_SIZE={override} does not divide the "
+                    f"{self.size}-device world into equal slices"
+                )
+            return [i // override for i in range(self.size)]
+        detected = _detect_slice_ids(self.devices)
+        if detected is not None:
+            # renumber to dense 0..n-1 in first-appearance order so the
+            # ids index hierarchical_mesh rows
+            order = {}
+            return [order.setdefault(s, len(order)) for s in detected]
+        procs = max(self.num_processes, 1)
+        if procs > 1 and self.size % procs == 0:
+            by_proc = {}
+            ids = []
+            for d in self.devices:
+                p = getattr(d, "process_index", 0)
+                ids.append(by_proc.setdefault(p, len(by_proc)))
+            if all(ids.count(s) == self.size // procs for s in set(ids)):
+                return ids
+        return [0] * self.size
+
+    @property
+    def num_slices(self) -> int:
+        """Number of fabric slices (DCN groups); 1 = no DCN tier."""
+        return len(set(self.slice_ids()))
+
+    @property
+    def slice_size(self) -> int:
+        """Chips per slice (the ICI group size)."""
+        return self.size // self.num_slices
+
+    def process_slice_groups(self) -> Optional[List[List[int]]]:
+        """Member processes per slice, for process-granular two-level
+        exchanges (the eager ZeRO hierarchical path): ``groups[s]`` is
+        the ascending process-index list of slice ``s``.
+
+        Returns None when the grouping cannot support a rectangular
+        local/cross communicator split — a single slice, a process whose
+        chips straddle slices, or unequal processes-per-slice — so the
+        caller falls back to the flat exchange with no negotiation (the
+        decision is a pure function of the frozen topology, identical on
+        every rank)."""
+        ids = self.slice_ids()
+        if len(set(ids)) <= 1:
+            return None
+        proc_slice = {}
+        for d, s in zip(self.devices, ids):
+            p = getattr(d, "process_index", 0)
+            if proc_slice.setdefault(p, s) != s:
+                return None  # chips of one process straddle slices
+        groups: dict = {}
+        for p in sorted(proc_slice):
+            groups.setdefault(proc_slice[p], []).append(p)
+        if len(groups) <= 1 or len({len(v) for v in groups.values()}) != 1:
+            return None
+        return [groups[s] for s in sorted(groups)]
+
     def hierarchical_mesh(self, num_groups: Optional[int] = None) -> Mesh:
         """2-D ``(dcn, ici)`` mesh for two-level reductions.
 
-        ``num_groups`` defaults to the number of processes (one group per
-        host/slice).  Reference analog: the local/cross communicator split
-        in horovod/common/mpi/mpi_context.cc used by hierarchical allreduce.
+        ``num_groups`` defaults to the detected slice count
+        (:meth:`slice_ids` — runtime ``slice_index`` tags, the
+        ``HVD_TPU_SLICE_SIZE`` override, or one group per process), so
+        the mesh rows reflect the physical fabric tiers.  Reference
+        analog: the local/cross communicator split in
+        horovod/common/mpi/mpi_context.cc used by hierarchical allreduce.
         """
-        groups = num_groups if num_groups is not None else max(self.num_processes, 1)
+        if num_groups is None:
+            slice_ids = self.slice_ids()
+            groups = len(set(slice_ids))
+            # a single detected slice yields a (1, world) mesh: no DCN
+            # tier is invented here — slice_ids() already consulted the
+            # per-process fallback where host boundaries ARE the best
+            # available information, so all-zeros means the runtime
+            # authoritatively reported one slice (or nothing partitions)
+            # and a fabricated tier would quantize fast-fabric traffic
+            # for zero benefit
+            # row-major device layout by detected slice, preserving world
+            # order within each slice — rows ARE the physical ICI groups
+            rows = [
+                [d for d, s in zip(self.devices, slice_ids) if s == g]
+                for g in range(groups)
+            ]
+            arr = np.asarray(rows, dtype=object)
+            return Mesh(arr, (DCN_AXIS, ICI_AXIS))
+        groups = num_groups
         if groups <= 0 or self.size % groups != 0:
             raise ValueError(
                 f"cannot split {self.size} devices into {groups} equal groups"

@@ -14,10 +14,29 @@ program, where the cast fuses with the collective's memory movement.
 
 from __future__ import annotations
 
-from typing import Any, Tuple
+from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+
+
+def _to_wire(x: jax.Array, dtype) -> jax.Array:
+    """Cast one float leaf to the wire dtype, clamped to the target's
+    finite range first.
+
+    The clamp exists for fp16: its max finite value is 65504, so a
+    large-magnitude fp32 gradient (easy to exceed with Sum reductions or
+    un-normalized losses) would silently overflow to inf and poison the
+    whole reduction.  Saturating at ±finfo.max keeps the value wrong by
+    at most the clamp — recoverable by error feedback — instead of
+    infectious.  bf16 shares fp32's exponent range, so its clamp is a
+    no-op in practice (and the recommended wire format for exactly that
+    reason)."""
+    dtype = jnp.dtype(dtype)
+    if x.dtype.itemsize > dtype.itemsize:
+        lim = jnp.asarray(jnp.finfo(dtype).max, x.dtype)
+        x = jnp.clip(x, -lim, lim)
+    return x.astype(dtype)
 
 
 def _cast_floats(tree: Any, dtype) -> Tuple[Any, Any]:
@@ -30,7 +49,7 @@ def _cast_floats(tree: Any, dtype) -> Tuple[Any, Any]:
         if jnp.issubdtype(x.dtype, jnp.floating) and \
                 x.dtype.itemsize > jnp.dtype(dtype).itemsize:
             ctx.append(x.dtype)
-            out.append(x.astype(dtype))
+            out.append(_to_wire(x, dtype))
         else:
             ctx.append(None)
             out.append(x)
@@ -96,3 +115,102 @@ class Compression:
     none = NoneCompressor
     fp16 = FP16Compressor
     bf16 = BF16Compressor
+
+
+class DcnCompression:
+    """Wire-format contract for the DCN hop of hierarchical collectives.
+
+    Unlike :class:`Compressor` (which casts the WHOLE tensor around the
+    whole collective), this compresses only the 1/n_ici shard that
+    actually crosses the slow inter-slice fabric: the ICI reduce-scatter
+    runs at full precision, the shard is cast to ``wire_dtype`` for the
+    DCN exchange, and the result is decompressed back to the accumulation
+    dtype before the ICI allgather — fp32 accumulation never leaves the
+    fast fabric (docs/COLLECTIVES.md).
+
+    ``error_feedback=True`` adds the standard EF-compression residual
+    (Seide et al., 1-bit SGD; Karimireddy et al., 2019): the quantization
+    error of this step's shard is carried by the caller and added back
+    before the next step's cast, so repeated steps do not accumulate
+    bias.  The residual is shard-shaped state — stateless callers (the
+    routed engine path) run without it; the ZeRO wrappers thread it
+    through their optimizer state.
+
+    Traceable: every method is pure jnp and composes into the one
+    compiled two-level program.
+    """
+
+    def __init__(self, wire_dtype="bfloat16", error_feedback: bool = False):
+        self.wire_dtype = jnp.dtype(wire_dtype)
+        if not jnp.issubdtype(self.wire_dtype, jnp.floating):
+            raise ValueError(
+                f"DCN wire dtype must be floating, got {wire_dtype!r}"
+            )
+        self.error_feedback = bool(error_feedback)
+
+    def __repr__(self) -> str:
+        return (f"DcnCompression(wire_dtype={self.wire_dtype.name}, "
+                f"error_feedback={self.error_feedback})")
+
+    def compress_shard(
+        self, shard: jax.Array, residual: Optional[jax.Array] = None,
+    ) -> Tuple[jax.Array, Optional[jax.Array]]:
+        """(wire shard, new residual).  ``residual`` is the previous
+        step's quantization error (or None on the first step / with
+        error feedback off); the new residual is None unless
+        ``error_feedback`` is set."""
+        shard = jnp.asarray(shard)
+        if not jnp.issubdtype(shard.dtype, jnp.floating) or \
+                shard.dtype.itemsize <= self.wire_dtype.itemsize:
+            return shard, residual  # nothing to compress (int / narrow)
+        if self.error_feedback and residual is not None:
+            shard = shard + residual.astype(shard.dtype)
+        wire = _to_wire(shard, self.wire_dtype)
+        new_residual = (
+            shard - wire.astype(shard.dtype)
+            if self.error_feedback else None
+        )
+        return wire, new_residual
+
+    def decompress_shard(self, wire: jax.Array, dtype) -> jax.Array:
+        """Back to the accumulation dtype (before the ICI allgather)."""
+        wire = jnp.asarray(wire)
+        return wire if wire.dtype == jnp.dtype(dtype) else wire.astype(dtype)
+
+
+_warned_wire_dtypes: set = set()
+
+
+def dcn_compression_from_name(name: Optional[str]):
+    """Resolve the ``HVD_TPU_DCN_WIRE_DTYPE`` spelling (none/bf16/fp16 or
+    a full dtype name) into a :class:`DcnCompression`, or None for off.
+    A garbled spelling warns and falls back to uncompressed — the
+    package's env convention (``env_float``): a typo'd knob must not
+    kill the first routed allreduce of a long job.  Error feedback is
+    never enabled here — the env-routed engine path is stateless
+    (docs/COLLECTIVES.md documents the bias bound)."""
+    if not name:
+        return None
+    key = name.strip().lower()
+    if key in ("", "0", "none", "off", "false"):
+        return None
+    alias = {"bf16": "bfloat16", "fp16": "float16", "half": "float16"}
+    try:
+        comp = DcnCompression(wire_dtype=alias.get(key, key))
+    except (TypeError, ValueError):
+        comp = None
+    # only 16-bit floats are meaningful wire formats for fp32 gradients;
+    # a wider/equal wire (e.g. float32 spelled out instead of "none")
+    # would be a silent no-op that still skews byte accounting and
+    # forks compiled-program signatures
+    if comp is not None and comp.wire_dtype.itemsize == 2:
+        return comp
+    if key not in _warned_wire_dtypes:  # once, not per collective
+        _warned_wire_dtypes.add(key)
+        from .utils.logging import get_logger
+
+        get_logger().warning(
+            "HVD_TPU_DCN_WIRE_DTYPE=%r is not a 16-bit floating wire "
+            "dtype; DCN-hop compression disabled", name,
+        )
+    return None

@@ -47,6 +47,20 @@ COLLECTIVE_BYTES = counter(
     ["op"],
 )
 
+#: Modeled bytes the engine's sum-family collectives moved on the fast
+#: intra-slice fabric (ring model, ops/comm_model.py; booked at dispatch).
+COLLECTIVE_ICI_BYTES = counter(
+    "hvd_tpu_collective_ici_bytes_total",
+    "Modeled intra-slice (ICI) fabric bytes moved by engine collectives",
+)
+
+#: Same, for the slow inter-slice fabric — THE number hierarchical
+#: routing + DCN wire compression exist to shrink (docs/COLLECTIVES.md).
+COLLECTIVE_DCN_BYTES = counter(
+    "hvd_tpu_collective_dcn_bytes_total",
+    "Modeled inter-slice (DCN) fabric bytes moved by engine collectives",
+)
+
 #: End-to-end latency of a negotiated collective: enqueue() to future
 #: resolution (includes negotiation, fusion and execution).
 OP_LATENCY = histogram(

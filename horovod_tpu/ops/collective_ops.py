@@ -255,7 +255,13 @@ def allreduce_async(
     process_set: Optional[ProcessSet] = None,
 ) -> Handle:
     rop = _normalize_op(op, average)
-    if _native(tensor) is not None:
+    eng = _engine()
+    if _native(tensor) is not None and not eng.routes_hierarchical(
+        rop, process_set
+    ):
+        # hierarchical-routed calls skip the controller: it negotiates
+        # the FLAT wire protocol, so the two-level (ICI × DCN) program
+        # and its DCN wire compression only exist on the engine path
         from ..native.controller import OP_ALLREDUCE
 
         return _native_submit(
@@ -266,7 +272,6 @@ def allreduce_async(
             ),
             prescale=prescale_factor, postscale=postscale_factor,
         )
-    eng = _engine()
     with _span(name, "allreduce", tensor):
         result = _fused_map(
             tensor,
@@ -323,8 +328,51 @@ def allreduce_multi_async(
     assert len(tensors) == len(names)
     rop = _normalize_op(op, average)
     arrays = [jnp.asarray(t) for t in tensors]
+    eng = _engine()
+    # the batched engine path only routes when this process owns every
+    # chip: batch composition is rank-local and timing-dependent (see
+    # the wire-name comment below), so in a multi-process world two
+    # ranks can drain different batch shapes — un-negotiated global
+    # programs would then mismatch and hang.  Multi-process bursts stay
+    # on the negotiated native batch (flat); their hierarchical savings
+    # come from the SPMD path and rank-symmetric call sites.
+    route_multi = (
+        eng.routes_hierarchical(rop, process_set)
+        and eng.topology.num_processes == 1
+    )
+    routed_fell_through = False
+    if route_multi and len(arrays) > 1 and not _contains_tracer(arrays):
+        # the batched hierarchical engine path: N buffers, ONE compiled
+        # two-level program (the native batch below would negotiate N
+        # flat allreduces); falls through on None (churn guard / bool).
+        # Metrics are booked only when the routed program ran — a None
+        # attempt costs just the eligibility checks, and the fallback
+        # below counts the same tensors itself.
+        tl = basics._state.timeline
+        if tl is not None:
+            tl.start("allreduce", "XLA_COMM")
+        t0 = time.perf_counter()
+        try:
+            routed = eng.hierarchical_allreduce_multi(
+                arrays, rop, prescale_factor, postscale_factor,
+                process_set, dcn_compression=eng._dcn_compression(),
+            )
+        finally:
+            if tl is not None:
+                tl.end("allreduce", "XLA_COMM")
+        if routed is not None:
+            _metrics.OP_LATENCY.labels("allreduce").observe(
+                time.perf_counter() - t0
+            )
+            _count_submission("allreduce", "eager", arrays, n=len(arrays))
+            return [Handle(r) for r in routed]
+        routed_fell_through = True
     ctrl = _native(arrays)
-    if ctrl is not None and ctrl.supports_batch and len(arrays) > 1:
+    # native batch (negotiated, flat) runs when routing is off, when the
+    # routed attempt fell through, or when the world is multi-process
+    # (negotiation is what makes rank-varying batches safe there)
+    if ctrl is not None and ctrl.supports_batch and len(arrays) > 1 \
+            and (routed_fell_through or not route_multi):
         from ..native.controller import OP_ALLREDUCE
 
         # ".0" leaf suffix: EXACTLY the wire name allreduce_async(name=n)
@@ -365,6 +413,13 @@ def grouped_allreduce_async(
         n_leaves = len(jax.tree_util.tree_leaves(list(tensors)))
         rop = _normalize_op(kwargs.pop("op", None), kwargs.pop("average", None))
         ps = kwargs.pop("process_set", None)
+        if _engine().routes_hierarchical(rop, ps):
+            # routed groups stay on the engine (see allreduce_async);
+            # atomicity is trivial there — the eager path negotiates
+            # nothing, the list fuses as one pytree
+            return allreduce_async(
+                list(tensors), op=rop, process_set=ps, **kwargs
+            )
         from ..native.controller import OP_ALLREDUCE
 
         name = kwargs.pop("name", None) or ctrl.auto_group_name(OP_ALLREDUCE)

@@ -35,9 +35,10 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..common.exceptions import HorovodInternalError
 from ..common.process_sets import ProcessSet, global_process_set
-from ..common.topology import Topology, WORLD_AXIS
+from ..common.topology import DCN_AXIS, ICI_AXIS, Topology, WORLD_AXIS
 from ..metrics import instruments as _metrics
 from ..utils.env_parser import Config
+from .comm_model import modeled_collective_bytes
 from .reduce_ops import ReduceOp
 
 _CACHE_HIT = _metrics.EXEC_CACHE.labels("hit")
@@ -92,6 +93,9 @@ class CollectiveEngine:
         self._cache = {}  # signature -> compiled callable
         self._set_ctxs = {}  # process_set_id -> _SetCtx
         self._world_ctx = self._build_ctx(None)
+        self._hier = None  # lazy (hmesh, slot_grid) | False; see _hier_info
+        self._spans_dcn = None  # lazy bool; see _account_flat
+        self._dcn_comp = None  # lazy (name, compression); _dcn_compression
 
     # -- per-set topology contexts ------------------------------------------
 
@@ -197,26 +201,244 @@ class CollectiveEngine:
             _CACHE_HIT.inc()
         return cached
 
-    def _compile_spmd(self, key, body_factory, ctx: "_SetCtx", in_specs):
+    def _compile_spmd(self, key, body_factory, ctx: "_SetCtx", in_specs,
+                      mesh=None):
         """Cache a jit(shard_map(body_factory())) over the set's mesh with
         replicated outputs — the shard_map-flavored sibling of
         ``_compile`` (same ``key + set_id`` cache protocol).  The factory
         runs only on a cache miss, keeping the hot cache-hit path free of
-        closure/constant construction."""
+        closure/constant construction.  ``mesh`` overrides the set's 1-D
+        mesh (the hierarchical path traces over the 2-D fabric mesh)."""
         key = key + (ctx.set_id,)
         cached = self._cache.get(key)
         if cached is None:
             _CACHE_MISS.inc()
             cached = _timed(key[0], jax.jit(
                 jax.shard_map(
-                    body_factory(), mesh=ctx.mesh, in_specs=in_specs,
-                    out_specs=P(), check_vma=False,
+                    body_factory(), mesh=mesh or ctx.mesh,
+                    in_specs=in_specs, out_specs=P(), check_vma=False,
                 )
             ))
             self._cache[key] = cached
         else:
             _CACHE_HIT.inc()
         return cached
+
+    # -- hierarchical (ICI x DCN) routing ------------------------------------
+
+    def _hier_info(self):
+        """``(hmesh, slot_grid)`` for the world set when the topology has
+        a real DCN tier, else None.  ``slot_grid[d, i]`` is the WORLD
+        device slot of the chip at hierarchical-mesh position ``(d, i)``
+        — the lead-mask lookup (slices need not be contiguous in world
+        order).  Cached: topology is frozen for the engine's lifetime."""
+        if self._hier is None:
+            if self.topology.num_slices <= 1:
+                self._hier = False
+            else:
+                hmesh = self.topology.hierarchical_mesh()
+                slot = {d: k for k, d in enumerate(self.topology.devices)}
+                grid = np.asarray(
+                    [[slot[dev] for dev in row] for row in hmesh.devices],
+                    dtype=np.int32,
+                )
+                self._hier = (hmesh, grid)
+        return self._hier or None
+
+    def _route_hierarchical(self, ctx: "_SetCtx", op: ReduceOp) -> bool:
+        """True when an allreduce should take the two-level path: the
+        HVD_TPU_HIERARCHICAL_ALLREDUCE / HOROVOD_HIERARCHICAL_ALLREDUCE
+        flag is set, the topology spans >1 slice, the call is world-scoped
+        (a process subset need not align with fabric tiers) and the op is
+        a sum-based reduction (the reference op's contract)."""
+        return (
+            self.config.hierarchical_allreduce
+            and ctx.set_id == 0
+            and op in (ReduceOp.AVERAGE, ReduceOp.SUM)
+            and self._hier_info() is not None
+        )
+
+    def routes_hierarchical(
+        self, op: ReduceOp,
+        process_set: Optional[ProcessSet] = None,
+    ) -> bool:
+        """Public probe of :meth:`_route_hierarchical` for the dispatch
+        layer: collective_ops consults it before handing an allreduce to
+        the native controller, which negotiates the FLAT wire protocol —
+        a routed call must stay on the engine so the two-level program
+        (and its DCN wire compression) actually runs."""
+        ctx = self._ctx(
+            process_set if process_set is not None else global_process_set
+        )
+        return self._route_hierarchical(ctx, op)
+
+    def _dcn_compression(self):
+        """The env-selected DCN wire compression for routed calls
+        (HVD_TPU_DCN_WIRE_DTYPE), or None.  Stateless — no error
+        feedback on the routed path (docs/COLLECTIVES.md).  Resolved
+        once per config value (this sits on the per-collective dispatch
+        path; the string compare keeps test re-configuration working)."""
+        name = self.config.dcn_wire_dtype
+        cached = self._dcn_comp
+        if cached is None or cached[0] != name:
+            from ..compression import dcn_compression_from_name
+
+            cached = (name, dcn_compression_from_name(name))
+            self._dcn_comp = cached
+        return cached[1]
+
+    def _stacked_global_hier(self, x: jax.Array, hmesh) -> jax.Array:
+        """The hierarchical-mesh sibling of :meth:`_stacked_global`: the
+        same per-chip tiled contribution, viewed as a (world, ...) array
+        with dim 0 sharded over BOTH fabric axes.  Every local shard is
+        this process's contribution, so the world-vs-mesh device
+        ordering never forces a copy."""
+        x = jnp.asarray(x)
+        shards = [
+            jax.device_put(x[None], d) for d in self.topology.local_devices
+        ]
+        global_shape = (self.topology.size,) + tuple(x.shape)
+        sharding = NamedSharding(hmesh, P((DCN_AXIS, ICI_AXIS)))
+        return jax.make_array_from_single_device_arrays(
+            global_shape, sharding, shards
+        )
+
+    def _account_tier_bytes(self, ici: int, dcn: int) -> None:
+        if ici:
+            _metrics.COLLECTIVE_ICI_BYTES.inc(int(ici))
+        if dcn:
+            _metrics.COLLECTIVE_DCN_BYTES.inc(int(dcn))
+
+    def _account_flat(self, nbytes: int, n: int,
+                      factor: float = 2.0) -> None:
+        """Book a flat collective's modeled fabric traffic over ``n``
+        contributors: the ring stream is ``factor·(n-1)/n·payload`` (2
+        for allreduce, 1 for reduce-scatter / allgather), attributed to
+        DCN when the world spans slices (the bottleneck-link view
+        comm_model documents) and to ICI otherwise."""
+        if n <= 1 or not nbytes:
+            return
+        stream = int(factor * (n - 1) * nbytes // n)
+        if self._spans_dcn is None:
+            # num_slices rescans the device list per call; the topology
+            # is frozen for the engine's lifetime, so resolve tier
+            # attribution once off the per-collective hot path
+            self._spans_dcn = self.topology.num_slices > 1
+        if self._spans_dcn:
+            self._account_tier_bytes(0, stream)
+        else:
+            self._account_tier_bytes(stream, 0)
+
+    def hierarchical_allreduce_multi(
+        self,
+        xs: Sequence[jax.Array],
+        op: ReduceOp = ReduceOp.AVERAGE,
+        prescale_factor: float = 1.0,
+        postscale_factor: float = 1.0,
+        process_set: Optional[ProcessSet] = None,
+        dcn_compression=None,
+        max_signatures: int = 64,
+    ) -> Optional[List[jax.Array]]:
+        """N two-level (ICI × DCN) allreduces in ONE compiled cached
+        program — the hierarchical sibling of :meth:`allreduce_multi` /
+        :meth:`reducescatter_multi`.
+
+        Per buffer: lead-masked contribution → intra-slice ICI
+        reduce-scatter (full precision) → inter-slice DCN exchange of the
+        1/n_ici shard (in ``dcn_compression``'s wire dtype when given,
+        decompressed before leaving the shard) → ICI allgather.
+        Reference: NCCLHierarchicalAllreduce (nccl_operations.cc) — the
+        intra/inter communicator split, as one XLA program over the 2-D
+        fabric mesh.
+
+        Returns None when the caller should use the flat path instead:
+        non-SUM/AVERAGE ops, bool leaves, no DCN tier in the topology, a
+        non-world process set, or the signature-count churn guard."""
+        if op not in (ReduceOp.AVERAGE, ReduceOp.SUM):
+            return None
+        ctx = self._member_ctx(process_set)
+        if ctx.set_id != 0:
+            return None
+        info = self._hier_info()
+        if info is None:
+            return None
+        hmesh, slot_grid = info
+        xs = [jnp.asarray(x) for x in xs]
+        if any(x.dtype == jnp.bool_ for x in xs):
+            return None
+        if ctx.n == 1:
+            scale = prescale_factor * postscale_factor
+            if scale != 1.0:
+                return [x * jnp.asarray(scale, x.dtype) for x in xs]
+            return list(xs)
+        n = ctx.n
+        wire = (
+            str(dcn_compression.wire_dtype)
+            if dcn_compression is not None else None
+        )
+        key = (
+            "hier_allreduce_multi",
+            tuple((x.shape, str(x.dtype)) for x in xs),
+            int(op), wire, hmesh.devices.shape,  # mesh shape: a changed
+            # HVD_TPU_SLICE_SIZE must never reuse a stale fabric layout
+        )
+        if key + (ctx.set_id,) not in self._cache:
+            n_sigs = sum(
+                1 for k in self._cache if k[0] == "hier_allreduce_multi"
+            )
+            if n_sigs >= max_signatures:
+                return None
+
+        def make_body():
+            from . import spmd_ops
+
+            lead = jnp.asarray(ctx.lead_slots)
+            slots = jnp.asarray(slot_grid)
+
+            def body(pre, post, *aa):
+                d_idx = jax.lax.axis_index(DCN_AXIS)
+                i_idx = jax.lax.axis_index(ICI_AXIS)
+                is_lead = jnp.any(slots[d_idx, i_idx] == lead)
+                outs = []
+                for a in aa:
+                    a0 = a[0]
+                    v = jnp.where(is_lead, a0 * pre, jnp.zeros_like(a0))
+                    red, _ = spmd_ops._two_level_sum_leaf(
+                        v, ICI_AXIS, DCN_AXIS, dcn_compression, None
+                    )
+                    if op == ReduceOp.AVERAGE:
+                        red = red / jnp.asarray(n, red.dtype)
+                    outs.append(red * post)
+                return tuple(outs)
+
+            return body
+
+        compiled = self._compile_spmd(
+            key, make_body, ctx,
+            in_specs=(P(), P()) + (P((DCN_AXIS, ICI_AXIS)),) * len(xs),
+            mesh=hmesh,
+        )
+        # book bytes for the fabric layout the compiled program actually
+        # uses — the cached hmesh, not an env-fresh topology.slice_size
+        # (HVD_TPU_SLICE_SIZE changed mid-process must not skew counters)
+        n_dcn, n_ici = hmesh.devices.shape
+        try:
+            for x in xs:
+                m = modeled_collective_bytes(
+                    x.shape, n_dcn * n_ici, n_ici,
+                    wire_dtype=wire, dtype=str(x.dtype),
+                )
+                self._account_tier_bytes(m["ici_bytes"], m["dcn_bytes"])
+        except Exception:  # accounting must never sink the collective
+            pass
+        dt = xs[0].dtype
+        g = self._run(
+            compiled,
+            jnp.asarray(prescale_factor, dt),
+            jnp.asarray(postscale_factor, dt),
+            *[self._stacked_global_hier(x, hmesh) for x in xs],
+        )
+        return [self._local_view(o) for o in g]
 
     def _unique_rows(self, a: jax.Array, ctx: "_SetCtx") -> jax.Array:
         """(set_size, ...) tiled stack -> (n_member_procs, ...) unique
@@ -281,6 +503,13 @@ class CollectiveEngine:
                         prescale_factor * postscale_factor, x.dtype
                     )
             return x
+        if self._route_hierarchical(ctx, op):
+            routed = self.hierarchical_allreduce_multi(
+                [x], op, prescale_factor, postscale_factor, process_set,
+                dcn_compression=self._dcn_compression(),
+            )
+            if routed is not None:
+                return routed[0]
         n = ctx.n
         if x.dtype != jnp.bool_ and op in (
             ReduceOp.AVERAGE, ReduceOp.SUM, ReduceOp.MIN, ReduceOp.MAX
@@ -333,6 +562,8 @@ class CollectiveEngine:
             compiled = self._compile_spmd(
                 key, make_body, ctx, in_specs=(P(WORLD_AXIS), P(), P())
             )
+            if op in (ReduceOp.AVERAGE, ReduceOp.SUM):
+                self._account_flat(x.nbytes, ctx.n)
             g = self._run(
                 compiled,
                 self._stacked_global(x, ctx),
@@ -391,6 +622,14 @@ class CollectiveEngine:
             if scale != 1.0:
                 return [x * jnp.asarray(scale, x.dtype) for x in xs]
             return list(xs)
+        if self._route_hierarchical(ctx, op):
+            routed = self.hierarchical_allreduce_multi(
+                xs, op, prescale_factor, postscale_factor, process_set,
+                dcn_compression=self._dcn_compression(),
+                max_signatures=max_signatures,
+            )
+            if routed is not None:
+                return routed
         n = ctx.n
         key = (
             "allreduce_multi",
@@ -426,6 +665,8 @@ class CollectiveEngine:
             key, make_body, ctx,
             in_specs=(P(), P()) + (P(WORLD_AXIS),) * len(xs),
         )
+        for x in xs:
+            self._account_flat(x.nbytes, n)
         dt = xs[0].dtype
         g = self._run(
             compiled,
@@ -483,6 +724,7 @@ class CollectiveEngine:
                 return u.reshape((-1,) + u.shape[2:])
 
             compiled = self._compile(key, fn, ctx)
+            self._account_flat(x.nbytes * n, n, 1.0)
             return self._local_view(
                 self._run(compiled, self._stacked_global(x, ctx))
             )
@@ -671,6 +913,7 @@ class CollectiveEngine:
             return jax.lax.dynamic_slice_in_dim(r, me * chunk, chunk, axis=0)
 
         compiled = self._compile(key, fn, ctx)
+        self._account_flat(x.nbytes, n, 1.0)
         return self._local_view(
             self._run(compiled, self._stacked_global(x, ctx))
         )
@@ -728,6 +971,8 @@ class CollectiveEngine:
             return tuple(outs)
 
         compiled = self._compile(key, fn, ctx)
+        for x in xs:
+            self._account_flat(x.nbytes, n, 1.0)
         g = self._run(
             compiled, *[self._stacked_global(x, ctx) for x in xs]
         )

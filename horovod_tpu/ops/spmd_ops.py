@@ -104,6 +104,66 @@ def allreduce(
     raise ValueError(f"unknown reduce op {op!r}")
 
 
+def _two_level_sum_leaf(
+    t: jax.Array,
+    ici_axis: str,
+    dcn_axis: str,
+    dcn_compression=None,
+    residual: Optional[jax.Array] = None,
+):
+    """Two-level SUM of one leaf's per-chip contributions: ICI
+    reduce-scatter (full precision) → DCN exchange of the 1/n_ici shard
+    (optionally in the compression's wire dtype, decompressed before
+    leaving the shard) → ICI allgather.  Returns ``(sum, new_residual)``
+    — the shared core of :func:`hierarchical_allreduce`, the engine's
+    ``hierarchical_allreduce_multi`` body and the ZeRO two-level
+    exchange, so one set of oracle tests covers every caller.
+
+    With compression, the DCN hop is an all-gather of the wire shard
+    followed by a local sum in the accumulation dtype: the 16-bit cast
+    touches only bytes on the slow fabric, never the arithmetic
+    (docs/COLLECTIVES.md).  ``residual`` is the error-feedback state
+    (shard-shaped; None = no feedback or first step).
+    """
+    t = jnp.asarray(t)
+    n_ici = jax.lax.axis_size(ici_axis)
+    flat = t.reshape(-1)
+    pad = (-flat.size) % n_ici
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    # ICI reduce-scatter: each chip owns 1/n_ici of the slice sum
+    piece = jax.lax.psum_scatter(
+        flat, ici_axis, scatter_dimension=0, tiled=True
+    )
+    new_residual = residual
+    if dcn_compression is not None:
+        wire, new_residual = dcn_compression.compress_shard(piece, residual)
+        if wire.dtype != piece.dtype:
+            # wire bytes cross DCN; accumulation stays in the payload
+            # dtype.  The barriers pin the casts to THIS side of the
+            # collective — the algebraic simplifier may otherwise hoist
+            # the decompress convert across the all-gather and put full-
+            # precision bytes back on the slow fabric.
+            wire = jax.lax.optimization_barrier(wire)
+            gathered = jax.lax.optimization_barrier(
+                jax.lax.all_gather(wire, dcn_axis)  # (n_dcn, shard)
+            )
+            piece = jnp.sum(
+                dcn_compression.decompress_shard(gathered, piece.dtype),
+                axis=0,
+            )
+        else:  # int / already-narrow leaf: nothing was compressed
+            piece = jax.lax.psum(piece, dcn_axis)
+    else:
+        # DCN allreduce of the shard (the only inter-group traffic)
+        piece = jax.lax.psum(piece, dcn_axis)
+    # ICI allgather reassembles the full reduced tensor
+    full = jax.lax.all_gather(piece, ici_axis, tiled=True)
+    if pad:
+        full = full[: t.size]
+    return full.reshape(t.shape), new_residual
+
+
 def hierarchical_allreduce(
     tensor: Any,
     average: Optional[bool] = None,
@@ -112,6 +172,8 @@ def hierarchical_allreduce(
     dcn_axis: str = DCN_AXIS,
     prescale_factor: float = 1.0,
     postscale_factor: float = 1.0,
+    dcn_compression=None,
+    residual: Any = None,
 ) -> Any:
     """Two-level allreduce over a 2-D ``(dcn, ici)`` mesh
     (``topology.hierarchical_mesh()``): intra-slice ICI reduce-scatter →
@@ -126,6 +188,13 @@ def hierarchical_allreduce(
     Numerically identical to a flat ``psum`` over both axes (modulo
     floating-point association order).  Sum/Average only, like the
     reference op.
+
+    ``dcn_compression`` (a :class:`horovod_tpu.compression.DcnCompression`)
+    casts only the DCN-crossing shard to the wire dtype; accumulation
+    stays in the payload dtype.  With ``error_feedback`` compression the
+    call returns ``(result, new_residual)`` and ``residual`` (a pytree of
+    shard-shaped leaves from the previous call, or None the first time)
+    must be threaded by the caller.
     """
     if op is not None and average is not None:
         raise ValueError("specify either op or average, not both")
@@ -135,36 +204,126 @@ def hierarchical_allreduce(
         raise ValueError(
             f"hierarchical_allreduce supports Sum/Average, got {op!r}"
         )
-    n_ici = jax.lax.axis_size(ici_axis)
-    n_total = n_ici * jax.lax.axis_size(dcn_axis)
-
-    def hier_leaf(t):
-        t = jnp.asarray(t)
-        flat = t.reshape(-1)
-        pad = (-flat.size) % n_ici
-        if pad:
-            flat = jnp.concatenate(
-                [flat, jnp.zeros((pad,), flat.dtype)]
-            )
-        # ICI reduce-scatter: each chip owns 1/n_ici of the slice sum
-        piece = jax.lax.psum_scatter(
-            flat, ici_axis, scatter_dimension=0, tiled=True
-        )
-        # DCN allreduce of the shard (the only inter-group traffic)
-        piece = jax.lax.psum(piece, dcn_axis)
-        # ICI allgather reassembles the full reduced tensor
-        full = jax.lax.all_gather(piece, ici_axis, tiled=True)
-        if pad:
-            full = full[: t.size]
-        return full.reshape(t.shape)
+    n_total = jax.lax.axis_size(ici_axis) * jax.lax.axis_size(dcn_axis)
+    with_feedback = (
+        dcn_compression is not None
+        and getattr(dcn_compression, "error_feedback", False)
+    )
 
     x = _scale(tensor, prescale_factor)
-    red = jax.tree_util.tree_map(hier_leaf, x)
+    leaves, treedef = jax.tree_util.tree_flatten(x)
+    res_leaves = (
+        treedef.flatten_up_to(residual) if residual is not None
+        else [None] * len(leaves)
+    )
+    red, new_res = [], []
+    for leaf, res in zip(leaves, res_leaves):
+        r, nr = _two_level_sum_leaf(
+            leaf, ici_axis, dcn_axis, dcn_compression, res
+        )
+        red.append(r)
+        new_res.append(nr)
+    red = jax.tree_util.tree_unflatten(treedef, red)
     if op == ReduceOp.AVERAGE:
         red = jax.tree_util.tree_map(
             lambda t: t / jnp.asarray(n_total, t.dtype), red
         )
-    return _scale(red, postscale_factor)
+    red = _scale(red, postscale_factor)
+    if with_feedback:
+        return red, jax.tree_util.tree_unflatten(treedef, new_res)
+    return red
+
+
+def _two_level_reduce_scatter_flat(
+    buf: jax.Array,
+    ici_axis: str,
+    dcn_axis: str,
+    dcn_compression=None,
+    residual: Optional[jax.Array] = None,
+):
+    """Two-level reduce-scatter of a flat buffer whose length divides
+    ``n_ici * n_dcn``: the chip at mesh position ``(d, i)`` receives the
+    fully reduced chunk ``d * n_ici + i`` — exactly the chunk a flat
+    ``psum_scatter`` over the row-major world order would hand it, so a
+    ZeroPlan built for the flat world slices identically.
+
+    Landing control: ICI scatters first (fast fabric, full precision),
+    then the 1/n_ici piece crosses DCN (optionally wire-compressed with
+    fp32 accumulation via all_to_all + local sum).  A local chunk
+    transpose before the first scatter makes the two-level landing match
+    the flat chunk order.  Returns ``(shard, new_residual)``; the
+    residual (error feedback) is piece-shaped — ``size / n_ici``.
+    """
+    n_ici = jax.lax.axis_size(ici_axis)
+    n_dcn = jax.lax.axis_size(dcn_axis)
+    s = buf.size // (n_ici * n_dcn)
+    # permuted position (i, d) holds flat chunk (d, i): after the ICI
+    # scatter chip i holds [chunk d*n_ici+i for all d], after the DCN
+    # scatter chip (d, i) holds chunk d*n_ici+i
+    permuted = buf.reshape(n_dcn, n_ici, s).transpose(1, 0, 2).reshape(-1)
+    piece = jax.lax.psum_scatter(
+        permuted, ici_axis, scatter_dimension=0, tiled=True
+    )  # (n_dcn * s,): this chip's slice-sum of its n_dcn chunks
+    new_residual = residual
+    if dcn_compression is not None:
+        wire, new_residual = dcn_compression.compress_shard(piece, residual)
+        if wire.dtype != piece.dtype:
+            # wire-dtype all_to_all (the only DCN traffic), then the
+            # cross-slice sum runs locally in the accumulation dtype;
+            # barriers pin the casts against convert-hoisting (see
+            # _two_level_sum_leaf)
+            recv = jax.lax.optimization_barrier(jax.lax.all_to_all(
+                jax.lax.optimization_barrier(wire),
+                dcn_axis, split_axis=0, concat_axis=0, tiled=True,
+            ))
+            shard = jnp.sum(
+                dcn_compression.decompress_shard(
+                    recv.reshape(n_dcn, s), piece.dtype
+                ),
+                axis=0,
+            )
+            return shard, new_residual
+    shard = jax.lax.psum_scatter(
+        piece, dcn_axis, scatter_dimension=0, tiled=True
+    )
+    return shard, new_residual
+
+
+def _two_level_all_gather_flat(
+    shard: jax.Array,
+    ici_axis: str,
+    dcn_axis: str,
+    dcn_compression=None,
+) -> jax.Array:
+    """Inverse of :func:`_two_level_reduce_scatter_flat`: gather the
+    per-chip chunks back into flat order — DCN first (optionally in the
+    wire dtype; every chip applies the same cast, so replicas stay
+    bit-identical), then ICI, then the inverse chunk transpose."""
+    n_ici = jax.lax.axis_size(ici_axis)
+    n_dcn = jax.lax.axis_size(dcn_axis)
+    s = shard.size
+    if dcn_compression is not None:
+        wire, _ = dcn_compression.compress_shard(shard, None)
+        if wire.dtype != shard.dtype:
+            # barriers pin the wire casts against convert-hoisting (see
+            # _two_level_sum_leaf)
+            piece = dcn_compression.decompress_shard(
+                jax.lax.optimization_barrier(jax.lax.all_gather(
+                    jax.lax.optimization_barrier(wire),
+                    dcn_axis, tiled=True,
+                )),
+                shard.dtype,
+            )
+        else:
+            piece = jax.lax.all_gather(shard, dcn_axis, tiled=True)
+    else:
+        piece = jax.lax.all_gather(shard, dcn_axis, tiled=True)
+    full_perm = jax.lax.all_gather(piece, ici_axis, tiled=True)
+    return (
+        full_perm.reshape(n_ici, n_dcn, s)
+        .transpose(1, 0, 2)
+        .reshape(-1)
+    )
 
 
 def allgather(tensor: Any, axis: str = WORLD_AXIS) -> Any:

@@ -70,6 +70,7 @@ def allreduce_gradients(
     hierarchical: Optional[bool] = None,
     ici_axis: str = ICI_AXIS,
     dcn_axis: str = DCN_AXIS,
+    dcn_compression=None,
 ) -> Any:
     """Average a gradient pytree across workers, picking the SPMD or eager
     path automatically.  Reference: the allreduce step of §3.2.
@@ -78,7 +79,13 @@ def allreduce_gradients(
     HOROVOD_HIERARCHICAL_ALLREDUCE / NCCLHierarchicalAllreduce); it
     defaults to the env flag and requires tracing over a
     ``hierarchical_mesh()``'s (dcn, ici) axes — in a flat or eager context
-    it falls back to the flat reduction (numerically identical).
+    it falls back to the flat reduction (numerically identical); on the
+    eager path the engine itself routes two-level when the flag is set
+    and the topology spans slices (CollectiveEngine._route_hierarchical).
+    ``dcn_compression`` casts only the DCN-crossing shard to its wire
+    dtype on the SPMD two-level path (stateless here — no error
+    feedback; thread a residual through
+    ``spmd_ops.hierarchical_allreduce`` directly for that).
     """
     if hierarchical is None:
         st = basics._state
@@ -91,10 +98,17 @@ def allreduce_gradients(
         and _in_spmd_context(ici_axis)
         and _in_spmd_context(dcn_axis)
     ):
+        if dcn_compression is not None and dcn_compression.error_feedback:
+            raise ValueError(
+                "allreduce_gradients is stateless — use "
+                "spmd_ops.hierarchical_allreduce(residual=...) to carry "
+                "the error-feedback residual"
+            )
         return spmd_ops.hierarchical_allreduce(
             grads, op=op, ici_axis=ici_axis, dcn_axis=dcn_axis,
             prescale_factor=prescale_factor,
             postscale_factor=postscale_factor,
+            dcn_compression=dcn_compression,
         )
     if _in_spmd_context(axis):
         return spmd_ops.allreduce(
@@ -122,6 +136,7 @@ def DistributedOptimizer(
     hierarchical: Optional[bool] = None,
     ici_axis: str = ICI_AXIS,
     dcn_axis: str = DCN_AXIS,
+    dcn_compression=None,
 ) -> optax.GradientTransformation:
     """Wrap an optax optimizer so updates see globally reduced gradients.
 
@@ -131,7 +146,10 @@ def DistributedOptimizer(
     ``compression`` on the wire, and local aggregation), expressed as an
     optax gradient transformation.  ``hierarchical=True`` (or the
     HVD_TPU_HIERARCHICAL_ALLREDUCE env flag) selects the two-level
-    ICI×DCN reduction when stepping inside a ``hierarchical_mesh()``.
+    ICI×DCN reduction when stepping inside a ``hierarchical_mesh()``;
+    ``dcn_compression`` then compresses only the DCN-crossing shard
+    (vs ``compression``, which casts the WHOLE gradient around the whole
+    reduction — the two compose but usually you want one or the other).
     """
     def _reduce(updates, params=None):
         if compression is not None:
@@ -143,6 +161,7 @@ def DistributedOptimizer(
             process_set=process_set,
             hierarchical=hierarchical,
             ici_axis=ici_axis, dcn_axis=dcn_axis,
+            dcn_compression=dcn_compression,
         )
         if compression is not None:
             updates = compression.decompress(updates, ctx)
@@ -280,9 +299,12 @@ class ZeroPlan:
 class ZeroState(NamedTuple):
     """Optimizer state of the ZeRO wrappers: the inner optimizer's state
     over THIS RANK's flat parameter shards (one 1-D slice per dtype
-    bucket)."""
+    bucket).  ``residual`` carries the DCN-hop error-feedback state (one
+    shard-shaped leaf per dtype bucket) when a hierarchical wrapper runs
+    with ``DcnCompression(error_feedback=True)``; None otherwise."""
 
     inner: Any
+    residual: Any = None
 
 
 def _zero_cast_grads(grads_leaves, specs):
@@ -329,6 +351,8 @@ def ZeroDistributedOptimizer(
     process_set: Optional[ProcessSet] = None,
     backward_passes_per_step: int = 1,
     min_total_bytes: Optional[int] = None,
+    hierarchical: Optional[bool] = None,
+    dcn_compression=None,
 ) -> optax.GradientTransformation:
     """ZeRO stage-1 sharded-state optimizer for the EAGER (one process
     per chip) deployment — the sharded sibling of
@@ -356,6 +380,25 @@ def ZeroDistributedOptimizer(
     wrapper falls back to replicated state + one allreduce — the
     decision is a pure function of the (static) parameter sizes, so
     every rank takes the same path with no negotiation.
+
+    ``hierarchical`` (default: the HVD_TPU_HIERARCHICAL_ALLREDUCE env
+    flag) selects the two-level fabric-aware exchange when the topology
+    spans >1 slice and processes group evenly into slices: gradients
+    reduce-scatter over the SLICE-LOCAL process set (ICI), only the
+    1/n_local shard crosses DCN (an allreduce over the cross-slice set
+    of same-position processes — optionally in ``dcn_compression``'s
+    wire dtype, with the error-feedback residual riding
+    ``ZeroState.residual``), and the update deltas allgather back on
+    ICI.  The state then shards by the slice-local world (the ZeRO++
+    "secondary partition": memory drops by processes-per-slice instead
+    of world, in exchange for DCN traffic shrinking to the hierarchical
+    -allreduce level — docs/COLLECTIVES.md has the byte model).  When
+    the topology offers no such grouping the wrapper silently uses the
+    flat exchange; both decisions are pure functions of the frozen
+    topology, so every rank agrees with no negotiation.  NOTE: the
+    eager cross-slice allreduce accumulates in the wire dtype (one
+    negotiated op); prefer bf16 (fp32-range) wire, or the SPMD wrapper
+    whose DCN hop accumulates in fp32.
     """
     if op not in (ReduceOp.AVERAGE, ReduceOp.SUM):
         raise ValueError(f"ZeroDistributedOptimizer supports Sum/Average, "
@@ -366,50 +409,170 @@ def ZeroDistributedOptimizer(
         eng = basics._require_init().engine
         return eng.member_info(process_set)
 
+    # Hierarchical topology resolution — once, lazily (init() may run
+    # before hvd.init in eval_shape contexts; the first real call pins
+    # it).  Value: None = flat exchange; else (local_ps, cross_ps,
+    # n_local, n_slices) with the process sets registered symmetrically
+    # on every rank (same deterministic order).
+    hier_cache: dict = {}
+
+    def _hier_sets():
+        if "v" in hier_cache:
+            return hier_cache["v"]
+        v = None
+        if process_set is None:
+            st = basics._require_init()
+            want = hierarchical
+            if want is None:
+                want = bool(st.config is not None
+                            and st.config.hierarchical_allreduce)
+            groups = st.topology.process_slice_groups() if want else None
+            if groups is not None and len(groups[0]) > 1:
+                import horovod_tpu as hvd  # runtime: the package is loaded
+
+                me_proc = st.topology.process_index
+
+                def chips(procs):
+                    return [
+                        r for r, d in enumerate(st.topology.devices)
+                        if getattr(d, "process_index", 0) in set(procs)
+                    ]
+
+                local_sets = [hvd.add_process_set(chips(g)) for g in groups]
+                n_local = len(groups[0])
+                cross_sets = [
+                    hvd.add_process_set(
+                        chips([g[j] for g in groups]))
+                    for j in range(n_local)
+                ]
+                my_slice = next(
+                    i for i, g in enumerate(groups) if me_proc in g
+                )
+                my_pos = groups[my_slice].index(me_proc)
+                v = (local_sets[my_slice], cross_sets[my_pos],
+                     n_local, len(groups))
+        hier_cache["v"] = v
+        return v
+
+    feedback = dcn_compression is not None and dcn_compression.error_feedback
+
     # The plan is a pure function of (leaf shapes/dtypes, world); cache
     # it so un-jitted eager steps don't pay O(leaves) bucket/padding
     # arithmetic per update.  Keyed on world too: elastic restarts that
     # resize re-plan instead of slicing with stale shard sizes.
     plan_cache: dict = {}
 
-    def _plan_for(params) -> Tuple[ZeroPlan, Any, bool, int, int]:
+    def _plan_for(params):
         if params is None:
             raise ValueError(
                 "ZeroDistributedOptimizer requires params at init/update "
                 "time (the inner update runs on the parameter shard)"
             )
         world, me = _world_me()
+        hier = _hier_sets() if world > 1 else None
+        plan_world = hier[2] if hier is not None else world
         leaves, treedef = jax.tree_util.tree_flatten(params)
-        key = (world, treedef, tuple(
+        key = (plan_world, treedef, tuple(
             (tuple(np.shape(x)),
              jnp.dtype(getattr(x, "dtype", None) or jnp.asarray(x).dtype))
             for x in leaves
         ))
         cached = plan_cache.get(key)
         if cached is None:
-            plan = ZeroPlan(leaves, world)
-            cached = (plan, world > 1 and plan.total_bytes >= min_bytes)
+            plan = ZeroPlan(leaves, plan_world)
+            cached = (plan, plan_world > 1
+                      and plan.total_bytes >= min_bytes)
             plan_cache[key] = cached
         plan, sharded = cached
-        return plan, treedef, sharded, world, me
+        if hier is not None and sharded:
+            # shard index = this process's position in the slice-local
+            # member order (the engine's member index for that set — the
+            # same order its reducescatter chunks and allgather concats)
+            eng = basics._require_init().engine
+            _, me_local = eng.member_info(hier[0])
+            return plan, treedef, sharded, world, me_local, hier
+        return plan, treedef, sharded, world, me, None
+
+    def _init_residual(plan, hier):
+        if not (feedback and hier is not None):
+            return None
+        return [
+            jnp.zeros((s,), jnp.dtype(dt))
+            for (dt, _), s in zip(plan.buckets, plan.shard_sizes)
+        ]
 
     def init(params):
-        plan, _, sharded, _, me = _plan_for(params)
+        plan, _, sharded, _, me, hier = _plan_for(params)
         bufs = plan.flatten(jax.tree_util.tree_leaves(params))
         if sharded:
             bufs = _slice_shards(plan, bufs, me)
         inner_state = optimizer.init(bufs)
         _metrics.OPTIM_STATE_SHARD_BYTES.set(
             state_bytes_abstract(inner_state))
-        return ZeroState(inner=inner_state)
+        return ZeroState(
+            inner=inner_state,
+            residual=_init_residual(plan, hier) if sharded else None,
+        )
 
     def update(grads, state, params=None):
-        plan, treedef, sharded, world, me = _plan_for(params)
+        plan, treedef, sharded, world, me, hier = _plan_for(params)
         g_leaves = _zero_cast_grads(
             jax.tree_util.tree_leaves(grads), plan.specs)
         g_bufs = plan.flatten(g_leaves)
         p_bufs = plan.flatten(jax.tree_util.tree_leaves(params))
-        if sharded:
+        new_residual = state.residual
+        if sharded and hier is not None:
+            local_ps, cross_ps, n_local, n_slices = hier
+            from .ops.reduce_ops import Sum as _Sum
+
+            _metrics.OPTIM_RS_BYTES.inc(plan.padded_bytes)
+            # ICI: reduce-scatter the flat gradients over the slice
+            g_shards = collective_ops.reducescatter(
+                g_bufs, op=_Sum, name="zero.grads.local",
+                process_set=local_ps,
+            )
+            # DCN: allreduce only the 1/n_local shard across slices, in
+            # the wire dtype when compression is on (error feedback
+            # rides ZeroState.residual)
+            residuals = (
+                state.residual if state.residual is not None
+                else [None] * len(g_shards)
+            )
+            wires, new_residual = [], []
+            for shard, res in zip(g_shards, residuals):
+                if dcn_compression is not None:
+                    w, nr = dcn_compression.compress_shard(shard, res)
+                else:
+                    w, nr = shard, res
+                wires.append(w)
+                new_residual.append(nr)
+            if not feedback:
+                new_residual = None
+            reduced = collective_ops.allreduce(
+                wires, op=_Sum, name="zero.grads.cross",
+                process_set=cross_ps,
+            )
+            def _finish(w, shard):
+                r = (dcn_compression.decompress_shard(w, shard.dtype)
+                     if dcn_compression is not None else w)
+                if op == ReduceOp.AVERAGE:
+                    r = r / jnp.asarray(world, r.dtype)
+                return r
+
+            g_shards = [
+                _finish(w, s) for w, s in zip(reduced, g_shards)
+            ]
+            p_shards = _slice_shards(plan, p_bufs, me)
+            u_shards, new_inner = optimizer.update(
+                g_shards, state.inner, p_shards
+            )
+            _metrics.OPTIM_AG_BYTES.inc(plan.shard_bytes)
+            # ICI: the update deltas fan back out within the slice; all
+            # slices computed identical shards, so params stay replicated
+            u_bufs = collective_ops.allgather(
+                u_shards, name="zero.updates.local", process_set=local_ps,
+            )
+        elif sharded:
             _metrics.OPTIM_RS_BYTES.inc(plan.padded_bytes)
             g_shards = collective_ops.reducescatter(
                 g_bufs, op=op, name="zero.grads",
@@ -436,7 +599,7 @@ def ZeroDistributedOptimizer(
         updates = jax.tree_util.tree_unflatten(
             treedef, plan.unflatten(u_bufs)
         )
-        return updates, ZeroState(inner=new_inner)
+        return updates, ZeroState(inner=new_inner, residual=new_residual)
 
     zero = optax.GradientTransformation(init, update)
     if backward_passes_per_step > 1:
@@ -460,6 +623,10 @@ def ZeroSpmdOptimizer(
     optimizer: optax.GradientTransformation,
     axis: str = WORLD_AXIS,
     op: ReduceOp = Average,
+    hierarchical: bool = False,
+    ici_axis: str = ICI_AXIS,
+    dcn_axis: str = DCN_AXIS,
+    dcn_compression=None,
 ) -> optax.GradientTransformation:
     """The SPMD twin of :func:`ZeroDistributedOptimizer` — call ``init``
     and ``update`` INSIDE a ``shard_map`` over ``axis`` (the per-chip
@@ -472,6 +639,18 @@ def ZeroSpmdOptimizer(
     update slices ``all_gather`` back (the second half).  The inner
     state holds only the shard, so Adam's m/v shrink by the axis size.
 
+    ``hierarchical=True`` is the two-level fabric-aware variant for a
+    ``hierarchical_mesh()``'s ``(dcn, ici)`` axes: the reduce-scatter
+    runs ICI-first at full precision and only the 1/n_ici piece crosses
+    DCN; the update-shard allgather crosses DCN first, then fans out on
+    ICI.  A local chunk transpose keeps the shard landing identical to
+    the flat order, so the partition (and the update arithmetic) is
+    bit-compatible with the flat wrapper (pinned by
+    tests/test_zero_optimizer.py).  ``dcn_compression``
+    (:class:`~horovod_tpu.compression.DcnCompression`) then casts only
+    the DCN-crossing bytes to the wire dtype; with ``error_feedback``
+    the quantization residual rides ``ZeroState.residual``.
+
     State layout across the mesh: every inner-state leaf that mirrors a
     shard buffer is axis-sharded — :func:`zero_opt_state_specs` builds
     the matching ``PartitionSpec`` tree for host-side init/donation
@@ -480,55 +659,107 @@ def ZeroSpmdOptimizer(
     if op not in (ReduceOp.AVERAGE, ReduceOp.SUM):
         raise ValueError(
             f"ZeroSpmdOptimizer supports Sum/Average, got {op!r}")
+    if dcn_compression is not None and not hierarchical:
+        raise ValueError(
+            "dcn_compression requires hierarchical=True (it compresses "
+            "the DCN hop, which only exists on the two-level exchange)")
+    feedback = hierarchical and dcn_compression is not None and \
+        dcn_compression.error_feedback
+
+    def _world():
+        if hierarchical:
+            return jax.lax.axis_size(ici_axis) * jax.lax.axis_size(dcn_axis)
+        return jax.lax.axis_size(axis)
+
+    def _me():
+        if hierarchical:
+            return (
+                jax.lax.axis_index(dcn_axis) * jax.lax.axis_size(ici_axis)
+                + jax.lax.axis_index(ici_axis)
+            )
+        return jax.lax.axis_index(axis)
 
     def _plan_for(params):
         if params is None:
             raise ValueError(
                 "ZeroSpmdOptimizer requires params at init/update time")
-        world = jax.lax.axis_size(axis)
         leaves, treedef = jax.tree_util.tree_flatten(params)
-        return ZeroPlan(leaves, world), treedef
+        return ZeroPlan(leaves, _world()), treedef
+
+    def _init_residual(plan):
+        if not feedback:
+            return None
+        n_ici = jax.lax.axis_size(ici_axis)
+        return [
+            jnp.zeros((padded // n_ici,), jnp.dtype(dt))
+            for (dt, _), padded in zip(plan.buckets, plan.padded_sizes)
+        ]
 
     def init(params):
         plan, _ = _plan_for(params)
-        me = jax.lax.axis_index(axis)
         bufs = plan.flatten(jax.tree_util.tree_leaves(params))
-        inner_state = optimizer.init(_slice_shards(plan, bufs, me))
+        inner_state = optimizer.init(_slice_shards(plan, bufs, _me()))
         # shapes are static, so the gauge is correct even though init
         # traces: set once per (re)trace with the shard's true bytes
         _metrics.OPTIM_STATE_SHARD_BYTES.set(
             state_bytes_abstract(inner_state))
-        return ZeroState(inner=inner_state)
+        return ZeroState(inner=inner_state, residual=_init_residual(plan))
 
     def update(grads, state, params=None):
         plan, treedef = _plan_for(params)
-        me = jax.lax.axis_index(axis)
+        me = _me()
         world = plan.world
         g_leaves = _zero_cast_grads(
             jax.tree_util.tree_leaves(grads), plan.specs)
         g_bufs = plan.flatten(g_leaves)
 
-        def rs(buf):
-            r = jax.lax.psum_scatter(
-                buf, axis, scatter_dimension=0, tiled=True
+        new_residual = state.residual
+        if hierarchical:
+            residuals = (
+                state.residual if state.residual is not None
+                else [None] * len(g_bufs)
             )
-            if op == ReduceOp.AVERAGE:
-                r = r / jnp.asarray(world, r.dtype)
-            return r
+            g_shards, new_residual = [], []
+            for buf, res in zip(g_bufs, residuals):
+                shard, nr = spmd_ops._two_level_reduce_scatter_flat(
+                    buf, ici_axis, dcn_axis, dcn_compression, res
+                )
+                if op == ReduceOp.AVERAGE:
+                    shard = shard / jnp.asarray(world, shard.dtype)
+                g_shards.append(shard)
+                new_residual.append(nr)
+            if not feedback:
+                new_residual = None
+        else:
+            def rs(buf):
+                r = jax.lax.psum_scatter(
+                    buf, axis, scatter_dimension=0, tiled=True
+                )
+                if op == ReduceOp.AVERAGE:
+                    r = r / jnp.asarray(world, r.dtype)
+                return r
 
-        g_shards = [rs(buf) for buf in g_bufs]
+            g_shards = [rs(buf) for buf in g_bufs]
         p_bufs = plan.flatten(jax.tree_util.tree_leaves(params))
         p_shards = _slice_shards(plan, p_bufs, me)
         u_shards, new_inner = optimizer.update(
             g_shards, state.inner, p_shards
         )
-        u_bufs = [
-            jax.lax.all_gather(u, axis, tiled=True) for u in u_shards
-        ]
+        if hierarchical:
+            u_bufs = [
+                spmd_ops._two_level_all_gather_flat(
+                    u, ici_axis, dcn_axis, dcn_compression
+                )
+                for u in u_shards
+            ]
+        else:
+            u_bufs = [
+                jax.lax.all_gather(u, axis, tiled=True) for u in u_shards
+            ]
         updates = jax.tree_util.tree_unflatten(
             treedef, plan.unflatten(u_bufs)
         )
-        return updates, ZeroState(inner=new_inner)
+        return updates, ZeroState(inner=new_inner, residual=new_residual)
 
     return optax.GradientTransformation(init, update)
 
@@ -537,7 +768,8 @@ def zero_opt_state_specs(
     optimizer: optax.GradientTransformation,
     params: Any,
     world: int,
-    axis: str = WORLD_AXIS,
+    axis=WORLD_AXIS,
+    dcn_compression=None,
 ) -> Any:
     """``PartitionSpec`` tree for a :func:`ZeroSpmdOptimizer` state over
     a mesh whose ``axis`` has ``world`` chips.
@@ -547,7 +779,13 @@ def zero_opt_state_specs(
     global view is the (world*shard,) concatenation of every chip's
     slice; scalars and anything else (step counts, schedule state) are
     replicated.  The inner state is derived via ``eval_shape`` over the
-    abstract shard buffers, so no device computation runs here."""
+    abstract shard buffers, so no device computation runs here.
+
+    ``axis`` may be a tuple of mesh axis names for the hierarchical
+    wrapper (``("dcn", "ici")`` — dim 0 sharded over both fabric tiers;
+    ``world`` is then the product of both axis sizes).  With
+    error-feedback ``dcn_compression`` the residual leaves (one per
+    dtype bucket, also per-chip) get the same sharded spec."""
     leaves = jax.tree_util.tree_leaves(params)
     plan = ZeroPlan(leaves, world)
     inner_abs = jax.eval_shape(optimizer.init, plan.shard_abstract())
@@ -562,7 +800,15 @@ def zero_opt_state_specs(
             return P(axis)
         return P()
 
-    return ZeroState(inner=jax.tree_util.tree_map(assign, inner_abs))
+    residual_specs = None
+    if dcn_compression is not None and getattr(
+        dcn_compression, "error_feedback", False
+    ):
+        residual_specs = [P(axis)] * len(plan.buckets)
+    return ZeroState(
+        inner=jax.tree_util.tree_map(assign, inner_abs),
+        residual=residual_specs,
+    )
 
 
 def sharded_state_bytes_per_rank(state: Any, specs: Any,

@@ -132,6 +132,8 @@ def zero_train_setup(
     axis: str = WORLD_AXIS,
     loss_fn: Callable = softmax_cross_entropy,
     op: ReduceOp = Average,
+    hierarchical: bool = False,
+    dcn_compression=None,
 ):
     """Build a ZeRO-sharded data-parallel trainer over the world mesh.
 
@@ -142,6 +144,13 @@ def zero_train_setup(
     each chip holds ~1/world of Adam's m/v instead of a full replica —
     the ZeRO stage-1 memory attack on PERF.md's large-batch limiter.
 
+    ``hierarchical=True`` lays the same program over the topology's
+    2-D ``hierarchical_mesh()`` instead: the ZeRO exchange runs
+    ICI-first and only the 1/n_ici piece crosses DCN — optionally in
+    ``dcn_compression``'s wire dtype (docs/COLLECTIVES.md byte model);
+    ``mesh`` then defaults to ``topology.hierarchical_mesh()`` and
+    ``axis`` is ignored in favor of the ``(dcn, ici)`` fabric axes.
+
     Returns ``(state, step, opt_state_specs)``: ``state.opt_state``
     leaves that mirror shard buffers are laid out ``P(axis)`` on the
     mesh (``opt_state_specs`` says which — also what per-rank memory
@@ -150,17 +159,32 @@ def zero_train_setup(
     Pass the INNER optax optimizer; do not wrap it in a Zero/Distributed
     wrapper yourself.
     """
+    from .common.topology import DCN_AXIS, ICI_AXIS
     from .optim import ZeroSpmdOptimizer, zero_opt_state_specs
 
-    if mesh is None:
-        mesh = basics._require_init().process_set_registry.get(0).mesh
-    world = int(mesh.shape[axis])
-    zopt = ZeroSpmdOptimizer(inner_optimizer, axis=axis, op=op)
+    if hierarchical:
+        if mesh is None:
+            mesh = basics._require_init().topology.hierarchical_mesh()
+        axis = (DCN_AXIS, ICI_AXIS)
+        world = int(mesh.shape[DCN_AXIS] * mesh.shape[ICI_AXIS])
+        zopt = ZeroSpmdOptimizer(
+            inner_optimizer, op=op, hierarchical=True,
+            ici_axis=ICI_AXIS, dcn_axis=DCN_AXIS,
+            dcn_compression=dcn_compression,
+        )
+    else:
+        if mesh is None:
+            mesh = basics._require_init().process_set_registry.get(0).mesh
+        world = int(mesh.shape[axis])
+        zopt = ZeroSpmdOptimizer(inner_optimizer, axis=axis, op=op)
 
     variables = model.init(rng, sample_input)
     params = variables["params"]
     batch_stats = variables.get("batch_stats")
-    ospecs = zero_opt_state_specs(inner_optimizer, params, world, axis)
+    ospecs = zero_opt_state_specs(
+        inner_optimizer, params, world, axis,
+        dcn_compression=dcn_compression if hierarchical else None,
+    )
     opt_state = jax.jit(jax.shard_map(
         zopt.init, mesh=mesh, in_specs=(P(),), out_specs=ospecs,
         check_vma=False,
@@ -192,11 +216,22 @@ def zero_train_setup(
         (loss, new_stats), grads = jax.value_and_grad(
             compute_loss, has_aux=True
         )(state.params)
+
         # no separate gradient allreduce: the ZeRO update IS the
-        # reduction (reduce-scatter + allgather = the split allreduce)
-        loss = spmd_ops.allreduce(loss, axis=axis)
+        # reduction (reduce-scatter + allgather = the split allreduce);
+        # a tuple axis (the hierarchical fabric mesh) means over both
+        def _mean(x):
+            if isinstance(axis, tuple):
+                return jax.tree_util.tree_map(
+                    lambda t: jax.lax.psum(t, axis)
+                    / jnp.asarray(world, t.dtype),
+                    x,
+                )
+            return spmd_ops.allreduce(x, axis=axis)
+
+        loss = _mean(loss)
         if new_stats is not None:
-            new_stats = spmd_ops.allreduce(new_stats, axis=axis)
+            new_stats = _mean(new_stats)
         updates, new_opt_state = zopt.update(
             grads, state.opt_state, state.params
         )

@@ -69,6 +69,9 @@ class Config:
     autotune_log: str = ""  # HOROVOD_AUTOTUNE_LOG
     # Hierarchical allreduce (nccl_operations.cc NCCLHierarchicalAllreduce):
     hierarchical_allreduce: bool = False  # HOROVOD_HIERARCHICAL_ALLREDUCE
+    # DCN-hop wire format for routed hierarchical allreduces
+    # (compression.DcnCompression; "" = full precision):
+    dcn_wire_dtype: str = ""  # HVD_TPU_DCN_WIRE_DTYPE
     # Elastic:
     elastic: bool = False  # HOROVOD_ELASTIC
     # Logging:
@@ -91,6 +94,7 @@ class Config:
             autotune=_get_bool("AUTOTUNE", False),
             autotune_log=_get("AUTOTUNE_LOG", "") or "",
             hierarchical_allreduce=_get_bool("HIERARCHICAL_ALLREDUCE", False),
+            dcn_wire_dtype=(_get("DCN_WIRE_DTYPE", "") or "").lower(),
             elastic=_get_bool("ELASTIC", False),
             log_level=(_get("LOG_LEVEL", "warning") or "warning").lower(),
             tpu_operations=(_get("TPU_OPERATIONS", "XLA") or "XLA").upper(),
