@@ -84,7 +84,21 @@ class DevicePrefetcher:
     The background thread is a daemon and also shuts down cleanly on
     ``close()``/GC; a producer exception re-raises on the consumer side
     in order.
+
+    **Long-lived (serving) use.**  Exhaustion is sticky on purpose for
+    the epoch-loop case — iterating past the end keeps raising
+    StopIteration instead of silently re-reading — but a *staging queue*
+    (the serving engine's request intake) outlives any one stream, so
+    the lifecycle is explicit: :meth:`restart` re-arms an exhausted or
+    closed prefetcher on a fresh iterable (cumulative :meth:`stats`
+    keep summing), and :meth:`poll` is the non-blocking consume —
+    ``None`` while the producer is still staging, :data:`EXHAUSTED`
+    once the stream truly ended.
     """
+
+    #: poll() return marker: the current stream ended (sticky until
+    #: restart()).  Distinct from None = nothing staged *yet*.
+    EXHAUSTED = object()
 
     def __init__(self, host_batches: Iterable, *,
                  depth: Optional[int] = None,
@@ -108,9 +122,15 @@ class DevicePrefetcher:
         self._starved = 0
         self._queue: Optional[queue.Queue] = None
         self._thread: Optional[threading.Thread] = None
+        self._stop: Optional[threading.Event] = None
         self._closed = False
+        self._exhausted = False
+        self._start()
+
+    def _start(self) -> None:
         if self.depth > 0:
             self._queue = queue.Queue(maxsize=self.depth)
+            self._stop = threading.Event()
             self._thread = threading.Thread(
                 target=self._producer, name="hvd-tpu-prefetch", daemon=True)
             self._thread.start()
@@ -140,18 +160,25 @@ class DevicePrefetcher:
         return batch
 
     def _producer(self):
+        # bind queue, iterator AND stop event locally: after restart()
+        # replaces them, a producer that was blocked past the close()
+        # join deadline must keep talking to ITS stream's queue — and
+        # must still see ITS stream's stop request (a shared _closed
+        # flag would be reset by restart(), resurrecting the zombie to
+        # keep consuming the abandoned iterator forever)
+        q, it, stop = self._queue, self._host_iter, self._stop
         try:
-            while not self._closed:
+            while not stop.is_set():
                 t0 = time.perf_counter()
                 try:
-                    item = next(self._host_iter)
+                    item = next(it)
                 except StopIteration:
-                    self._queue.put(_SENTINEL)
+                    q.put(_SENTINEL)
                     return
                 self._produce_s += time.perf_counter() - t0
-                self._queue.put(self._stage(item))
+                q.put(self._stage(item))
         except BaseException as e:  # re-raise on the consumer side
-            self._queue.put(e)
+            q.put(e)
 
     # -- iteration -----------------------------------------------------------
 
@@ -165,6 +192,7 @@ class DevicePrefetcher:
             try:
                 item = next(self._host_iter)
             except StopIteration:
+                self._exhausted = True
                 raise
             self._produce_s += time.perf_counter() - t0
             staged = self._stage(item)
@@ -173,14 +201,51 @@ class DevicePrefetcher:
         t0 = time.perf_counter()
         item = self._queue.get()
         waited = time.perf_counter() - t0
+        out = self._resolve(item)
+        if out is self.EXHAUSTED:
+            raise StopIteration
+        self._account_delivery(waited=waited)
+        return out
+
+    def _resolve(self, item):
+        """Queue item -> delivered batch, EXHAUSTED, or raised error."""
         if item is _SENTINEL:
             self._queue.put(_SENTINEL)  # idempotent exhaustion
-            raise StopIteration
+            self._exhausted = True
+            return self.EXHAUSTED
         if isinstance(item, BaseException):
             self._queue.put(item)
             raise item
-        self._account_delivery(waited=waited)
         return item
+
+    def poll(self, block: bool = False):
+        """Non-blocking consume for long-lived (staging-queue) use:
+        returns a staged batch, ``None`` when nothing is staged yet, or
+        :data:`EXHAUSTED` once the stream ended.  ``block=True`` waits
+        like ``next`` but still returns EXHAUSTED instead of raising.
+        With ``depth=0`` there is no queue to peek — any poll runs the
+        synchronous ``next`` (i.e. it may block on the host iterator).
+        """
+        if self._closed:
+            # close() drained the queue (sentinel included) and the
+            # producer exited without re-queueing it — a blocking get
+            # here would hang forever; closed is terminal like exhausted
+            return self.EXHAUSTED
+        if self.depth == 0:
+            try:
+                return next(self)
+            except StopIteration:
+                return self.EXHAUSTED
+        t0 = time.perf_counter()
+        try:
+            item = self._queue.get(block=block)
+        except queue.Empty:
+            return None
+        out = self._resolve(item)
+        if out is self.EXHAUSTED:
+            return out
+        self._account_delivery(waited=time.perf_counter() - t0)
+        return out
 
     def _account_delivery(self, waited: float) -> None:
         self._batches += 1
@@ -211,15 +276,51 @@ class DevicePrefetcher:
             "starved_batches": self._starved,
         }
 
+    @property
+    def exhausted(self) -> bool:
+        """True once the host iterator's end was delivered to the
+        consumer (sticky until :meth:`restart`)."""
+        return self._exhausted
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def restart(self, host_batches: Iterable) -> None:
+        """Re-arm on a fresh host iterable — the explicit reuse contract
+        for long-lived staging queues (one prefetcher per serving
+        engine, not one per stream).  Only legal once the previous
+        stream is done: exhausted, or torn down with :meth:`close` (an
+        active stream's producer thread would race the new one).
+        Cumulative :meth:`stats` keep summing across streams."""
+        if not (self._exhausted or self._closed):
+            raise RuntimeError(
+                "restart() on an active prefetcher; close() it or drain "
+                "it to exhaustion first")
+        if self._thread is not None:
+            self._closed = True
+            self._stop.set()  # per-stream: survives the _closed reset below
+            self._drain_queue()  # unblock a producer parked on a full queue
+            self._thread.join(timeout=5)
+        self._host_iter = iter(host_batches)
+        self._closed = False
+        self._exhausted = False
+        self._start()
+
+    def _drain_queue(self) -> None:
+        if self._queue is None:
+            return
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+
     def close(self) -> None:
         self._closed = True
-        if self._queue is not None:
-            # unblock a producer waiting on a full queue
-            try:
-                while True:
-                    self._queue.get_nowait()
-            except queue.Empty:
-                pass
+        if self._stop is not None:
+            self._stop.set()
+        self._drain_queue()  # unblock a producer waiting on a full queue
         if self._thread is not None:
             self._thread.join(timeout=5)
         # release the upstream pipeline too (map_ordered holds a worker

@@ -181,6 +181,54 @@ DATA_BATCHES = counter(
     ["source"],
 )
 
+# -- inference serving (serving/ — docs/SERVING.md) --------------------------
+
+#: Per-token emission latency: ``first`` = arrival to first token (TTFT,
+#: includes queueing — the head-of-line-blocking signal), ``inter`` =
+#: gap between consecutive tokens of one request (TPOT).  p50/p99 come
+#: from the histogram quantiles.
+SERVE_TOKEN_LATENCY = histogram(
+    "hvd_tpu_serve_token_latency_seconds",
+    "Per-token emission latency (first = TTFT incl. queueing, inter = TPOT)",
+    ["kind"],
+    buckets=DEFAULT_LATENCY_BUCKETS + (25.0, 60.0),
+)
+
+#: Requests waiting for admission (staged + pending; live).
+SERVE_QUEUE_DEPTH = gauge(
+    "hvd_tpu_serve_queue_depth",
+    "Requests waiting for admission to the decode batch",
+)
+
+#: Fraction of allocatable KV blocks owned by running sequences —
+#: sustained ~1.0 with a deep queue means the pool (not compute) caps
+#: the batch; grow HVD_TPU_SERVE_NUM_BLOCKS.
+SERVE_KV_OCCUPANCY = gauge(
+    "hvd_tpu_serve_kv_block_occupancy_ratio",
+    "Allocated fraction of the paged KV cache's block pool",
+)
+
+#: Sequences preempted (LIFO recompute eviction) because the pool ran
+#: dry mid-growth; sustained nonzero = admission is overcommitting.
+SERVE_EVICTIONS = counter(
+    "hvd_tpu_serve_evictions_total",
+    "Sequences evicted from the decode batch to reclaim KV blocks",
+)
+
+#: Engine steps by kind (prefill/decode) — the interleave ratio.
+SERVE_STEPS = counter(
+    "hvd_tpu_serve_steps_total",
+    "Serving engine steps executed, by kind",
+    ["kind"],
+)
+
+#: Request lifecycle events (submitted/completed).
+SERVE_REQUESTS = counter(
+    "hvd_tpu_serve_requests_total",
+    "Serving request lifecycle events",
+    ["event"],
+)
+
 # -- elastic (runner/elastic_driver.py, elastic/worker.py) -------------------
 
 ELASTIC_WORLD_SIZE = gauge(

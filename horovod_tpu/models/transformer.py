@@ -246,7 +246,7 @@ class Attention(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, paged=None, layer: int = 0):
         cfg = self.cfg
         dense = functools.partial(
             nn.DenseGeneral, dtype=cfg.dtype, use_bias=False
@@ -259,6 +259,35 @@ class Attention(nn.Module):
         v = dense(features=(kv_heads, cfg.head_dim), name="v")(x)
         q = rope(q, positions)
         k = rope(k, positions)
+        if paged is not None:
+            # serving path (docs/SERVING.md): K/V live in the paged
+            # cache's block pools, not in this activation.  Prefill
+            # writes the whole prompt's K/V through the block table and
+            # attends within itself (sequences start at position 0, so
+            # plain causal attention is exact at any padding); decode
+            # writes the one new token then attends the GATHERED pages
+            # with the per-sequence decode kernel.
+            if cfg.attention_impl not in ("dot", "flash"):
+                raise ValueError(
+                    f"paged serving supports attention_impl 'dot'/'flash', "
+                    f"not {cfg.attention_impl!r}")
+            if not cfg.causal:
+                raise ValueError("paged serving requires causal=True")
+            if paged.mode == "prefill":
+                paged.write_prefill(layer, k, v)
+            else:
+                from ..ops.flash_attention import flash_decode_attention
+
+                paged.write_decode(layer, k, v)
+                gk, gv, kv_start = paged.gather(layer, window=cfg.window)
+                out = flash_decode_attention(
+                    q, gk, gv, paged.lens + 1, window=cfg.window,
+                    kv_start=kv_start,
+                )
+                return nn.DenseGeneral(
+                    features=cfg.d_model, axis=(-2, -1), dtype=cfg.dtype,
+                    use_bias=False, name="o",
+                )(out)
         # GQA needs no expansion: every impl consumes (B, S, H_kv, D)
         # K/V natively — the kernels/einsums share each kv head across
         # its query-head group, so the group factor is saved in
@@ -306,12 +335,13 @@ class Block(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, paged=None, layer: int = 0):
         cfg = self.cfg
         norm = functools.partial(
             nn.RMSNorm, dtype=cfg.dtype, epsilon=1e-5
         )
-        x = x + Attention(cfg, name="attn")(norm(name="ln1")(x), positions)
+        x = x + Attention(cfg, name="attn")(
+            norm(name="ln1")(x), positions, paged=paged, layer=layer)
         x = x + MlpBlock(cfg, name="mlp")(norm(name="ln2")(x))
         return x
 
@@ -322,7 +352,8 @@ class Transformer(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, positions=None, train: bool = True):
+    def __call__(self, tokens, positions=None, train: bool = True,
+                 paged=None):
         cfg = self.cfg
         if positions is None:
             local = jnp.arange(tokens.shape[1])
@@ -354,9 +385,19 @@ class Transformer(nn.Module):
                     Block, policy=_checkpoint_policy(pol)
                 )
                 block_cls_for[pol] = block_cls
-            x = block_cls(cfg, name=f"layer_{i}")(x, positions)
+            if paged is not None:
+                # serving (inference-only) path: the paged-cache state
+                # threads through every block, each addressing its own
+                # pool layer; never composes with remat (train=False)
+                x = block_cls(cfg, name=f"layer_{i}")(
+                    x, positions, paged, i)
+            else:
+                x = block_cls(cfg, name=f"layer_{i}")(x, positions)
         x = nn.RMSNorm(dtype=cfg.dtype, epsilon=1e-5, name="ln_f")(x)
-        return emb.attend(x.astype(jnp.float32))
+        logits = emb.attend(x.astype(jnp.float32))
+        if paged is not None:
+            return logits, paged
+        return logits
 
 
 def modeled_activation_bytes(cfg: TransformerConfig, batch: int,

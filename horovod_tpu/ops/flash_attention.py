@@ -49,8 +49,10 @@ try:  # scalar params belong in SMEM on TPU; interpret mode accepts it too
     from jax.experimental.pallas import tpu as _pltpu
 
     _SCALAR_SPEC = pl.BlockSpec(memory_space=_pltpu.SMEM)
+    _HAVE_SMEM = True
 except Exception:  # pragma: no cover - CPU-only images without pallas.tpu
     _SCALAR_SPEC = pl.BlockSpec((1,), lambda *_: (0,))
+    _HAVE_SMEM = False
 
 _NEG_INF = -1e30
 
@@ -100,9 +102,17 @@ def _kb_range(q_off, block_q, block_k, padded_kb, causal, window, kv_off=0):
 
 
 def _fwd_kernel(kvoff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale,
-                causal, block_q, block_k, seq_len, window=None):
+                causal, block_q, block_k, seq_len, window=None,
+                off_div=None):
     qi = pl.program_id(1)
-    kv_off = kvoff_ref[0]
+    # off_div=None: one kv_offset for the whole grid (self/ring blocks).
+    # off_div=H: kvoff_ref holds one offset PER BATCH ROW and grid row bh
+    # reads entry bh // H — the paged-decode path, where every sequence
+    # sits at its own global position (serving/kv_cache.py).
+    if off_div is None:
+        kv_off = kvoff_ref[0]
+    else:
+        kv_off = kvoff_ref[pl.program_id(0) // off_div]
     head_dim = q_ref.shape[-1]
     q = q_ref[0].astype(jnp.float32) * sm_scale  # (block_q, D)
     q_off = qi * block_q
@@ -197,6 +207,14 @@ def _off_arr(kv_offset):
     if kv_offset is None:
         return jnp.zeros((1,), jnp.int32)
     return jnp.asarray(kv_offset, jnp.int32).reshape(1)
+
+
+def _off_spec(n):
+    """BlockSpec for an (n,) int32 offset vector — SMEM where available
+    (the module-level _SCALAR_SPEC probe), whole-array block otherwise."""
+    if _HAVE_SMEM:
+        return _SCALAR_SPEC
+    return pl.BlockSpec((n,), lambda *_: (0,))  # pragma: no cover
 
 
 def _forward_impl(q, k, v, causal, block_q, block_k, interpret,
@@ -524,6 +542,106 @@ def flash_block_forward(q, k, v, causal, block_q=256, block_k=256,
     )
     lse = lse_f[:, :, 0].reshape(b, h, -1)[:, :, :s].transpose(0, 2, 1)
     return out, lse
+
+
+# -- q_len=1 decode entry (the paged-KV-cache serving path) ------------------
+#
+# Autoregressive decode is one query row attending a long cached K/V
+# stream — exactly the forward kernel at block_q rows with a PER-SEQUENCE
+# kv_offset: each sequence sits at its own global position, so the SMEM
+# offset input carries one entry per batch row and grid row bh reads
+# entry bh // H.  The causal term of _tile_mask then masks everything at
+# or beyond the sequence's length (stale pool garbage, trash-block
+# gathers, unwritten tail positions) and _kb_range skips the K blocks
+# the sequence doesn't own — the block-granular read reduction the paged
+# cache (serving/kv_cache.py) is built on.  GQA grouping and sliding-
+# window truncation compose exactly as in the training kernels.
+
+
+def flash_decode_attention(q, k, v, kv_lens, *, window=None, kv_start=None,
+                           block_q=8, block_k=128, interpret=None):
+    """Single-token decode attention over gathered KV-cache pages.
+
+    q: (B, 1, H, D) — the new token's query, one row per sequence.
+    k, v: (B, S_kv, H_kv, D) with ``H_kv | H`` (GQA) — each sequence's
+    cache pages gathered contiguous (serving's block-table gather); rows
+    at or beyond the sequence's length may hold arbitrary garbage, the
+    mask never reads them.
+    kv_lens: (B,) int32 — keys the query may attend, PER SEQUENCE: the
+    query sits at global position ``kv_lens - 1`` and attends keys
+    ``0..kv_lens-1`` (itself included, i.e. its own K/V must already be
+    present in ``k``/``v``).
+    kv_start: optional (B,) int32 global position of ``k[:, 0]`` (0 when
+    the gather starts at the sequence head; the windowed gather passes
+    the trailing-page start so masks stay global).
+    window: Mistral-style sliding window — the query attends the last
+    ``window`` positions only, and _kb_range SKIPS pages wholly before
+    the window, so per-step reads are O(window), not O(context).
+
+    Output: (B, 1, H, D) in q's dtype.  Rows with ``kv_lens <= 0`` (pad
+    slots of a partially filled decode batch) come back all-zero.
+    """
+    b, s_q, h, d = q.shape
+    if s_q != 1:
+        raise ValueError(f"decode expects q_len=1, got {s_q}")
+    if k.shape != v.shape:
+        raise ValueError(f"k/v shapes differ: {k.shape} vs {v.shape}")
+    group = _group_of(q, k)
+    h_kv = h // group
+    s_k = k.shape[1]
+    if window is not None and window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
+    kv_lens = jnp.asarray(kv_lens, jnp.int32).reshape(b)
+    if kv_start is None:
+        starts = jnp.zeros((b,), jnp.int32)
+    else:
+        starts = jnp.asarray(kv_start, jnp.int32).reshape(b)
+    # global K start − global Q start, per sequence (the query's global
+    # position is kv_lens − 1): the causal term rel >= 0 then reads
+    # k_global <= kv_lens − 1 — the per-sequence length mask.
+    offs = starts - (kv_lens - 1)
+    block_k = min(block_k, s_k + (-s_k) % 128)
+    kp = _pad_to(k, block_k, axis=1)
+    vp = _pad_to(v, block_k, axis=1)
+    s_k_pad = kp.shape[1]
+    qp = _pad_to(q, block_q, axis=1)  # 1 real row + block_q-1 pad rows
+    qf = _fold(qp, b, h, d)
+    kf = _fold(kp, b, h_kv, d)
+    vf = _fold(vp, b, h_kv, d)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    kernel = functools.partial(
+        _fwd_kernel,
+        sm_scale=1.0 / (d ** 0.5),
+        causal=True,  # the per-sequence length mask IS the causal term
+        block_q=block_q,
+        block_k=block_k,
+        seq_len=s_k,
+        window=window,
+        off_div=h,
+    )
+    out, _ = pl.pallas_call(
+        kernel,
+        grid=(b * h, 1),
+        in_specs=[
+            _off_spec(b),
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, s_k_pad, d),
+                         lambda bh, qi: (bh // group, 0, 0)),
+            pl.BlockSpec((1, s_k_pad, d),
+                         lambda bh, qi: (bh // group, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, block_q, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, block_q, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(offs, qf, kf, vf)
+    return _unfold(out, b, h, block_q, d)[:, :1]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))

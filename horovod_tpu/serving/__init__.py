@@ -1,0 +1,34 @@
+"""Continuous-batching inference serving on the paged KV cache.
+
+The millions-of-users workload (ROADMAP item 2): iteration-level
+request scheduling (Orca, OSDI '22) over block-granular KV paging
+(vLLM's PagedAttention, SOSP '23), with decode attention driven through
+the repo's own flash kernels' ``kv_offset``/block-skip machinery —
+see docs/SERVING.md for the policy, tuning and exactness contract.
+
+Not imported by ``import horovod_tpu`` (training jobs shouldn't pay the
+model-stack import); use ``from horovod_tpu import serving``.
+"""
+
+from .engine import Request, ServeConfig, ServingEngine
+from .kv_cache import (
+    BlockAllocator,
+    PagedKVState,
+    blocks_for,
+    modeled_decode_read_bytes,
+    pool_bytes,
+)
+from .scheduler import ContinuousBatchingScheduler, Sequence
+
+__all__ = [
+    "BlockAllocator",
+    "ContinuousBatchingScheduler",
+    "PagedKVState",
+    "Request",
+    "Sequence",
+    "ServeConfig",
+    "ServingEngine",
+    "blocks_for",
+    "modeled_decode_read_bytes",
+    "pool_bytes",
+]

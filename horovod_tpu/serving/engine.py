@@ -1,0 +1,546 @@
+"""The serving engine: continuous batching over the paged KV cache.
+
+Ties the pieces together (docs/SERVING.md):
+
+* the **paged KV cache** (`kv_cache.py`) holds every running sequence's
+  K/V in fixed-size device blocks;
+* the **scheduler** (`scheduler.py`) re-decides the batch every
+  iteration — admit against the token budget and block watermark,
+  LIFO-evict (recompute) when the pool runs dry;
+* **prefill and decode steps** are two jitted programs over *padding
+  tiers*: every step's shapes are padded up to a tier from a small
+  static menu, so a lifetime of arbitrary request shapes compiles a
+  BOUNDED set of programs (the same executable-cache discipline as the
+  ops engine's ``max_signatures``; hits/misses are mirrored into the
+  PR-1 ``hvd_tpu_executable_cache_total`` counters so the bound is
+  observable);
+* the **staging queue** (`data.prefetch.DevicePrefetcher` in its
+  restartable role) device-stages tokenized prompts while the current
+  step computes, so admission never waits on PCIe.
+
+Decoding is greedy (argmax, fp32 logits) — deterministic, which is what
+makes the continuous batch *oracle-exact*: batched decode over the
+paged cache emits token-for-token what one-at-a-time full-context
+decode emits, across admit/evict boundaries (tests/test_serving.py).
+
+``run_static`` is the pre-Orca baseline the bench A/Bs against: fixed
+request batches held until every member finishes, contiguous
+max-length KV reservations — both kinds of waste continuous batching
+and paging exist to remove.
+"""
+
+from __future__ import annotations
+
+import bisect
+import collections
+import dataclasses
+import os
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.retry import env_int
+from ..data.prefetch import DevicePrefetcher
+from ..metrics import instruments as _instr
+from ..models.transformer import Transformer, TransformerConfig
+from ..utils.logging import get_logger
+from .kv_cache import (
+    BlockAllocator, PagedKVState, blocks_for, make_pools, pool_bytes,
+)
+from .scheduler import ContinuousBatchingScheduler, Request, Sequence
+
+_CACHE_HIT = _instr.EXEC_CACHE.labels("hit")
+_CACHE_MISS = _instr.EXEC_CACHE.labels("miss")
+_LAT_FIRST = _instr.SERVE_TOKEN_LATENCY.labels("first")
+_LAT_INTER = _instr.SERVE_TOKEN_LATENCY.labels("inter")
+_STEP_PREFILL = _instr.SERVE_STEPS.labels("prefill")
+_STEP_DECODE = _instr.SERVE_STEPS.labels("decode")
+_REQ_SUBMITTED = _instr.SERVE_REQUESTS.labels("submitted")
+_REQ_COMPLETED = _instr.SERVE_REQUESTS.labels("completed")
+
+
+# name constants so the analysis env pass sees the reads (the tier
+# parser receives the name indirectly)
+_PREFILL_TIERS_ENV = "HVD_TPU_SERVE_PREFILL_TIERS"
+_DECODE_TIERS_ENV = "HVD_TPU_SERVE_DECODE_TIERS"
+
+
+def _env_tiers(name: str, default: Tuple[int, ...]) -> Tuple[int, ...]:
+    """Comma-separated ascending int tiers from the environment, with the
+    package's warn-and-fall-back convention (see common.retry.env_int)."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        tiers = tuple(sorted({int(x) for x in raw.split(",") if x.strip()}))
+        if not tiers or tiers[0] < 1:
+            raise ValueError(raw)
+        return tiers
+    except ValueError:
+        get_logger().warning("%s=%r is not a comma-separated positive int "
+                             "list; using %s", name, raw, default)
+        return default
+
+
+def _pow2_tiers(lo: int, hi: int) -> Tuple[int, ...]:
+    tiers = []
+    t = lo
+    while t < hi:
+        tiers.append(t)
+        t *= 2
+    tiers.append(hi)
+    return tuple(tiers)
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Serving knobs (every field has an ``HVD_TPU_SERVE_*`` env
+    spelling resolved by :meth:`from_env`; docs/running.md).
+
+    ``prefill_tiers`` / ``decode_tiers`` are the padding menus: prompt
+    lengths pad up to a prefill tier, batch sizes to a decode tier, so
+    the compiled-program count is bounded by the product of the menus,
+    not by the request distribution."""
+
+    block_size: int = 16
+    num_blocks: int = 0  # 0 = auto: full residency for the largest batch
+    token_budget: int = 2048
+    watermark: int = 4
+    prefill_tiers: Tuple[int, ...] = ()
+    decode_tiers: Tuple[int, ...] = (1, 2, 4, 8)
+
+    @classmethod
+    def from_env(cls, **overrides) -> "ServeConfig":
+        base = cls(**overrides)
+        fields = dataclasses.asdict(base)
+        if "block_size" not in overrides:
+            fields["block_size"] = env_int("HVD_TPU_SERVE_BLOCK_SIZE",
+                                           base.block_size)
+        if "num_blocks" not in overrides:
+            fields["num_blocks"] = env_int("HVD_TPU_SERVE_NUM_BLOCKS",
+                                           base.num_blocks)
+        if "token_budget" not in overrides:
+            fields["token_budget"] = env_int("HVD_TPU_SERVE_TOKEN_BUDGET",
+                                             base.token_budget)
+        if "watermark" not in overrides:
+            fields["watermark"] = env_int("HVD_TPU_SERVE_WATERMARK",
+                                          base.watermark)
+        if "prefill_tiers" not in overrides:
+            fields["prefill_tiers"] = _env_tiers(
+                _PREFILL_TIERS_ENV, base.prefill_tiers)
+        if "decode_tiers" not in overrides:
+            fields["decode_tiers"] = _env_tiers(
+                _DECODE_TIERS_ENV, base.decode_tiers)
+        return cls(**fields)
+
+
+def _tier_for(tiers: Tuple[int, ...], n: int) -> int:
+    """Smallest tier >= n (tiers ascending)."""
+    i = bisect.bisect_left(tiers, n)
+    if i == len(tiers):
+        raise ValueError(f"{n} exceeds the largest tier {tiers[-1]}")
+    return tiers[i]
+
+
+class ServingEngine:
+    """Continuous-batching inference over one :class:`Transformer`.
+
+    ``params`` is the flax params pytree (as from ``model.init``).  The
+    model config must be causal with attention_impl 'dot' or 'flash';
+    GQA (``num_kv_heads``) and sliding windows (``window``) both shrink
+    the cache and the decode reads natively.
+    """
+
+    def __init__(self, cfg: TransformerConfig, params, *,
+                 serve: Optional[ServeConfig] = None,
+                 clock=time.perf_counter):
+        if cfg.attention_impl not in ("dot", "flash") or not cfg.causal:
+            raise ValueError(
+                "serving requires a causal 'dot' or 'flash' config, got "
+                f"attention_impl={cfg.attention_impl!r} causal={cfg.causal}")
+        self.cfg = cfg
+        self.params = params
+        self.serve_cfg = serve = serve or ServeConfig.from_env()
+        self._clock = clock
+        self._model = Transformer(cfg)
+        bs = serve.block_size
+        self.max_blocks_per_seq = blocks_for(cfg.max_seq_len, bs)
+        max_batch = max(serve.decode_tiers)
+        num_blocks = serve.num_blocks
+        if num_blocks <= 0:
+            num_blocks = 1 + self.max_blocks_per_seq * max_batch
+        prefill_tiers = serve.prefill_tiers or _pow2_tiers(
+            min(32, cfg.max_seq_len), cfg.max_seq_len)
+        over = [t for t in prefill_tiers if t > cfg.max_seq_len]
+        if over:
+            # an oversize tier is not just waste: pad positions past
+            # max_seq_len index block-table columns past max_blocks,
+            # and the clamped gather would scatter pad garbage into the
+            # sequence's REAL tail block — silent KV corruption
+            get_logger().warning(
+                "dropping prefill tiers %s > max_seq_len %d", over,
+                cfg.max_seq_len)
+            prefill_tiers = tuple(
+                t for t in prefill_tiers if t <= cfg.max_seq_len)
+        if not prefill_tiers or prefill_tiers[-1] < cfg.max_seq_len:
+            # evicted contexts re-prefill at up to max_seq_len
+            prefill_tiers = prefill_tiers + (cfg.max_seq_len,)
+        self.prefill_tiers = prefill_tiers
+        self.decode_tiers = serve.decode_tiers
+        kv_heads = cfg.num_kv_heads or cfg.num_heads
+        self.k_pool, self.v_pool = make_pools(
+            cfg.num_layers, num_blocks, bs, kv_heads, cfg.head_dim,
+            cfg.dtype)
+        self.pool_bytes = pool_bytes(
+            cfg.num_layers, num_blocks, bs, kv_heads, cfg.head_dim,
+            cfg.dtype)
+        self.allocator = BlockAllocator(num_blocks, bs)
+        self.scheduler = ContinuousBatchingScheduler(
+            self.allocator, token_budget=serve.token_budget,
+            watermark=serve.watermark, max_decode_batch=max_batch,
+            max_seq_len=cfg.max_seq_len)
+        # queue depth = scheduler pending + device-staged-but-undrained
+        self.scheduler.staged_depth = lambda: len(self._staging_meta)
+        self.results: Dict[int, np.ndarray] = {}
+        self._ids_seen: set = set()
+        #: set to a list to record (request_id, emit_time, arrival) per
+        #: token — the bench's raw latency trace (off by default: the
+        #: registry histograms carry production quantiles)
+        self.token_log: Optional[list] = None
+        self._next_id = 0
+        self._progs: Dict[tuple, bool] = {}
+        self._staging: Optional[DevicePrefetcher] = None
+        self._staging_meta: collections.deque = collections.deque()
+        self._source_done = True
+        self._prefill_fn = jax.jit(self._prefill_step)
+        self._decode_fn = jax.jit(self._decode_step)
+
+    # -- the two tiered programs --------------------------------------------
+
+    def _prefill_step(self, params, k, v, tables, lens, tokens):
+        b, p = tokens.shape
+        state = PagedKVState(k=k, v=v, tables=tables, lens=lens,
+                             mode="prefill")
+        positions = jnp.broadcast_to(
+            jnp.arange(p, dtype=jnp.int32)[None], (b, p))
+        logits, state = self._model.apply(
+            {"params": params}, tokens, positions=positions, train=False,
+            paged=state)
+        last = jnp.clip(lens - 1, 0, p - 1)
+        next_tok = jnp.argmax(
+            logits[jnp.arange(b), last].astype(jnp.float32), axis=-1)
+        return next_tok.astype(jnp.int32), state.k, state.v
+
+    def _decode_step(self, params, k, v, tables, lens, last_tok):
+        state = PagedKVState(k=k, v=v, tables=tables, lens=lens,
+                             mode="decode")
+        logits, state = self._model.apply(
+            {"params": params}, last_tok[:, None], positions=lens[:, None],
+            train=False, paged=state)
+        next_tok = jnp.argmax(logits[:, 0].astype(jnp.float32), axis=-1)
+        return next_tok.astype(jnp.int32), state.k, state.v
+
+    def _book_program(self, kind: str, *dims: int) -> None:
+        """Mirror the jit executable cache into the PR-1 hit/miss
+        counters: the padding tiers make ``dims`` a bounded set, so
+        steady state must be all hits (the acceptance assert)."""
+        key = (kind,) + dims
+        if key in self._progs:
+            _CACHE_HIT.inc()
+        else:
+            _CACHE_MISS.inc()
+            self._progs[key] = True
+
+    @property
+    def program_count(self) -> int:
+        """Distinct (kind, tier...) step programs compiled so far."""
+        return len(self._progs)
+
+    def warmup(self) -> int:
+        """Compile the WHOLE tier menu up front — every (batch tier,
+        prefill tier) prefill program and every decode-tier program.
+        The menu is what makes this possible (and cheap to reason
+        about): the compiled set is bounded by the tier product, so a
+        production engine pre-warms it and serves its lifetime without
+        a single mid-traffic XLA compile (a straggler compile is a
+        multi-second p99 spike — measured in tools/serve_bench.py).
+
+        Side-effect-free by construction: the dummy steps run with
+        all-zero block tables, so every write lands in the trash block
+        and no real sequence's cache is touched.  Returns the number of
+        programs compiled."""
+        before = len(self._progs)
+        tables = jnp.zeros((1, self.max_blocks_per_seq), jnp.int32)
+        for bt in self.decode_tiers:
+            tb = jnp.broadcast_to(tables, (bt, self.max_blocks_per_seq))
+            lens = jnp.ones((bt,), jnp.int32)
+            for p in self.prefill_tiers:
+                self._book_program("prefill", bt, p)
+                self._prefill_fn(self.params, self.k_pool, self.v_pool,
+                                 tb, lens, jnp.zeros((bt, p), jnp.int32))
+            self._book_program("decode", bt)
+            self._decode_fn(self.params, self.k_pool, self.v_pool, tb,
+                            lens, jnp.zeros((bt,), jnp.int32))
+        return len(self._progs) - before
+
+    # -- request intake ------------------------------------------------------
+
+    def _validate_request(self, prompt_len: int, max_new_tokens: int,
+                          rid: Optional[int] = None) -> None:
+        """The intake contract, shared by ALL three entry points
+        (submit, attach_source staging, run_static): no request may be
+        able to outgrow its block table mid-serve, and the prefill step
+        always emits one token so asking for zero is a caller error."""
+        who = "" if rid is None else f"request {rid}: "
+        if prompt_len < 1:
+            raise ValueError(f"{who}empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"{who}max_new_tokens must be >= 1 (the prefill step "
+                f"always emits one token), got {max_new_tokens}")
+        if prompt_len + max_new_tokens > self.cfg.max_seq_len:
+            raise ValueError(
+                f"{who}prompt ({prompt_len}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds max_seq_len "
+                f"{self.cfg.max_seq_len}")
+
+    def submit(self, prompt, max_new_tokens: int, *, eos_id=None,
+               arrival: Optional[float] = None) -> int:
+        """Enqueue one request; returns its id (key into ``results``)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self._validate_request(len(prompt), max_new_tokens)
+        req = Request(
+            id=self._next_id, prompt=prompt,
+            max_new_tokens=int(max_new_tokens), eos_id=eos_id,
+            arrival=self._clock() if arrival is None else arrival)
+        self._next_id += 1
+        self._ids_seen.add(req.id)
+        self.scheduler.submit(Sequence(req=req, context=prompt))
+        _REQ_SUBMITTED.inc()
+        return req.id
+
+    def _stage_rows(self, requests: Iterable[Request]):
+        """Generator the staging DevicePrefetcher consumes: pads each
+        prompt to its prefill tier and hands the row over for the
+        background device_put.  Metadata rides a side deque in the same
+        order (the staging queue is strictly FIFO)."""
+        for req in requests:
+            # the raise propagates to the consumer via the prefetcher
+            self._validate_request(len(req.prompt), req.max_new_tokens,
+                                   rid=req.id)
+            row = np.zeros(
+                (_tier_for(self.prefill_tiers, len(req.prompt)),), np.int32)
+            row[:len(req.prompt)] = req.prompt
+            self._staging_meta.append(req)
+            yield (row,)
+
+    def attach_source(self, requests: Iterable[Request],
+                      depth: Optional[int] = None) -> None:
+        """Open-loop intake: stage ``requests`` (an iterator that may
+        block until each request's arrival time) through the device
+        prefetcher while steps compute."""
+        if self._staging is not None and not self._source_done:
+            raise RuntimeError("a request source is already attached")
+        gen = self._stage_rows(requests)
+        if self._staging is None:
+            self._staging = DevicePrefetcher(gen, depth=depth,
+                                             source_kind="serving")
+        else:
+            self._staging.restart(gen)
+        self._source_done = False
+
+    def _drain_staging(self, block: bool) -> None:
+        if self._staging is None or self._source_done:
+            return
+        while True:
+            item = self._staging.poll(block=block)
+            block = False  # at most one blocking wait per drain
+            if item is self._staging.EXHAUSTED:
+                self._source_done = True
+                return
+            if item is None:
+                return
+            req = self._staging_meta.popleft()
+            # caller-chosen ids and submit()'s counter share `results`:
+            # reject an id already used (it would silently clobber that
+            # request's output) and keep the counter strictly above
+            # every id seen so future submit()s can't collide either
+            if req.id in self._ids_seen:
+                raise ValueError(
+                    f"sourced request id {req.id} already in use")
+            self._ids_seen.add(req.id)
+            self._next_id = max(self._next_id, req.id + 1)
+            seq = Sequence(req=req, context=req.prompt)
+            seq.staged = item[0]
+            self.scheduler.submit(seq)
+            _REQ_SUBMITTED.inc()
+
+    # -- batch assembly ------------------------------------------------------
+
+    def _batch_tier(self, n: int) -> int:
+        return _tier_for(self.decode_tiers, n)
+
+    def _prefill_batch(self, batch: List[Sequence]):
+        p = max(_tier_for(self.prefill_tiers, len(s.context))
+                for s in batch)
+        bt = self._batch_tier(len(batch))
+        rows = []
+        for s in batch:
+            row = s.staged
+            if row is None:  # evicted/requeued or submitted directly
+                host = np.zeros((p,), np.int32)
+                host[:len(s.context)] = s.context
+                row = jnp.asarray(host)
+            elif row.shape[0] < p:  # device-side pad up to the batch tier
+                row = jnp.pad(row, (0, p - row.shape[0]))
+            rows.append(row)
+        rows.extend([jnp.zeros((p,), jnp.int32)] * (bt - len(batch)))
+        return jnp.stack(rows), p, bt
+
+    def _tables_lens(self, seqs: List[Sequence], bt: int, lens: List[int]):
+        tables = np.zeros((bt, self.max_blocks_per_seq), np.int32)
+        for i, s in enumerate(seqs):
+            tables[i, :len(s.blocks)] = s.blocks
+        lens_arr = np.zeros((bt,), np.int32)
+        lens_arr[:len(seqs)] = lens
+        return jnp.asarray(tables), jnp.asarray(lens_arr)
+
+    def _prefill_once(self, seqs: List[Sequence]):
+        """One prefill step over ``seqs`` (ONE assembly for both the
+        engine loop and the static baseline — the A/B must execute
+        identical step programs)."""
+        tokens, p, bt = self._prefill_batch(seqs)
+        tables, lens = self._tables_lens(
+            seqs, bt, [len(s.context) for s in seqs])
+        self._book_program("prefill", bt, p)
+        next_tok, self.k_pool, self.v_pool = self._prefill_fn(
+            self.params, self.k_pool, self.v_pool, tables, lens, tokens)
+        _STEP_PREFILL.inc()
+        return np.asarray(next_tok), self._clock()
+
+    def _decode_once(self, seqs: List[Sequence]):
+        """One decode step over ``seqs`` — tokens in cache = length - 1
+        (the newest generated token's K/V is written by THIS step, at
+        position length - 1)."""
+        bt = self._batch_tier(len(seqs))
+        cache_lens = [s.length - 1 for s in seqs]
+        tables, lens = self._tables_lens(seqs, bt, cache_lens)
+        last = np.zeros((bt,), np.int32)
+        last[:len(seqs)] = [s.generated[-1] for s in seqs]
+        self._book_program("decode", bt)
+        next_tok, self.k_pool, self.v_pool = self._decode_fn(
+            self.params, self.k_pool, self.v_pool, tables, lens,
+            jnp.asarray(last))
+        _STEP_DECODE.inc()
+        return np.asarray(next_tok), self._clock()
+
+    # -- token emission ------------------------------------------------------
+
+    def _observe_token(self, seq: Sequence, token: int, now: float) -> None:
+        """Shared emission bookkeeping for BOTH legs (continuous and the
+        static baseline) — identical latency semantics is what keeps the
+        bench A/B honest."""
+        seq.generated.append(int(token))
+        if self.token_log is not None:
+            self.token_log.append((seq.req.id, now, seq.req.arrival))
+        if seq.first_token_at is None:
+            seq.first_token_at = now
+            _LAT_FIRST.observe(now - seq.req.arrival)
+        elif seq.last_token_at is not None:
+            # honest inter-token gap: after an eviction it includes the
+            # requeue wait + re-prefill — that IS the user-visible stall
+            _LAT_INTER.observe(now - seq.last_token_at)
+        seq.last_token_at = now
+
+    def _emit(self, seq: Sequence, token: int, now: float) -> None:
+        self._observe_token(seq, token, now)
+        if seq.done:
+            self.scheduler.finish(seq)
+            # the emitted stream: tokens folded into context by evictions
+            # plus those generated since (an EOS always completes the
+            # sequence the step it is emitted, so none hides mid-stream)
+            self.results[seq.req.id] = np.concatenate([
+                seq.context[len(seq.req.prompt):],
+                np.asarray(seq.generated, np.int32)])
+            _REQ_COMPLETED.inc()
+
+    # -- the scheduler loop --------------------------------------------------
+
+    def step(self) -> bool:
+        """One iteration: drain staging, then EITHER one prefill (when
+        admission succeeded) or one decode over the running batch.
+        Returns False when there is nothing left to do."""
+        idle = not self.scheduler.running and not self.scheduler.pending
+        self._drain_staging(block=idle and not self._source_done)
+        batch = self.scheduler.admit()
+        if batch:
+            toks, now = self._prefill_once(batch)
+            for i, s in enumerate(batch):
+                self._emit(s, toks[i], now)
+            return True
+        self.scheduler.grow_running()
+        running = list(self.scheduler.running)
+        if running:
+            toks, now = self._decode_once(running)
+            for i, s in enumerate(running):
+                self._emit(s, toks[i], now)
+            return True
+        return not self._source_done or bool(self.scheduler.pending)
+
+    def run(self) -> Dict[int, np.ndarray]:
+        """Drive :meth:`step` until every submitted/staged request has
+        completed; returns ``results`` (id -> generated token ids)."""
+        while self.step():
+            pass
+        return self.results
+
+    # -- the pre-Orca baseline ----------------------------------------------
+
+    def run_static(self, requests: List[Request],
+                   batch_size: int) -> Dict[int, np.ndarray]:
+        """Static (request-level) batching baseline: fixed batches held
+        until every member finishes, each member holding a contiguous
+        reservation for the batch's worst-case length — the two wastes
+        continuous batching + paging remove.  Shares the engine's jitted
+        tier programs, pools and greedy sampling, so the A/B isolates
+        the SCHEDULING policy."""
+        results: Dict[int, np.ndarray] = {}
+        for r in requests:
+            self._validate_request(len(r.prompt), r.max_new_tokens,
+                                   rid=r.id)
+        for at in range(0, len(requests), batch_size):
+            chunk = requests[at:at + batch_size]
+            seqs = [Sequence(req=r, context=np.asarray(r.prompt, np.int32))
+                    for r in chunk]
+            worst = max(len(r.prompt) + r.max_new_tokens for r in chunk)
+            for s in seqs:
+                got = self.allocator.alloc(
+                    blocks_for(worst, self.serve_cfg.block_size))
+                if got is None:
+                    raise RuntimeError(
+                        "static baseline could not reserve "
+                        f"{worst}-token contiguous KV for a batch of "
+                        f"{len(chunk)} — the reservation waste paging "
+                        "removes")
+                s.blocks = got
+            toks, now = self._prefill_once(seqs)
+            for i, s in enumerate(seqs):
+                self._static_emit(s, toks[i], now, results)
+            while not all(s.done for s in seqs):
+                toks, now = self._decode_once(seqs)
+                for i, s in enumerate(seqs):
+                    if not s.done:
+                        self._static_emit(s, toks[i], now, results)
+            for s in seqs:
+                self.allocator.free(s.blocks)
+                s.blocks = []
+        return results
+
+    def _static_emit(self, seq: Sequence, token: int, now: float,
+                     results: Dict[int, np.ndarray]) -> None:
+        self._observe_token(seq, token, now)
+        if seq.done:
+            results[seq.req.id] = np.asarray(seq.generated, np.int32)

@@ -1,0 +1,259 @@
+"""Paged KV cache: fixed-size KV blocks in preallocated device pools.
+
+The serving memory problem (vLLM's PagedAttention, SOSP '23): contiguous
+per-sequence KV buffers sized for ``max_seq_len`` waste most of HBM on
+reservations, and the waste is what caps the batch — the batch is what
+throughput lives on.  Paging fixes it the way virtual memory did: the
+pools hold ``num_blocks`` fixed-size blocks of K/V per layer, a
+per-sequence *block table* maps logical token positions to physical
+blocks, and a sequence owns exactly ``ceil(len / block_size)`` blocks at
+any moment.
+
+Two cooperating pieces:
+
+* :class:`BlockAllocator` — the host-side free list.  Block 0 is
+  reserved as the *trash block*: every padded/unused block-table slot
+  points at it, so scatter writes from padded positions land somewhere
+  harmless and gathers from padded slots read garbage that the decode
+  kernel's per-sequence causal mask never attends
+  (``ops.flash_attention.flash_decode_attention``).
+* :class:`PagedKVState` — the device-side pytree carried through the
+  jitted prefill/decode step: the pools, the step batch's block tables
+  and lengths.  The transformer's attention layers call its
+  ``write_prefill`` / ``write_decode`` / ``gather`` from inside the
+  traced step; the updated pools come back out through the step's
+  return value (functional update, ``.at[].set``).
+
+The decode read path is where the paged + GQA + window savings stack:
+the decode KERNEL reads only the blocks holding a sequence's live
+positions (block-table gather + ``_kb_range`` skip), once per KV head
+(GQA BlockSpecs), and only the trailing window's worth when ``window``
+is set — where the gather itself is also truncated to the last pages
+(without a window the gather copy stays ``max_blocks`` wide; static
+shapes) — see :func:`modeled_decode_read_bytes`, which models both
+terms, and the columns ``tools/serve_bench.py`` emits.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def blocks_for(length: int, block_size: int) -> int:
+    """Blocks a sequence of ``length`` tokens occupies (ceil division)."""
+    return -(-int(length) // int(block_size))
+
+
+class BlockAllocator:
+    """Free-list allocator over the pool's block ids (host side).
+
+    Block 0 is never handed out — it is the shared trash block padded
+    block-table slots point at (see module docstring).  Allocation is
+    all-or-nothing: a partial grab would strand blocks the caller can't
+    use (the scheduler admits against :meth:`free_blocks` first).
+    """
+
+    def __init__(self, num_blocks: int, block_size: int = 16):
+        if num_blocks < 2:
+            raise ValueError(
+                f"need >= 2 blocks (one is the trash block), got {num_blocks}"
+            )
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
+        self.peak_occupancy = 0.0  # high-water mark (bench column)
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def capacity(self) -> int:
+        """Allocatable blocks (pool size minus the trash block)."""
+        return self.num_blocks - 1
+
+    def occupancy(self) -> float:
+        """Fraction of allocatable blocks currently owned by sequences."""
+        return 1.0 - len(self._free) / self.capacity
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """``n`` block ids, or None if the pool can't satisfy all of them."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            return None
+        taken = self._free[-n:] if n else []
+        del self._free[len(self._free) - n:]
+        self.peak_occupancy = max(self.peak_occupancy, self.occupancy())
+        return list(reversed(taken))
+
+    def free(self, blocks: Sequence[int]) -> None:
+        seen = set(self._free)
+        for b in blocks:
+            if not 0 < b < self.num_blocks:
+                raise ValueError(f"block id {b} out of range")
+            if b in seen:
+                raise ValueError(f"double free of block {b}")
+            seen.add(b)
+        self._free.extend(blocks)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class PagedKVState:
+    """Device-side paged-cache state for ONE engine step (a pytree).
+
+    ``k``/``v``: (num_layers, num_blocks, block_size, H_kv, D) pools.
+    ``tables``: (B, max_blocks) int32 — the step batch's block tables,
+    rows padded with 0 (the trash block).
+    ``lens``: (B,) int32 — tokens already written for each sequence
+    BEFORE this step's token(s); pad slots carry 0.
+    ``mode``: 'prefill' | 'decode' (static — selects the write/attend
+    shape inside the traced step).
+    """
+
+    k: jax.Array
+    v: jax.Array
+    tables: jax.Array
+    lens: jax.Array
+    mode: str = "decode"
+
+    def tree_flatten(self):
+        return (self.k, self.v, self.tables, self.lens), (self.mode,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        k, v, tables, lens = children
+        return cls(k=k, v=v, tables=tables, lens=lens, mode=aux[0])
+
+    # -- static geometry -----------------------------------------------------
+
+    @property
+    def block_size(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def max_blocks(self) -> int:
+        return self.tables.shape[1]
+
+    # -- traced cache ops (called from inside the model's attention) ---------
+
+    def write_prefill(self, layer: int, k_new: jax.Array,
+                      v_new: jax.Array) -> None:
+        """Scatter a prefill batch's K/V — (B, P, H_kv, D), positions
+        0..P-1 — into the pools through the block tables.  Rows beyond a
+        sequence's true length land in the trash block (padded table
+        slots) or in the owned tail block at not-yet-attendable offsets
+        (overwritten by the decode write before they become visible)."""
+        b, p = k_new.shape[0], k_new.shape[1]
+        pos = jnp.arange(p, dtype=jnp.int32)
+        blk = jnp.take_along_axis(
+            self.tables, pos[None, :] // self.block_size, axis=1)  # (B, P)
+        off = jnp.broadcast_to(pos[None, :] % self.block_size, (b, p))
+        self.k = self.k.at[layer, blk, off].set(k_new)
+        self.v = self.v.at[layer, blk, off].set(v_new)
+
+    def write_decode(self, layer: int, k_new: jax.Array,
+                     v_new: jax.Array) -> None:
+        """Scatter one decode token's K/V — (B, 1, H_kv, D) at position
+        ``lens`` — into each sequence's tail block."""
+        blk = jnp.take_along_axis(
+            self.tables, (self.lens[:, None] // self.block_size), axis=1
+        )[:, 0]  # (B,)
+        off = self.lens % self.block_size
+        self.k = self.k.at[layer, blk, off].set(k_new[:, 0])
+        self.v = self.v.at[layer, blk, off].set(v_new[:, 0])
+
+    def gather(self, layer: int, window: Optional[int] = None):
+        """Gather each sequence's pages contiguous for the decode kernel:
+        returns (k, v, kv_start) with k/v (B, n_blocks*block_size, H_kv,
+        D) and kv_start (B,) the global position of each gathered row 0.
+
+        With ``window`` set only the trailing pages that can hold the
+        window are gathered — the static gather width drops from
+        ``max_blocks`` to ~``window/block_size`` pages, which with the
+        in-kernel block skip is the O(window) decode read."""
+        bs = self.block_size
+        if window is None:
+            tbl = self.tables
+            kv_start = jnp.zeros((self.tables.shape[0],), jnp.int32)
+        else:
+            # pages covering positions [lens - window, lens]: the window
+            # plus the in-flight token, plus one page of alignment slack
+            n_win = min(self.max_blocks, window // bs + 2)
+            first = jnp.clip(
+                (self.lens + 1 - window) // bs, 0, self.max_blocks - n_win)
+            idx = first[:, None] + jnp.arange(n_win, dtype=jnp.int32)[None]
+            tbl = jnp.take_along_axis(self.tables, idx, axis=1)
+            kv_start = first * bs
+        gk = self.k[layer][tbl]  # (B, n, bs, H_kv, D)
+        gv = self.v[layer][tbl]
+        b, n = tbl.shape
+        h_kv, d = self.k.shape[3], self.k.shape[4]
+        return (gk.reshape(b, n * bs, h_kv, d),
+                gv.reshape(b, n * bs, h_kv, d), kv_start)
+
+
+def make_pools(num_layers: int, num_blocks: int, block_size: int,
+               num_kv_heads: int, head_dim: int, dtype) -> tuple:
+    """Zeroed (k, v) pools: (L, N, block_size, H_kv, D) each."""
+    shape = (num_layers, num_blocks, block_size, num_kv_heads, head_dim)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def pool_bytes(num_layers: int, num_blocks: int, block_size: int,
+               num_kv_heads: int, head_dim: int, dtype) -> int:
+    """Total bytes of one K+V pool pair."""
+    per = num_layers * num_blocks * block_size * num_kv_heads * head_dim
+    return 2 * per * jnp.dtype(dtype).itemsize
+
+
+def modeled_decode_read_bytes(context_len: int, *, block_size: int,
+                              num_heads: int, num_kv_heads: int,
+                              head_dim: int, num_layers: int,
+                              window: Optional[int] = None,
+                              dtype_bytes: int = 2,
+                              max_seq_len: Optional[int] = None) -> dict:
+    """Modeled K/V bytes ONE sequence's decode step reads, paged vs the
+    dense full-context baseline — the serve_bench column pinning the
+    paged + GQA + window read reduction (CPU-measurable: it is pure
+    block arithmetic, the same ``blocks_for`` the allocator uses).
+
+    Two paged terms, because this engine's decode path has two stages:
+
+    * ``paged_bytes`` — what the KERNEL reads: the owned pages holding
+      live positions (window-truncated when set), once per KV head
+      (``_kb_range`` skips the rest of the gathered buffer).
+    * ``gathered_bytes`` — what :meth:`PagedKVState.gather` materializes
+      first: with ``window`` set, ~``window/block_size`` trailing pages
+      (the O(window) claim); with ``window=None`` the gather is
+      ``max_blocks`` wide regardless of context (static shapes — the
+      honest cost of this engine's gather-then-attend layout, and why
+      windowed configs are the production recommendation).
+
+    baseline ``full_bytes``: a contiguous ``max_seq_len`` MHA buffer —
+    what a non-paged, non-GQA cache re-reads every step.
+    """
+    max_pages = blocks_for(max_seq_len or context_len, block_size)
+    span = context_len if window is None else min(context_len, window + 1)
+    pages = blocks_for(span, block_size) + (
+        0 if window is None else 1)  # alignment slack page
+    pages = min(pages, max_pages)
+    gathered = max_pages if window is None else min(
+        max_pages, window // block_size + 2)
+    per_kv_page = 2 * block_size * num_kv_heads * head_dim  # K+V, one page
+    full = max_seq_len if max_seq_len is not None else context_len
+    per_layer_full = 2 * full * num_heads * head_dim
+    return {
+        "paged_bytes": num_layers * pages * per_kv_page * dtype_bytes,
+        "gathered_bytes": num_layers * gathered * per_kv_page * dtype_bytes,
+        "full_bytes": num_layers * per_layer_full * dtype_bytes,
+        "pages_read": pages,
+        "pages_gathered": gathered,
+    }

@@ -1,0 +1,208 @@
+"""Iteration-level (continuous-batching) request scheduler.
+
+Orca (OSDI '22) made the case: autoregressive serving must schedule at
+*iteration* granularity, not request granularity.  A static batch holds
+every slot hostage to its slowest member — finished sequences keep
+padding the batch, waiting requests queue behind the whole batch's
+maximum length.  Continuous batching re-decides the batch every step:
+finished sequences leave immediately, waiting requests join as soon as
+a slot and KV blocks are free, so the decode batch stays full and
+throughput tracks the token budget instead of the worst tail.
+
+The policy here (documented in docs/SERVING.md):
+
+* **Prefill-prioritized**: when admissible requests are waiting, the
+  next step is a prefill — time-to-first-token is the latency SLO,
+  and a full batch is the throughput SLO; both want admission early.
+* **Admission gates**: the prompt-token sum of one prefill batch is
+  capped by ``token_budget`` (bounds the prefill step's cost so decode
+  latency can't spike arbitrarily), the decode batch by the largest
+  padding tier, and block allocation must leave ``watermark`` free
+  blocks (headroom so running sequences can keep growing without
+  immediate eviction thrash).
+* **LIFO eviction (recompute-style)**: when a growing sequence needs a
+  block and the pool is empty, the most recently admitted sequence is
+  preempted — its blocks are freed and it re-queues *with the tokens it
+  already generated* (vLLM's recompute preemption), so its re-prefill
+  reproduces the exact cache state and generation continues token-for-
+  token identically (greedy decode is deterministic; the oracle test
+  pins this across evict boundaries).
+
+Everything here is host-side bookkeeping over the
+:class:`~horovod_tpu.serving.kv_cache.BlockAllocator`; the device work
+happens in :mod:`horovod_tpu.serving.engine`.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+from typing import Deque, List, Optional, Tuple
+
+import numpy as np
+
+from ..metrics import instruments as _instr
+from .kv_cache import BlockAllocator, blocks_for
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request as submitted by the client."""
+
+    id: int
+    prompt: np.ndarray  # int32 token ids, 1-D
+    max_new_tokens: int
+    eos_id: Optional[int] = None
+    arrival: float = 0.0  # open-loop load injection timestamp (bench)
+
+
+@dataclasses.dataclass
+class Sequence:
+    """A request's live serving state.
+
+    ``context`` is what the next prefill must write: the prompt, plus —
+    after an eviction — the tokens already generated (recompute
+    preemption re-prefills prompt+generated and resumes decoding).
+    """
+
+    req: Request
+    context: np.ndarray
+    generated: List[int] = dataclasses.field(default_factory=list)
+    blocks: List[int] = dataclasses.field(default_factory=list)
+    staged: object = None  # device-resident padded prompt row (staging queue)
+    first_token_at: Optional[float] = None
+    last_token_at: Optional[float] = None
+
+    @property
+    def length(self) -> int:
+        """Tokens currently in the KV cache once prefill has run."""
+        return len(self.context) + len(self.generated)
+
+    @property
+    def done(self) -> bool:
+        n = len(self.generated) + (len(self.context) - len(self.req.prompt))
+        if n >= self.req.max_new_tokens:
+            return True
+        eos = self.req.eos_id
+        return eos is not None and len(self.generated) > 0 \
+            and self.generated[-1] == eos
+
+
+class ContinuousBatchingScheduler:
+    """Admit/evict sequences against a token budget and a block pool."""
+
+    def __init__(self, allocator: BlockAllocator, *, token_budget: int,
+                 watermark: int, max_decode_batch: int,
+                 max_seq_len: int):
+        if token_budget < 1:
+            raise ValueError(f"token_budget must be >= 1, got {token_budget}")
+        if watermark < 0:
+            raise ValueError(f"watermark must be >= 0, got {watermark}")
+        need_one = blocks_for(max_seq_len, allocator.block_size)
+        if need_one > allocator.capacity:
+            raise ValueError(
+                f"pool of {allocator.capacity} blocks cannot hold one "
+                f"max_seq_len={max_seq_len} sequence ({need_one} blocks) — "
+                f"a lone sequence could deadlock growth")
+        self.allocator = allocator
+        self.token_budget = int(token_budget)
+        self.watermark = int(watermark)
+        self.max_decode_batch = int(max_decode_batch)
+        self.max_seq_len = int(max_seq_len)
+        self.pending: Deque[Sequence] = collections.deque()
+        self.running: List[Sequence] = []
+        self.evictions = 0
+        #: extra waiting requests not yet in ``pending`` (the engine
+        #: points this at its device-staging queue so the queue-depth
+        #: gauge counts staged + pending, as documented)
+        self.staged_depth = lambda: 0
+
+    # -- bookkeeping ---------------------------------------------------------
+
+    def submit(self, seq: Sequence) -> None:
+        self.pending.append(seq)
+        self._book()
+
+    def _book(self) -> None:
+        _instr.SERVE_QUEUE_DEPTH.set(len(self.pending) + self.staged_depth())
+        _instr.SERVE_KV_OCCUPANCY.set(self.allocator.occupancy())
+
+    def finish(self, seq: Sequence) -> None:
+        """Release a completed sequence's blocks and batch slot."""
+        self.running.remove(seq)
+        self.allocator.free(seq.blocks)
+        seq.blocks = []
+        self._book()
+
+    def _evict_one(self) -> bool:
+        """Preempt the most recently admitted sequence (LIFO recompute)."""
+        if len(self.running) <= 1:
+            return False
+        victim = self.running.pop()
+        self.allocator.free(victim.blocks)
+        victim.blocks = []
+        # recompute preemption: re-prefill prompt + generated so far
+        victim.context = np.concatenate([
+            victim.context, np.asarray(victim.generated, np.int32)])
+        victim.generated = []
+        victim.staged = None  # host re-pads/re-stages at re-admission
+        self.pending.appendleft(victim)
+        self.evictions += 1
+        _instr.SERVE_EVICTIONS.inc()
+        self._book()
+        return True
+
+    # -- the per-step decision ----------------------------------------------
+
+    def grow_running(self) -> None:
+        """Before a decode step: every running sequence is about to gain
+        one token; allocate tail blocks, evicting LIFO when the pool is
+        dry.  A sequence evicted here simply re-queues — the decode step
+        then runs over whoever is left."""
+        for seq in list(self.running):
+            if seq not in self.running:
+                continue  # evicted by an earlier iteration
+            while True:
+                need = blocks_for(seq.length + 1, self.allocator.block_size)
+                if need <= len(seq.blocks):
+                    break
+                got = self.allocator.alloc(need - len(seq.blocks))
+                if got is not None:
+                    seq.blocks.extend(got)
+                    break
+                if not self._evict_one() or seq not in self.running:
+                    break
+        self._book()
+
+    def admit(self) -> List[Sequence]:
+        """Admit pending sequences for one prefill batch: token budget,
+        decode-batch slots, and block watermark all permitting.  The
+        admitted sequences get their context's blocks allocated here and
+        join ``running``; returns them (empty = no prefill this step)."""
+        batch: List[Sequence] = []
+        tokens = 0
+        while self.pending:
+            seq = self.pending[0]
+            ctx = len(seq.context)  # <= max_seq_len: engine validates at
+            # submit and caps generation at max_seq_len
+            if batch and tokens + ctx > self.token_budget:
+                break
+            if len(self.running) + len(batch) + 1 > self.max_decode_batch:
+                break
+            need = blocks_for(ctx + 1, self.allocator.block_size)
+            # the watermark bypass exists ONLY for the progress
+            # guarantee (an idle engine must admit SOMETHING); with
+            # sequences already running, draining below the watermark
+            # just sets up the admit→grow→evict thrash it prevents
+            if self.allocator.free_blocks - need < self.watermark and (
+                    batch or self.running):
+                break
+            got = self.allocator.alloc(need)
+            if got is None:
+                break
+            seq.blocks = got
+            batch.append(self.pending.popleft())
+            tokens += ctx
+        self.running.extend(batch)
+        self._book()
+        return batch

@@ -264,6 +264,81 @@ def test_prefetcher_propagates_producer_error():
         next(pf)  # error is sticky too
 
 
+def test_prefetcher_poll_and_exhausted_marker():
+    """The staging-queue consume (serving): poll() never raises
+    StopIteration — items, then the sticky EXHAUSTED marker."""
+    pf = data.DevicePrefetcher(
+        iter([(np.full(2, i),) for i in range(3)]), depth=2,
+        device_put=False, source_kind="serving")
+    got = []
+    while True:
+        item = pf.poll(block=True)
+        if item is pf.EXHAUSTED:
+            break
+        got.append(int(item[0][0]))
+    assert got == [0, 1, 2]
+    assert pf.exhausted
+    assert pf.poll() is pf.EXHAUSTED, "exhaustion is sticky for poll too"
+    with pytest.raises(StopIteration):
+        next(pf)
+
+
+def test_prefetcher_poll_depth_zero_synchronous():
+    pf = data.DevicePrefetcher(iter([(np.ones(1),)] * 2), depth=0,
+                               device_put=False)
+    assert pf.poll() is not None and pf.poll(block=True) is not None
+    assert pf.poll() is pf.EXHAUSTED
+    assert pf.exhausted
+
+
+def test_prefetcher_poll_after_close_returns_exhausted():
+    """close() drains the queue (sentinel included): a blocking poll
+    afterwards must return EXHAUSTED, not hang on the empty queue."""
+    pf = data.DevicePrefetcher(iter([(np.ones(1),)] * 5), depth=2,
+                               device_put=False)
+    pf.close()
+    assert pf.poll(block=True) is pf.EXHAUSTED
+    assert pf.poll() is pf.EXHAUSTED
+
+
+def test_prefetcher_restart_contract():
+    """The long-lived reuse contract: restart() re-arms an exhausted
+    prefetcher on a fresh iterable, stats keep summing, and restarting
+    an ACTIVE stream is refused (its producer would race the new one)."""
+    pf = data.DevicePrefetcher(iter([(np.zeros(1),)] * 2), depth=2,
+                               device_put=False)
+    with pytest.raises(RuntimeError, match="active"):
+        pf.restart(iter([]))
+    assert len(list(pf)) == 2 and pf.exhausted
+    pf.restart(iter([(np.ones(1),)] * 3))
+    assert not pf.exhausted
+    assert len(list(pf)) == 3
+    assert pf.stats()["batches"] == 5, "stats sum across streams"
+    # restart also revives a close()d prefetcher
+    pf.close()
+    assert pf.closed
+    pf.restart(iter([(np.ones(1),)]))
+    assert not pf.closed and len(list(pf)) == 1
+    pf.close()
+
+
+def test_prefetcher_restart_does_not_leak_old_stream():
+    """A producer parked on a full queue at close() must never deliver
+    its stale items into the restarted stream's queue."""
+    def slow_then_poisoned():
+        for i in range(50):
+            yield (np.full(1, -1.0),)  # stale marker
+
+    pf = data.DevicePrefetcher(slow_then_poisoned(), depth=1,
+                               device_put=False)
+    time.sleep(0.1)  # let the producer fill the queue and block
+    pf.close()
+    pf.restart(iter([(np.full(1, float(i)),) for i in range(4)]))
+    got = [float(b[0][0]) for b in pf]
+    assert got == [0.0, 1.0, 2.0, 3.0], got
+    pf.close()
+
+
 def test_prefetcher_bf16_cast_floats_only():
     import jax.numpy as jnp
 

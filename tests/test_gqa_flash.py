@@ -267,3 +267,105 @@ def test_modeled_flops_drop_with_window():
         prev = f
     # O(S·W): at W=256 with 256-blocks, each Q block visits <= 3 K blocks
     assert prev <= 4 * 1 * 8 * 256 * 256 * 128 * (4096 // 256) * 3
+
+
+# -- q_len=1 decode entry (the paged-KV serving path, ISSUE 8) ---------------
+
+
+from horovod_tpu.ops.flash_attention import flash_decode_attention  # noqa: E402
+
+
+def decode_oracle(q, k, v, kv_lens, window=None, kv_start=None):
+    """Dense per-sequence reference for single-token decode: query at
+    global position kv_lens-1 attends keys at global positions
+    kv_start..kv_start+S_kv-1 masked by length and window."""
+    b, _, h, d = q.shape
+    s_k = k.shape[1]
+    g = h // k.shape[2]
+    kf = np.repeat(np.asarray(k, np.float32), g, axis=2)
+    vf = np.repeat(np.asarray(v, np.float32), g, axis=2)
+    starts = (np.zeros(b, np.int64) if kv_start is None
+              else np.asarray(kv_start, np.int64))
+    outs = np.zeros((b, 1, h, d), np.float32)
+    for i in range(b):
+        qpos = int(kv_lens[i]) - 1
+        kg = starts[i] + np.arange(s_k)
+        mask = kg <= qpos
+        if window is not None:
+            mask &= (qpos - kg) < window
+        if not mask.any():
+            continue  # fully masked row: the kernel's -inf lse sentinel
+        s = np.einsum("hd,shd->hs",
+                      np.asarray(q[i, 0], np.float32) / np.sqrt(d), kf[i])
+        s[:, ~mask] = -np.inf
+        p = np.exp(s - s.max(axis=1, keepdims=True))
+        p /= p.sum(axis=1, keepdims=True)
+        outs[i, 0] = np.einsum("hs,shd->hd", p, vf[i])
+    return outs
+
+
+def _decode_qkv(b, s_k, h, h_kv, d, kv_lens, seed=0, kv_start=None):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (b, 1, h, d), jnp.float32)
+    k = np.array(jax.random.normal(ks[1], (b, s_k, h_kv, d)))
+    v = np.array(jax.random.normal(ks[2], (b, s_k, h_kv, d)))
+    # poison every position the mask must exclude: a wrong/missing mask
+    # turns into a huge numeric diff, not a subtle one
+    starts = np.zeros(b, np.int64) if kv_start is None else np.asarray(kv_start)
+    for i in range(b):
+        k[i, max(0, kv_lens[i] - starts[i]):] = 1e4
+        v[i, max(0, kv_lens[i] - starts[i]):] = 1e4
+    return q, jnp.asarray(k), jnp.asarray(v)
+
+
+@pytest.mark.parametrize("ratio", [1, 2, 4])
+@pytest.mark.parametrize("window", [None, 24])
+def test_flash_decode_matches_oracle(ratio, window):
+    """Single query row vs dense reference across GQA ratio x window;
+    per-sequence kv_lens land mid-block and at block boundaries, with
+    poisoned K/V beyond every length."""
+    kv_lens = np.array([1, 37, 128, 160], np.int32)  # edges + mid-block
+    q, k, v = _decode_qkv(4, 160, 4, 4 // ratio, 16, kv_lens, seed=ratio)
+    out = flash_decode_attention(q, k, v, kv_lens, window=window)
+    ref = decode_oracle(q, k, v, kv_lens, window=window)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-5, atol=2e-5)
+
+
+def test_flash_decode_kv_start_offsets():
+    """The windowed-gather contract: k[:, 0] sits at a per-sequence
+    global position (page-aligned or not); masks must stay global.
+    Covers kv_offset at non-zero block-size boundaries (128 = one
+    block_k) and unaligned starts."""
+    starts = np.array([0, 128, 37], np.int64)
+    kv_lens = np.array([60, 170, 95], np.int32)
+    q, k, v = _decode_qkv(3, 64, 4, 2, 16, kv_lens, seed=9,
+                          kv_start=starts)
+    for window in (None, 16):
+        out = flash_decode_attention(q, k, v, kv_lens, window=window,
+                                     kv_start=starts)
+        ref = decode_oracle(q, k, v, kv_lens, window=window,
+                            kv_start=starts)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-5,
+                                   atol=2e-5, err_msg=f"window={window}")
+
+
+def test_flash_decode_fully_masked_rows_are_zero():
+    """kv_lens<=0 pad slots (and window pushed fully past the gather)
+    ride the -inf lse sentinel: all-zero output, no NaN."""
+    kv_lens = np.array([0, 48, 0], np.int32)
+    q, k, v = _decode_qkv(3, 64, 4, 2, 16, kv_lens, seed=3)
+    out = np.asarray(flash_decode_attention(q, k, v, kv_lens))
+    assert np.isfinite(out).all()
+    assert np.all(out[0] == 0) and np.all(out[2] == 0)
+    ref = decode_oracle(q, k, v, kv_lens)
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_flash_decode_validates():
+    q = jnp.zeros((2, 3, 4, 16))
+    kv = jnp.zeros((2, 64, 2, 16))
+    with pytest.raises(ValueError, match="q_len=1"):
+        flash_decode_attention(q, kv, kv, np.array([1, 1]))
+    with pytest.raises(ValueError, match="window"):
+        flash_decode_attention(jnp.zeros((2, 1, 4, 16)), kv, kv,
+                               np.array([1, 1]), window=0)

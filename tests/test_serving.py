@@ -1,0 +1,275 @@
+"""Continuous-batching serving: the batched-decode oracle + bounded
+compiled-program set (ISSUE 8 acceptance).
+
+The oracle (the serving exactness contract, docs/SERVING.md): greedy
+decode is deterministic, so continuous batching over the paged KV
+cache — whatever admission order, padding tier, eviction or block-table
+reuse the scheduler lands on — must emit token-for-token what
+one-at-a-time full-context decode emits.  Any paging bug (wrong block,
+stale page, bad tail-block offset, a padded slot leaking into a real
+row) breaks exactness immediately, which is why the oracle is the test
+rather than a statistical check.
+
+Program bounding: the padding-tier menu caps the compiled-program set
+by |decode_tiers| x (|prefill_tiers| + 1) regardless of the request
+distribution; the 512-request randomized load pins it via the PR-1
+executable-cache counters (warmup compiles the menu, traffic must be
+all hits).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu.metrics import instruments as _instr
+from horovod_tpu.models.transformer import Transformer, TransformerConfig
+from horovod_tpu.serving import (
+    BlockAllocator, Request, ServeConfig, ServingEngine, blocks_for,
+    modeled_decode_read_bytes,
+)
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    cfg = TransformerConfig(
+        vocab_size=97, num_layers=2, num_heads=4, num_kv_heads=2,
+        head_dim=8, max_seq_len=64, dtype=jnp.float32,
+        attention_impl="dot", causal=True)
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32), train=False)["params"]
+    return cfg, model, params
+
+
+def ref_decode(model, params, prompt, n, eos_id=None):
+    """One-at-a-time full-context greedy decode (no cache at all)."""
+    toks = list(np.asarray(prompt))
+    out = []
+    for _ in range(n):
+        x = jnp.asarray(np.asarray(toks, np.int32))[None]
+        logits = model.apply({"params": params}, x, train=False)
+        t = int(jnp.argmax(logits[0, -1].astype(jnp.float32)))
+        toks.append(t)
+        out.append(t)
+        if eos_id is not None and t == eos_id:
+            break
+    return np.asarray(out, np.int32)
+
+
+def _prompts(rs, n, lo=3, hi=20):
+    return [rs.randint(1, 97, size=rs.randint(lo, hi)).astype(np.int32)
+            for _ in range(n)]
+
+
+# -- the batched-decode oracle ----------------------------------------------
+
+
+def test_continuous_batched_decode_matches_one_at_a_time(model_and_params):
+    cfg, model, params = model_and_params
+    eng = ServingEngine(cfg, params, serve=ServeConfig(
+        block_size=8, num_blocks=0, token_budget=128, watermark=2,
+        decode_tiers=(1, 2, 4)))
+    rs = np.random.RandomState(0)
+    prompts = _prompts(rs, 6)
+    gens = [10, 3, 7, 10, 1, 5]
+    ids = [eng.submit(p, max_new_tokens=g) for p, g in zip(prompts, gens)]
+    out = eng.run()
+    for i, rid in enumerate(ids):
+        ref = ref_decode(model, params, prompts[i], gens[i])
+        np.testing.assert_array_equal(out[rid], ref, err_msg=f"req {i}")
+
+
+def test_oracle_pinned_across_evictions_and_block_reuse(model_and_params):
+    """A pool too small for the batch forces LIFO recompute evictions;
+    freed blocks are immediately reallocated to other sequences (table
+    reuse), and the evicted sequence re-prefills prompt+generated.
+    Token streams must be pinned through all of it."""
+    cfg, model, params = model_and_params
+    # 16 allocatable blocks of 4 = 64 cache slots for 3 sequences that
+    # each want prompt+18 tokens (~7 blocks): admission overcommits,
+    # growth evicts
+    eng = ServingEngine(cfg, params, serve=ServeConfig(
+        block_size=4, num_blocks=17, token_budget=64, watermark=0,
+        decode_tiers=(1, 2, 4)))
+    rs = np.random.RandomState(1)
+    prompts = _prompts(rs, 3, lo=10, hi=14)
+    ids = [eng.submit(p, max_new_tokens=18) for p in prompts]
+    out = eng.run()
+    assert eng.scheduler.evictions > 0, "pool was sized to force evictions"
+    for i, rid in enumerate(ids):
+        ref = ref_decode(model, params, prompts[i], 18)
+        np.testing.assert_array_equal(out[rid], ref, err_msg=f"req {i}")
+
+
+def test_eos_stops_generation(model_and_params):
+    cfg, model, params = model_and_params
+    eng = ServingEngine(cfg, params, serve=ServeConfig(
+        block_size=8, num_blocks=0, token_budget=128, watermark=1,
+        decode_tiers=(1, 2)))
+    rs = np.random.RandomState(2)
+    prompt = _prompts(rs, 1)[0]
+    ref = ref_decode(model, params, prompt, 16)
+    eos = int(ref[4])  # stop at the 5th token the model will emit
+    rid = eng.submit(prompt, max_new_tokens=16, eos_id=eos)
+    out = eng.run()
+    np.testing.assert_array_equal(
+        out[rid], ref_decode(model, params, prompt, 16, eos_id=eos))
+    assert out[rid][-1] == eos and len(out[rid]) <= 16
+
+
+def test_staged_source_path_matches_submit_path(model_and_params):
+    """attach_source (DevicePrefetcher staging) and direct submit are
+    the same requests — same tokens out."""
+    cfg, model, params = model_and_params
+    rs = np.random.RandomState(3)
+    prompts = _prompts(rs, 5)
+    reqs = [Request(id=i, prompt=p, max_new_tokens=6)
+            for i, p in enumerate(prompts)]
+    eng = ServingEngine(cfg, params, serve=ServeConfig(
+        block_size=8, num_blocks=0, token_budget=128, watermark=2,
+        decode_tiers=(1, 2, 4)))
+    eng.attach_source(iter(reqs))
+    out = eng.run()
+    for i, p in enumerate(prompts):
+        np.testing.assert_array_equal(
+            out[i], ref_decode(model, params, p, 6), err_msg=f"req {i}")
+
+
+def test_submit_validates(model_and_params):
+    cfg, _, params = model_and_params
+    eng = ServingEngine(cfg, params, serve=ServeConfig(
+        block_size=8, num_blocks=0, decode_tiers=(1, 2)))
+    with pytest.raises(ValueError, match="empty"):
+        eng.submit(np.zeros((0,), np.int32), max_new_tokens=4)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        eng.submit(np.ones((4,), np.int32), max_new_tokens=0)
+    with pytest.raises(ValueError, match="max_seq_len"):
+        eng.submit(np.ones((60,), np.int32), max_new_tokens=10)
+    with pytest.raises(ValueError, match="causal"):
+        ServingEngine(
+            TransformerConfig(causal=False, dtype=jnp.float32), params)
+
+
+def test_oversize_prefill_tier_dropped(model_and_params):
+    """A tier > max_seq_len would index block-table columns past
+    max_blocks and corrupt real KV through the clamped gather — the
+    engine must drop it (warning) rather than compile it."""
+    cfg, _, params = model_and_params  # max_seq_len = 64
+    eng = ServingEngine(cfg, params, serve=ServeConfig(
+        block_size=8, num_blocks=0, prefill_tiers=(32, 100),
+        decode_tiers=(1, 2)))
+    assert max(eng.prefill_tiers) <= cfg.max_seq_len
+    assert eng.prefill_tiers == (32, 64)
+
+
+def test_sourced_id_collision_rejected(model_and_params):
+    """A sourced request reusing an id already handed out by submit()
+    must be rejected, not silently clobber that request's results."""
+    cfg, _, params = model_and_params
+    eng = ServingEngine(cfg, params, serve=ServeConfig(
+        block_size=8, num_blocks=0, decode_tiers=(1, 2)))
+    rid = eng.submit(np.ones((4,), np.int32), max_new_tokens=2)
+    eng.attach_source(iter(
+        [Request(id=rid, prompt=np.ones((4,), np.int32),
+                 max_new_tokens=2)]))
+    with pytest.raises(ValueError, match="already in use"):
+        eng.run()
+
+
+# -- bounded compiled-program set under randomized load ----------------------
+
+
+def test_program_count_bounded_under_randomized_load(model_and_params):
+    """512 randomized requests; the tier menu bounds the compiled set
+    and the PR-1 executable-cache counters prove steady state is all
+    hits: warmup compiles the menu, traffic adds ZERO misses."""
+    cfg, model, params = model_and_params
+    eng = ServingEngine(cfg, params, serve=ServeConfig(
+        block_size=8, num_blocks=0, token_budget=256, watermark=2,
+        decode_tiers=(1, 2, 4, 8)))
+    menu = (len(eng.prefill_tiers) + 1) * len(eng.decode_tiers)
+    warmed = eng.warmup()
+    assert warmed == menu == eng.program_count
+    hits0 = _instr.EXEC_CACHE.labels("hit").get()
+    miss0 = _instr.EXEC_CACHE.labels("miss").get()
+    rs = np.random.RandomState(4)
+    for p in _prompts(rs, 512, lo=3, hi=41):
+        eng.submit(p, max_new_tokens=int(rs.randint(1, 7)))
+    out = eng.run()
+    assert len(out) == 512 and all(len(v) >= 1 for v in out.values())
+    assert eng.program_count == menu, (
+        f"{eng.program_count} programs compiled; menu bounds it to {menu}")
+    assert _instr.EXEC_CACHE.labels("miss").get() == miss0
+    assert _instr.EXEC_CACHE.labels("hit").get() > hits0
+    # spot-check the oracle still holds at this scale
+    for rid in (0, 99, 511):
+        prompt = None
+        rs2 = np.random.RandomState(4)
+        for i, p in enumerate(_prompts(rs2, 512, lo=3, hi=41)):
+            n = int(rs2.randint(1, 7))
+            if i == rid:
+                prompt, gen = p, n
+        np.testing.assert_array_equal(
+            out[rid], ref_decode(model, params, prompt, gen))
+
+
+# -- allocator / kv-model units ---------------------------------------------
+
+
+def test_block_allocator_contract():
+    a = BlockAllocator(8, block_size=4)
+    assert a.capacity == 7 and a.free_blocks == 7
+    got = a.alloc(3)
+    assert len(got) == 3 and 0 not in got, "block 0 is the trash block"
+    assert a.alloc(5) is None, "all-or-nothing"
+    assert a.free_blocks == 4
+    assert a.occupancy() == pytest.approx(3 / 7)
+    assert a.peak_occupancy == pytest.approx(3 / 7)
+    a.free(got)
+    assert a.free_blocks == 7 and a.occupancy() == 0.0
+    assert a.peak_occupancy == pytest.approx(3 / 7), "peak is sticky"
+    with pytest.raises(ValueError, match="double free"):
+        a.free([a.alloc(1)[0]] * 2)
+    with pytest.raises(ValueError, match="out of range"):
+        a.free([0])
+    with pytest.raises(ValueError, match=">= 2"):
+        BlockAllocator(1)
+    assert blocks_for(9, 4) == 3 and blocks_for(8, 4) == 2
+
+
+def test_modeled_decode_read_bytes_reductions():
+    """The serve_bench kv_model column: paging (vs max-seq reservation),
+    GQA (vs MHA) and windowing each cut modeled decode reads."""
+    kw = dict(block_size=16, num_heads=8, num_kv_heads=2, head_dim=64,
+              num_layers=4, dtype_bytes=2, max_seq_len=2048)
+    m = modeled_decode_read_bytes(256, **kw)
+    # 256 of 2048 tokens resident, GQA 4x: >= 16x kernel-read reduction
+    assert m["full_bytes"] >= 16 * m["paged_bytes"]
+    assert m["pages_read"] == 16
+    # the window=None gather copy is max_blocks wide (static shapes):
+    # only the GQA factor survives in the gather term
+    assert m["pages_gathered"] == 2048 // 16
+    assert m["full_bytes"] == 4 * m["gathered_bytes"]
+    w = modeled_decode_read_bytes(1024, window=128, **kw)
+    nw = modeled_decode_read_bytes(1024, **kw)
+    assert w["paged_bytes"] < nw["paged_bytes"] / 4, "window caps reads"
+    assert w["pages_read"] <= 128 // 16 + 2
+    assert w["pages_gathered"] <= 128 // 16 + 2, "window truncates gather"
+
+
+def test_pool_watermark_defers_admission(model_and_params):
+    """With a deep queue and a watermark, admission stops before the
+    pool drains: running sequences keep headroom to grow."""
+    cfg, _, params = model_and_params
+    eng = ServingEngine(cfg, params, serve=ServeConfig(
+        block_size=8, num_blocks=17, token_budget=256, watermark=6,
+        decode_tiers=(1, 2, 4, 8)))
+    for _ in range(8):
+        eng.submit(np.ones((8,), np.int32), max_new_tokens=2)
+    admitted = eng.scheduler.admit()
+    # each sequence needs 2 blocks (8+1 tokens @ block 8); 16 free,
+    # watermark 6 -> at most 5 admitted (16 - 5*2 = 6)
+    assert 0 < len(admitted) <= 5
+    assert eng.allocator.free_blocks >= 6
