@@ -215,11 +215,40 @@ SERVE_EVICTIONS = counter(
     "Sequences evicted from the decode batch to reclaim KV blocks",
 )
 
-#: Engine steps by kind (prefill/decode) — the interleave ratio.
+#: Engine steps by kind (mixed = chunked prefill riding the decode
+#: batch / decode-only) — the interleave ratio.
 SERVE_STEPS = counter(
     "hvd_tpu_serve_steps_total",
     "Serving engine steps executed, by kind",
     ["kind"],
+)
+
+#: Prompt blocks served straight from the prefix cache at admission
+#: (refcount bump, zero prefill compute for the span).
+SERVE_PREFIX_HITS = counter(
+    "hvd_tpu_serve_prefix_hits_total",
+    "Prompt KV blocks mapped from the prefix cache at admission",
+)
+
+#: Full prompt blocks that had to be prefilled because no cached
+#: prefix covered them; hits/(hits+misses) is the prefix hit rate.
+SERVE_PREFIX_MISSES = counter(
+    "hvd_tpu_serve_prefix_misses_total",
+    "Full prompt KV blocks prefilled for lack of a cached prefix",
+)
+
+#: Prefill chunks packed into mixed steps (Sarathi-style chunked
+#: prefill — each chunk rides a decode step instead of stalling it).
+SERVE_PREFILL_CHUNKS = counter(
+    "hvd_tpu_serve_prefill_chunks_total",
+    "Prefill chunks executed inside mixed prefill+decode steps",
+)
+
+#: Fraction of allocatable KV blocks holding prefix-cache content
+#: (referenced by live sequences or parked on the reclaim LRU).
+SERVE_KV_CACHED = gauge(
+    "hvd_tpu_serve_kv_cached_blocks_ratio",
+    "Fraction of the KV block pool holding prefix-cache content",
 )
 
 #: Request lifecycle events (submitted/completed).

@@ -261,20 +261,33 @@ class Attention(nn.Module):
         k = rope(k, positions)
         if paged is not None:
             # serving path (docs/SERVING.md): K/V live in the paged
-            # cache's block pools, not in this activation.  Prefill
-            # writes the whole prompt's K/V through the block table and
-            # attends within itself (sequences start at position 0, so
-            # plain causal attention is exact at any padding); decode
-            # writes the one new token then attends the GATHERED pages
-            # with the per-sequence decode kernel.
+            # cache's block pools, not in this activation.  Chunk (the
+            # mixed chunked-prefill + decode step — whole-prompt
+            # prefill is its offset-0 case) writes each row's chunk at
+            # its own offset then attends the GATHERED pages — cached
+            # prefix included — with per-row global offsets; decode
+            # writes the one new token then attends the gathered pages
+            # with the q_len=1 kernel.
             if cfg.attention_impl not in ("dot", "flash"):
                 raise ValueError(
                     f"paged serving supports attention_impl 'dot'/'flash', "
                     f"not {cfg.attention_impl!r}")
             if not cfg.causal:
                 raise ValueError("paged serving requires causal=True")
-            if paged.mode == "prefill":
-                paged.write_prefill(layer, k, v)
+            if paged.mode == "chunk":
+                from ..ops.flash_attention import flash_chunk_attention
+
+                paged.write_chunk(layer, k, v)
+                gk, gv, kv_start = paged.gather(
+                    layer, window=cfg.window, q_span=k.shape[1])
+                out = flash_chunk_attention(
+                    q, gk, gv, paged.lens, window=cfg.window,
+                    kv_start=kv_start,
+                )
+                return nn.DenseGeneral(
+                    features=cfg.d_model, axis=(-2, -1), dtype=cfg.dtype,
+                    use_bias=False, name="o",
+                )(out)
             else:
                 from ..ops.flash_attention import flash_decode_attention
 

@@ -544,7 +544,7 @@ def flash_block_forward(q, k, v, causal, block_q=256, block_k=256,
     return out, lse
 
 
-# -- q_len=1 decode entry (the paged-KV-cache serving path) ------------------
+# -- per-row-offset serving entries (the paged-KV-cache path) ----------------
 #
 # Autoregressive decode is one query row attending a long cached K/V
 # stream — exactly the forward kernel at block_q rows with a PER-SEQUENCE
@@ -556,34 +556,41 @@ def flash_block_forward(q, k, v, causal, block_q=256, block_k=256,
 # the sequence doesn't own — the block-granular read reduction the paged
 # cache (serving/kv_cache.py) is built on.  GQA grouping and sliding-
 # window truncation compose exactly as in the training kernels.
+#
+# A chunked-prefill row is the SAME program shape with q_len > 1: row
+# i's queries sit at global positions q_starts[i] .. q_starts[i]+C-1,
+# so a prefill chunk at offset k is just another batch row of the mixed
+# step (Sarathi-Serve's insight, docs/SERVING.md) — decode rows are
+# chunks of length 1 and flash_decode_attention delegates here.
 
 
-def flash_decode_attention(q, k, v, kv_lens, *, window=None, kv_start=None,
-                           block_q=8, block_k=128, interpret=None):
-    """Single-token decode attention over gathered KV-cache pages.
+def flash_chunk_attention(q, k, v, q_starts, *, window=None, kv_start=None,
+                          block_q=32, block_k=128, interpret=None):
+    """Per-row-offset attention over gathered KV-cache pages: the mixed
+    chunked-prefill + decode step's kernel.
 
-    q: (B, 1, H, D) — the new token's query, one row per sequence.
+    q: (B, C, H, D) — row i's C queries sit at global positions
+    ``q_starts[i] + 0 .. q_starts[i] + C - 1`` (C is the padded chunk
+    tier; columns beyond a row's true chunk are pad whose outputs the
+    engine discards).
     k, v: (B, S_kv, H_kv, D) with ``H_kv | H`` (GQA) — each sequence's
-    cache pages gathered contiguous (serving's block-table gather); rows
-    at or beyond the sequence's length may hold arbitrary garbage, the
-    mask never reads them.
-    kv_lens: (B,) int32 — keys the query may attend, PER SEQUENCE: the
-    query sits at global position ``kv_lens - 1`` and attends keys
-    ``0..kv_lens-1`` (itself included, i.e. its own K/V must already be
-    present in ``k``/``v``).
+    cache pages gathered contiguous (serving's block-table gather),
+    INCLUDING this chunk's own just-written K/V; rows beyond a
+    sequence's written length may hold arbitrary garbage, the causal
+    mask never attends them from a real query row.
+    q_starts: (B,) int32 — each row's first query's global position
+    (= tokens already in the cache before this chunk).
     kv_start: optional (B,) int32 global position of ``k[:, 0]`` (0 when
     the gather starts at the sequence head; the windowed gather passes
     the trailing-page start so masks stay global).
-    window: Mistral-style sliding window — the query attends the last
-    ``window`` positions only, and _kb_range SKIPS pages wholly before
-    the window, so per-step reads are O(window), not O(context).
+    window: sliding window, composing exactly as in decode — per-step
+    reads stay O(window + C), not O(context).
 
-    Output: (B, 1, H, D) in q's dtype.  Rows with ``kv_lens <= 0`` (pad
-    slots of a partially filled decode batch) come back all-zero.
+    Output: (B, C, H, D) in q's dtype.  Causality INSIDE the chunk is
+    the same global causal term (query j attends keys ≤ its own global
+    position), so no separate intra-chunk mask exists to drift.
     """
-    b, s_q, h, d = q.shape
-    if s_q != 1:
-        raise ValueError(f"decode expects q_len=1, got {s_q}")
+    b, c, h, d = q.shape
     if k.shape != v.shape:
         raise ValueError(f"k/v shapes differ: {k.shape} vs {v.shape}")
     group = _group_of(q, k)
@@ -591,20 +598,22 @@ def flash_decode_attention(q, k, v, kv_lens, *, window=None, kv_start=None,
     s_k = k.shape[1]
     if window is not None and window < 1:
         raise ValueError(f"window must be >= 1, got {window}")
-    kv_lens = jnp.asarray(kv_lens, jnp.int32).reshape(b)
+    q_starts = jnp.asarray(q_starts, jnp.int32).reshape(b)
     if kv_start is None:
         starts = jnp.zeros((b,), jnp.int32)
     else:
         starts = jnp.asarray(kv_start, jnp.int32).reshape(b)
-    # global K start − global Q start, per sequence (the query's global
-    # position is kv_lens − 1): the causal term rel >= 0 then reads
-    # k_global <= kv_lens − 1 — the per-sequence length mask.
-    offs = starts - (kv_lens - 1)
+    # global K start − global Q start, per sequence: the causal term
+    # rel >= 0 then reads k_global <= q_global — the per-row length
+    # mask (a real query's global position is < its row's written end).
+    offs = starts - q_starts
     block_k = min(block_k, s_k + (-s_k) % 128)
     kp = _pad_to(k, block_k, axis=1)
     vp = _pad_to(v, block_k, axis=1)
     s_k_pad = kp.shape[1]
-    qp = _pad_to(q, block_q, axis=1)  # 1 real row + block_q-1 pad rows
+    block_q = min(block_q, c + (-c) % 8)  # tiny chunks: one 8-row tile
+    qp = _pad_to(q, block_q, axis=1)
+    s_q_pad = qp.shape[1]
     qf = _fold(qp, b, h, d)
     kf = _fold(kp, b, h_kv, d)
     vf = _fold(vp, b, h_kv, d)
@@ -613,7 +622,7 @@ def flash_decode_attention(q, k, v, kv_lens, *, window=None, kv_start=None,
     kernel = functools.partial(
         _fwd_kernel,
         sm_scale=1.0 / (d ** 0.5),
-        causal=True,  # the per-sequence length mask IS the causal term
+        causal=True,  # the per-row global length mask IS the causal term
         block_q=block_q,
         block_k=block_k,
         seq_len=s_k,
@@ -622,7 +631,7 @@ def flash_decode_attention(q, k, v, kv_lens, *, window=None, kv_start=None,
     )
     out, _ = pl.pallas_call(
         kernel,
-        grid=(b * h, 1),
+        grid=(b * h, s_q_pad // block_q),
         in_specs=[
             _off_spec(b),
             pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
@@ -636,12 +645,35 @@ def flash_decode_attention(q, k, v, kv_lens, *, window=None, kv_start=None,
             pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, block_q, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, block_q, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, s_q_pad, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, s_q_pad, 1), jnp.float32),
         ],
         interpret=interpret,
     )(offs, qf, kf, vf)
-    return _unfold(out, b, h, block_q, d)[:, :1]
+    return _unfold(out, b, h, s_q_pad, d)[:, :c]
+
+
+def flash_decode_attention(q, k, v, kv_lens, *, window=None, kv_start=None,
+                           block_q=8, block_k=128, interpret=None):
+    """Single-token decode attention over gathered KV-cache pages — the
+    q_len=1 case of :func:`flash_chunk_attention` (one query row per
+    sequence, sitting at global position ``kv_lens - 1``).
+
+    kv_lens: (B,) int32 — keys the query may attend, PER SEQUENCE: the
+    query sits at global position ``kv_lens - 1`` and attends keys
+    ``0..kv_lens-1`` (itself included, i.e. its own K/V must already be
+    present in ``k``/``v``).
+
+    Output: (B, 1, H, D) in q's dtype.  Rows with ``kv_lens <= 0`` (pad
+    slots of a partially filled decode batch) come back all-zero.
+    """
+    b, s_q = q.shape[0], q.shape[1]
+    if s_q != 1:
+        raise ValueError(f"decode expects q_len=1, got {s_q}")
+    kv_lens = jnp.asarray(kv_lens, jnp.int32).reshape(b)
+    return flash_chunk_attention(
+        q, k, v, kv_lens - 1, window=window, kv_start=kv_start,
+        block_q=block_q, block_k=block_k, interpret=interpret)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))

@@ -3,16 +3,27 @@
 Ties the pieces together (docs/SERVING.md):
 
 * the **paged KV cache** (`kv_cache.py`) holds every running sequence's
-  K/V in fixed-size device blocks;
+  K/V in fixed-size device blocks, with a refcounted **prefix cache**:
+  prompts sharing a block-aligned prefix with anything previously
+  served map the cached blocks straight into their tables and prefill
+  only the tail;
 * the **scheduler** (`scheduler.py`) re-decides the batch every
-  iteration — admit against the token budget and block watermark,
-  LIFO-evict (recompute) when the pool runs dry;
-* **prefill and decode steps** are two jitted programs over *padding
-  tiers*: every step's shapes are padded up to a tier from a small
-  static menu, so a lifetime of arbitrary request shapes compiles a
-  BOUNDED set of programs (the same executable-cache discipline as the
-  ops engine's ``max_signatures``; hits/misses are mirrored into the
-  PR-1 ``hvd_tpu_executable_cache_total`` counters so the bound is
+  iteration — prefix-match + admit against the token budget and block
+  watermark, LIFO-evict (recompute) when the pool runs dry;
+* **mixed and decode steps** are two jitted program families over
+  *padding tiers*: a MIXED step packs the running decode batch plus
+  prefill chunks (Sarathi-style chunked prefill — a chunk at offset k
+  is just another batch row of the per-row-offset kernel, so a long
+  prompt streams in without stalling decodes) and is keyed by (batch
+  tier, chunk tier); a DECODE step is keyed by (batch tier, PAGE tier)
+  — the unwindowed gather copy is bounded by the batch's live
+  max-context page tier instead of ``max_blocks``.  Every step's
+  shapes pad up to a tier from a small static menu, so a lifetime of
+  arbitrary request shapes compiles a BOUNDED set of programs —
+  ``|decode_tiers| × (|chunk_tiers| + |page_tiers|)`` — (the same
+  executable-cache discipline as the ops engine's ``max_signatures``;
+  hits/misses are mirrored into the PR-1
+  ``hvd_tpu_executable_cache_total`` counters so the bound is
   observable);
 * the **staging queue** (`data.prefetch.DevicePrefetcher` in its
   restartable role) device-stages tokenized prompts while the current
@@ -56,7 +67,7 @@ _CACHE_HIT = _instr.EXEC_CACHE.labels("hit")
 _CACHE_MISS = _instr.EXEC_CACHE.labels("miss")
 _LAT_FIRST = _instr.SERVE_TOKEN_LATENCY.labels("first")
 _LAT_INTER = _instr.SERVE_TOKEN_LATENCY.labels("inter")
-_STEP_PREFILL = _instr.SERVE_STEPS.labels("prefill")
+_STEP_MIXED = _instr.SERVE_STEPS.labels("mixed")
 _STEP_DECODE = _instr.SERVE_STEPS.labels("decode")
 _REQ_SUBMITTED = _instr.SERVE_REQUESTS.labels("submitted")
 _REQ_COMPLETED = _instr.SERVE_REQUESTS.labels("completed")
@@ -103,7 +114,15 @@ class ServeConfig:
     ``prefill_tiers`` / ``decode_tiers`` are the padding menus: prompt
     lengths pad up to a prefill tier, batch sizes to a decode tier, so
     the compiled-program count is bounded by the product of the menus,
-    not by the request distribution."""
+    not by the request distribution.
+
+    ``prefill_chunk`` > 0 bounds per-step prefill work: prompt tails
+    stream in as chunks of at most this many tokens, each packed into
+    a mixed step alongside the running decode batch, so decode p99
+    stays flat under prompt bursts (0 = a tail prefills in one chunk).
+    ``prefix_cache`` toggles prompt prefix caching (docs/SERVING.md);
+    greedy outputs are bit-identical either way — the cache moves
+    compute, never values."""
 
     block_size: int = 16
     num_blocks: int = 0  # 0 = auto: full residency for the largest batch
@@ -111,6 +130,8 @@ class ServeConfig:
     watermark: int = 4
     prefill_tiers: Tuple[int, ...] = ()
     decode_tiers: Tuple[int, ...] = (1, 2, 4, 8)
+    prefill_chunk: int = 0
+    prefix_cache: bool = True
 
     @classmethod
     def from_env(cls, **overrides) -> "ServeConfig":
@@ -134,6 +155,12 @@ class ServeConfig:
         if "decode_tiers" not in overrides:
             fields["decode_tiers"] = _env_tiers(
                 _DECODE_TIERS_ENV, base.decode_tiers)
+        if "prefill_chunk" not in overrides:
+            fields["prefill_chunk"] = env_int("HVD_TPU_SERVE_PREFILL_CHUNK",
+                                              base.prefill_chunk)
+        if "prefix_cache" not in overrides:
+            fields["prefix_cache"] = bool(env_int(
+                "HVD_TPU_SERVE_PREFIX_CACHE", int(base.prefix_cache)))
         return cls(**fields)
 
 
@@ -190,6 +217,24 @@ class ServingEngine:
             prefill_tiers = prefill_tiers + (cfg.max_seq_len,)
         self.prefill_tiers = prefill_tiers
         self.decode_tiers = serve.decode_tiers
+        # chunk-width menu for the mixed step's q axis: the prefill
+        # tiers capped at prefill_chunk (chunks never exceed the cap,
+        # so larger tiers would never be exercised — and the cap itself
+        # is a tier so a maximal chunk pads to exactly the cap)
+        if serve.prefill_chunk > 0:
+            cap = min(serve.prefill_chunk, cfg.max_seq_len)
+            self.chunk_tiers = tuple(
+                t for t in prefill_tiers if t < cap) + (cap,)
+        else:
+            self.chunk_tiers = prefill_tiers
+        # page-tier menu for the unwindowed decode gather: the copy is
+        # bounded by the batch's live max-context page tier instead of
+        # max_blocks (windowed configs already truncate the gather to a
+        # single static width, so the menu collapses to one entry)
+        if cfg.window is None:
+            self.page_tiers = _pow2_tiers(1, self.max_blocks_per_seq)
+        else:
+            self.page_tiers = (self.max_blocks_per_seq,)
         kv_heads = cfg.num_kv_heads or cfg.num_heads
         self.k_pool, self.v_pool = make_pools(
             cfg.num_layers, num_blocks, bs, kv_heads, cfg.head_dim,
@@ -197,7 +242,8 @@ class ServingEngine:
         self.pool_bytes = pool_bytes(
             cfg.num_layers, num_blocks, bs, kv_heads, cfg.head_dim,
             cfg.dtype)
-        self.allocator = BlockAllocator(num_blocks, bs)
+        self.allocator = BlockAllocator(
+            num_blocks, bs, prefix_cache=serve.prefix_cache)
         self.scheduler = ContinuousBatchingScheduler(
             self.allocator, token_budget=serve.token_budget,
             watermark=serve.watermark, max_decode_batch=max_batch,
@@ -215,28 +261,39 @@ class ServingEngine:
         self._staging: Optional[DevicePrefetcher] = None
         self._staging_meta: collections.deque = collections.deque()
         self._source_done = True
-        self._prefill_fn = jax.jit(self._prefill_step)
-        self._decode_fn = jax.jit(self._decode_step)
+        #: chunk tokens actually computed by prefill (prefix-cache hits
+        #: and pad columns excluded) — the bench's
+        #: ``prefill_tokens_computed`` column
+        self.prefill_tokens_computed = 0
+        self._mixed_fn = jax.jit(self._mixed_step)
+        self._decode_fn = jax.jit(self._decode_step,
+                                  static_argnames=("pages",))
 
-    # -- the two tiered programs --------------------------------------------
+    # -- the two tiered program families ------------------------------------
 
-    def _prefill_step(self, params, k, v, tables, lens, tokens):
-        b, p = tokens.shape
+    def _mixed_step(self, params, k, v, tables, lens, chunk_lens, tokens):
+        """One mixed chunked-prefill + decode step: row i writes and
+        attends ``chunk_lens[i]`` new tokens at global offset
+        ``lens[i]`` — decode rows are chunks of length 1, prefill
+        chunks of any tail fill the rest of the batch.  Emits each
+        row's next token from its LAST valid position (meaningful for
+        decode rows and for chunks that complete their prompt; the
+        host discards the rest)."""
+        b, c = tokens.shape
         state = PagedKVState(k=k, v=v, tables=tables, lens=lens,
-                             mode="prefill")
-        positions = jnp.broadcast_to(
-            jnp.arange(p, dtype=jnp.int32)[None], (b, p))
+                             mode="chunk", chunk_lens=chunk_lens)
+        positions = lens[:, None] + jnp.arange(c, dtype=jnp.int32)[None]
         logits, state = self._model.apply(
             {"params": params}, tokens, positions=positions, train=False,
             paged=state)
-        last = jnp.clip(lens - 1, 0, p - 1)
+        last = jnp.clip(chunk_lens - 1, 0, c - 1)
         next_tok = jnp.argmax(
             logits[jnp.arange(b), last].astype(jnp.float32), axis=-1)
         return next_tok.astype(jnp.int32), state.k, state.v
 
-    def _decode_step(self, params, k, v, tables, lens, last_tok):
+    def _decode_step(self, params, k, v, tables, lens, last_tok, pages):
         state = PagedKVState(k=k, v=v, tables=tables, lens=lens,
-                             mode="decode")
+                             mode="decode", gather_pages=pages)
         logits, state = self._model.apply(
             {"params": params}, last_tok[:, None], positions=lens[:, None],
             train=False, paged=state)
@@ -261,12 +318,14 @@ class ServingEngine:
 
     def warmup(self) -> int:
         """Compile the WHOLE tier menu up front — every (batch tier,
-        prefill tier) prefill program and every decode-tier program.
-        The menu is what makes this possible (and cheap to reason
-        about): the compiled set is bounded by the tier product, so a
-        production engine pre-warms it and serves its lifetime without
-        a single mid-traffic XLA compile (a straggler compile is a
-        multi-second p99 spike — measured in tools/serve_bench.py).
+        chunk tier) mixed program and every (batch tier, page tier)
+        decode program: ``|decode_tiers| × (|chunk_tiers| +
+        |page_tiers|)``.  The menu is what makes this possible (and
+        cheap to reason about): the compiled set is bounded by the tier
+        product, so a production engine pre-warms it and serves its
+        lifetime without a single mid-traffic XLA compile (a straggler
+        compile is a multi-second p99 spike — measured in
+        tools/serve_bench.py).
 
         Side-effect-free by construction: the dummy steps run with
         all-zero block tables, so every write lands in the trash block
@@ -277,13 +336,17 @@ class ServingEngine:
         for bt in self.decode_tiers:
             tb = jnp.broadcast_to(tables, (bt, self.max_blocks_per_seq))
             lens = jnp.ones((bt,), jnp.int32)
-            for p in self.prefill_tiers:
-                self._book_program("prefill", bt, p)
-                self._prefill_fn(self.params, self.k_pool, self.v_pool,
-                                 tb, lens, jnp.zeros((bt, p), jnp.int32))
-            self._book_program("decode", bt)
-            self._decode_fn(self.params, self.k_pool, self.v_pool, tb,
-                            lens, jnp.zeros((bt,), jnp.int32))
+            for c in self.chunk_tiers:
+                self._book_program("mixed", bt, c)
+                self._mixed_fn(self.params, self.k_pool, self.v_pool,
+                               tb, jnp.zeros((bt,), jnp.int32),
+                               jnp.ones((bt,), jnp.int32),
+                               jnp.zeros((bt, c), jnp.int32))
+            for pt in self.page_tiers:
+                self._book_program("decode", bt, pt)
+                self._decode_fn(self.params, self.k_pool, self.v_pool,
+                                tb, lens, jnp.zeros((bt,), jnp.int32),
+                                pages=pt)
         return len(self._progs) - before
 
     # -- request intake ------------------------------------------------------
@@ -383,23 +446,6 @@ class ServingEngine:
     def _batch_tier(self, n: int) -> int:
         return _tier_for(self.decode_tiers, n)
 
-    def _prefill_batch(self, batch: List[Sequence]):
-        p = max(_tier_for(self.prefill_tiers, len(s.context))
-                for s in batch)
-        bt = self._batch_tier(len(batch))
-        rows = []
-        for s in batch:
-            row = s.staged
-            if row is None:  # evicted/requeued or submitted directly
-                host = np.zeros((p,), np.int32)
-                host[:len(s.context)] = s.context
-                row = jnp.asarray(host)
-            elif row.shape[0] < p:  # device-side pad up to the batch tier
-                row = jnp.pad(row, (0, p - row.shape[0]))
-            rows.append(row)
-        rows.extend([jnp.zeros((p,), jnp.int32)] * (bt - len(batch)))
-        return jnp.stack(rows), p, bt
-
     def _tables_lens(self, seqs: List[Sequence], bt: int, lens: List[int]):
         tables = np.zeros((bt, self.max_blocks_per_seq), np.int32)
         for i, s in enumerate(seqs):
@@ -408,32 +454,108 @@ class ServingEngine:
         lens_arr[:len(seqs)] = lens
         return jnp.asarray(tables), jnp.asarray(lens_arr)
 
-    def _prefill_once(self, seqs: List[Sequence]):
-        """One prefill step over ``seqs`` (ONE assembly for both the
-        engine loop and the static baseline — the A/B must execute
-        identical step programs)."""
-        tokens, p, bt = self._prefill_batch(seqs)
+    def _chunk_row(self, s: Sequence, c: int, width: int):
+        """One prefill chunk's tokens — ``context[prefilled:prefilled+c]``
+        — padded to the chunk tier ``width``.  The device-staged row is
+        used ONLY when it IS the chunk (whole prompt at exactly the
+        step's tier): any device-side slice/pad here would compile one
+        tiny XLA program per distinct chunk length — an unbounded
+        program set through the back door, measured as 60–150 ms
+        first-use spikes.  Sliced chunks assemble from the host-side
+        context instead (prompt tokens are KBs; the K/V is what's big).
+        """
+        row = s.staged
+        if row is not None and s.prefilled == 0 and \
+                c == len(s.context) and row.shape[0] == width:
+            return row
+        host = np.zeros((width,), np.int32)
+        host[:c] = s.context[s.prefilled:s.prefilled + c]
+        return host
+
+    def _select_chunks(self, prefill_rows: List[Sequence], slots: int):
+        """Chunk work for one mixed step: FIFO over sequences still
+        prefilling.  Each chunk is capped by ``prefill_chunk`` (the
+        Sarathi-style bound on per-step prefill work — what keeps
+        decode latency flat under prompt bursts); the token budget
+        caps how many chunks PACK into one step but never splits a
+        chunk below the cap (with ``prefill_chunk=0`` this reproduces
+        the pre-chunking whole-prompt prefill step exactly, budget
+        gating the batch sum with a first-chunk bypass as admission
+        always did).  Returns [(seq, chunk_len)]."""
+        cap = self.serve_cfg.prefill_chunk or max(self.chunk_tiers)
+        left = self.scheduler.token_budget
+        sel: List[Tuple[Sequence, int]] = []
+        for s in prefill_rows:
+            if len(sel) >= slots:
+                break
+            rem = len(s.context) - s.prefilled
+            c = min(rem, cap)
+            if sel and c > left:
+                break
+            sel.append((s, c))
+            left -= c
+        return sel
+
+    def _run_mixed(self, decode_rows: List[Sequence], chunk_sel):
+        """Execute ONE mixed step over ``decode_rows`` (one token each)
+        plus ``chunk_sel`` ([(seq, chunk_len)]) — the single program
+        both the engine loop and the static baseline assemble through
+        (the A/B must execute identical step programs).  Row order:
+        decode rows first, chunk rows after."""
+        n = len(decode_rows) + len(chunk_sel)
+        bt = self._batch_tier(n)
+        width = _tier_for(
+            self.chunk_tiers, max([c for _, c in chunk_sel], default=1))
+        rows = []
+        lens_list = []
+        chunk_lens = np.zeros((bt,), np.int32)
+        for i, s in enumerate(decode_rows):
+            host = np.zeros((width,), np.int32)
+            host[0] = s.generated[-1]
+            rows.append(host)
+            lens_list.append(s.length - 1)
+            chunk_lens[i] = 1
+        for j, (s, c) in enumerate(chunk_sel):
+            rows.append(self._chunk_row(s, c, width))
+            lens_list.append(s.prefilled)
+            chunk_lens[len(decode_rows) + j] = c
+        rows.extend([np.zeros((width,), np.int32)] * (bt - n))
+        if all(isinstance(r, np.ndarray) for r in rows):
+            tokens = jnp.asarray(np.stack(rows))  # one host put
+        else:  # device-staged fast-path rows in the mix
+            tokens = jnp.stack([jnp.asarray(r) for r in rows])
         tables, lens = self._tables_lens(
-            seqs, bt, [len(s.context) for s in seqs])
-        self._book_program("prefill", bt, p)
-        next_tok, self.k_pool, self.v_pool = self._prefill_fn(
-            self.params, self.k_pool, self.v_pool, tables, lens, tokens)
-        _STEP_PREFILL.inc()
+            decode_rows + [s for s, _ in chunk_sel], bt, lens_list)
+        self._book_program("mixed", bt, width)
+        next_tok, self.k_pool, self.v_pool = self._mixed_fn(
+            self.params, self.k_pool, self.v_pool, tables, lens,
+            jnp.asarray(chunk_lens), tokens)
+        _STEP_MIXED.inc()
+        _instr.SERVE_PREFILL_CHUNKS.inc(len(chunk_sel))
+        self.prefill_tokens_computed += sum(c for _, c in chunk_sel)
         return np.asarray(next_tok), self._clock()
 
     def _decode_once(self, seqs: List[Sequence]):
         """One decode step over ``seqs`` — tokens in cache = length - 1
         (the newest generated token's K/V is written by THIS step, at
-        position length - 1)."""
+        position length - 1).  The unwindowed gather copy is bounded by
+        the batch's live max-context PAGE TIER (``pages``), not
+        ``max_blocks`` — the static-shape-per-tier form of the paging
+        savings on the copy."""
         bt = self._batch_tier(len(seqs))
         cache_lens = [s.length - 1 for s in seqs]
+        pages = self.max_blocks_per_seq
+        if self.cfg.window is None:
+            need = max(blocks_for(s.length, self.serve_cfg.block_size)
+                       for s in seqs)
+            pages = _tier_for(self.page_tiers, need)
         tables, lens = self._tables_lens(seqs, bt, cache_lens)
         last = np.zeros((bt,), np.int32)
         last[:len(seqs)] = [s.generated[-1] for s in seqs]
-        self._book_program("decode", bt)
+        self._book_program("decode", bt, pages)
         next_tok, self.k_pool, self.v_pool = self._decode_fn(
             self.params, self.k_pool, self.v_pool, tables, lens,
-            jnp.asarray(last))
+            jnp.asarray(last), pages=pages)
         _STEP_DECODE.inc()
         return np.asarray(next_tok), self._clock()
 
@@ -470,22 +592,56 @@ class ServingEngine:
     # -- the scheduler loop --------------------------------------------------
 
     def step(self) -> bool:
-        """One iteration: drain staging, then EITHER one prefill (when
-        admission succeeded) or one decode over the running batch.
-        Returns False when there is nothing left to do."""
+        """One iteration: drain staging, admit (prefix-matching), grow,
+        then run ONE program — a MIXED step whenever prefill work is
+        pending (chunks packed alongside the running decode batch, so a
+        streaming prompt never stalls decodes), a decode step
+        otherwise.  Returns False when there is nothing left to do."""
         idle = not self.scheduler.running and not self.scheduler.pending
         self._drain_staging(block=idle and not self._source_done)
-        batch = self.scheduler.admit()
-        if batch:
-            toks, now = self._prefill_once(batch)
-            for i, s in enumerate(batch):
-                self._emit(s, toks[i], now)
-            return True
+        self.scheduler.admit()
         self.scheduler.grow_running()
         running = list(self.scheduler.running)
-        if running:
-            toks, now = self._decode_once(running)
-            for i, s in enumerate(running):
+        decode_rows = [s for s in running if s.in_decode]
+        prefill_rows = [s for s in running if not s.in_decode]
+        if prefill_rows:
+            # decode rows ride the mixed step ONLY under chunked
+            # prefill: with the chunk tier bounded, a decode row's
+            # padded q-width stays small and the ride is what keeps its
+            # latency flat through a prompt burst.  Unchunked, the
+            # chunk width is the whole prompt tier — riding would charge
+            # every decode token the full prompt's q-work for no
+            # latency win over just waiting the step out, so the
+            # pre-chunking prefill-only step is kept verbatim.
+            if self.serve_cfg.prefill_chunk <= 0:
+                decode_rows = []
+            # >= 1 chunk slot is guaranteed: admission caps running at
+            # max_decode_batch, so with prefill_rows non-empty the
+            # decode rows can fill at most bt_max - 1 of the batch
+            bt_max = max(self.decode_tiers)
+            sel = self._select_chunks(
+                prefill_rows, bt_max - len(decode_rows))
+            toks, now = self._run_mixed(decode_rows, sel)
+            for s, c in sel:
+                s.prefilled += c
+            # publish BEFORE emission: _emit may finish a sequence and
+            # release its blocks — registering after release could
+            # index a block the free list is about to hand out
+            for s in running:
+                if s.blocks:
+                    self.scheduler.publish_full_blocks(s)
+            for i, s in enumerate(decode_rows):
+                self._emit(s, toks[i], now)
+            base = len(decode_rows)
+            for j, (s, _c) in enumerate(sel):
+                if s.in_decode:  # prompt complete -> its first token
+                    self._emit(s, toks[base + j], now)
+            return True
+        if decode_rows:
+            toks, now = self._decode_once(decode_rows)
+            for s in decode_rows:
+                self.scheduler.publish_full_blocks(s)
+            for i, s in enumerate(decode_rows):
                 self._emit(s, toks[i], now)
             return True
         return not self._source_done or bool(self.scheduler.pending)
@@ -526,9 +682,21 @@ class ServingEngine:
                         f"{len(chunk)} — the reservation waste paging "
                         "removes")
                 s.blocks = got
-            toks, now = self._prefill_once(seqs)
-            for i, s in enumerate(seqs):
-                self._static_emit(s, toks[i], now, results)
+            # whole prompts in as few steps as the chunk-tier cap
+            # allows, NO token-budget pacing and NO prefix publication/
+            # matching — the pre-Orca baseline neither paces nor caches
+            while True:
+                todo = [s for s in seqs if not s.in_decode]
+                if not todo:
+                    break
+                cap = self.serve_cfg.prefill_chunk or max(self.chunk_tiers)
+                sel = [(s, min(len(s.context) - s.prefilled, cap))
+                       for s in todo]
+                toks, now = self._run_mixed([], sel)
+                for j, (s, c) in enumerate(sel):
+                    s.prefilled += c
+                    if s.in_decode:
+                        self._static_emit(s, toks[j], now, results)
             while not all(s.done for s in seqs):
                 toks, now = self._decode_once(seqs)
                 for i, s in enumerate(seqs):

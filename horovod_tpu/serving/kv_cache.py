@@ -11,16 +11,26 @@ any moment.
 
 Two cooperating pieces:
 
-* :class:`BlockAllocator` — the host-side free list.  Block 0 is
-  reserved as the *trash block*: every padded/unused block-table slot
-  points at it, so scatter writes from padded positions land somewhere
-  harmless and gathers from padded slots read garbage that the decode
-  kernel's per-sequence causal mask never attends
+* :class:`BlockAllocator` — the host-side free list, now *refcounted*
+  with a **prefix cache**: a content-hash index over full, immutable
+  blocks (hash chained over token ids per block, vLLM's scheme).  A
+  block freed to refcount 0 while its content is cached parks on an
+  LRU instead of the free list; a later request whose prompt shares
+  the block-aligned prefix re-maps it with a refcount bump — zero
+  prefill compute, zero pool writes for the shared span.  The last,
+  partially-filled block of any sequence is never cached and never
+  shared, so it stays writable by its one owner: copy-on-write by
+  construction (writes only ever land at positions ≥ the sequence's
+  cached length, and full blocks are immutable).  Block 0 is reserved
+  as the *trash block*: every padded/unused block-table slot points at
+  it, so scatter writes from padded positions land somewhere harmless
+  and gathers from padded slots read garbage that the decode kernel's
+  per-sequence causal mask never attends
   (``ops.flash_attention.flash_decode_attention``).
 * :class:`PagedKVState` — the device-side pytree carried through the
-  jitted prefill/decode step: the pools, the step batch's block tables
+  jitted chunk/decode step: the pools, the step batch's block tables
   and lengths.  The transformer's attention layers call its
-  ``write_prefill`` / ``write_decode`` / ``gather`` from inside the
+  ``write_chunk`` / ``write_decode`` / ``gather`` from inside the
   traced step; the updated pools come back out through the step's
   return value (functional update, ``.at[].set``).
 
@@ -36,8 +46,9 @@ terms, and the columns ``tools/serve_bench.py`` emits.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
-from typing import List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -48,16 +59,45 @@ def blocks_for(length: int, block_size: int) -> int:
     return -(-int(length) // int(block_size))
 
 
+#: Root of every sequence's hash chain (the "parent" of block 0).
+PREFIX_HASH_ROOT = 0
+
+
+def chain_hash(parent_hash: int, tokens: Tuple[int, ...]) -> int:
+    """Content hash of one full block, chained over its prefix: the
+    hash covers (parent chain hash, this block's token ids), so equal
+    hashes along a chain imply equal *prefixes* block by block — the
+    vLLM prefix-caching scheme.  Process-local (python ``hash`` over
+    int tuples is deterministic within a process, which is all the
+    in-memory index needs); collisions are SAFE regardless because
+    every index hit is confirmed with a full token-id + parent compare
+    (tests monkeypatch this to a constant to prove it)."""
+    return hash((parent_hash, tokens))
+
+
 class BlockAllocator:
-    """Free-list allocator over the pool's block ids (host side).
+    """Refcounted allocator over the pool's block ids, with a prefix
+    cache (host side).
 
     Block 0 is never handed out — it is the shared trash block padded
     block-table slots point at (see module docstring).  Allocation is
     all-or-nothing: a partial grab would strand blocks the caller can't
     use (the scheduler admits against :meth:`free_blocks` first).
+
+    Every handed-out block carries a refcount; a shared prefix block is
+    mapped into several sequences' block tables at once and only
+    becomes reclaimable when the count hits 0.  A block whose *content*
+    is registered in the prefix index (:meth:`register`) is not freed
+    at refcount 0 — it parks on an LRU of cached-but-unreferenced
+    blocks, still matchable by :meth:`match_prefix`, and is reclaimed
+    (cache entry dropped) only when a fresh allocation drains the plain
+    free list: refcount-aware LRU eviction.  Eviction can never touch a
+    block with live references — the LRU only ever holds refcount-0
+    blocks.
     """
 
-    def __init__(self, num_blocks: int, block_size: int = 16):
+    def __init__(self, num_blocks: int, block_size: int = 16,
+                 prefix_cache: bool = True):
         if num_blocks < 2:
             raise ValueError(
                 f"need >= 2 blocks (one is the trash block), got {num_blocks}"
@@ -66,42 +106,175 @@ class BlockAllocator:
             raise ValueError(f"block_size must be >= 1, got {block_size}")
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
+        #: prefix caching on/off (off: register/match are no-ops and
+        #: refcount-0 blocks always return to the plain free list)
+        self.prefix_cache = bool(prefix_cache)
+        #: injectable for collision tests (see chain_hash)
+        self.hash_fn = chain_hash
+        self._ref: List[int] = [0] * self.num_blocks
         self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
+        #: cached blocks with refcount 0, oldest first (the evictables)
+        self._lru: "collections.OrderedDict[int, None]" = \
+            collections.OrderedDict()
+        #: chain hash -> block id, for every block with cached content
+        #: (referenced or parked — a hot shared prefix stays matchable)
+        self._index: Dict[int, int] = {}
+        #: block id -> (chain_hash, parent_hash, token ids) for the
+        #: full-compare on every index hit (collision safety)
+        self._meta: Dict[int, Tuple[int, int, Tuple[int, ...]]] = {}
         self.peak_occupancy = 0.0  # high-water mark (bench column)
 
     @property
     def free_blocks(self) -> int:
-        return len(self._free)
+        """Allocatable blocks: the plain free list plus the
+        cached-but-unreferenced LRU (reclaimable on demand)."""
+        return len(self._free) + len(self._lru)
 
     @property
     def capacity(self) -> int:
         """Allocatable blocks (pool size minus the trash block)."""
         return self.num_blocks - 1
 
+    @property
+    def cached_blocks(self) -> int:
+        """Blocks currently holding prefix-cache content (referenced
+        or parked on the LRU) — the occupancy gauge's numerator."""
+        return len(self._index)
+
+    def ref(self, block: int) -> int:
+        """Live reference count of ``block`` (0 = free or parked)."""
+        return self._ref[block]
+
     def occupancy(self) -> float:
         """Fraction of allocatable blocks currently owned by sequences."""
-        return 1.0 - len(self._free) / self.capacity
+        return 1.0 - self.free_blocks / self.capacity
+
+    def _drop_cache_entry(self, b: int) -> None:
+        h, _parent, _tokens = self._meta.pop(b)
+        if self._index.get(h) == b:
+            del self._index[h]
 
     def alloc(self, n: int) -> Optional[List[int]]:
-        """``n`` block ids, or None if the pool can't satisfy all of them."""
+        """``n`` fresh block ids at refcount 1, or None if the pool
+        can't satisfy all of them.  Drains the plain free list first,
+        then reclaims cached-but-unreferenced blocks in LRU order
+        (their cache entries are dropped — this is the eviction)."""
         if n < 0:
             raise ValueError(f"alloc({n})")
-        if n > len(self._free):
+        if n > self.free_blocks:
             return None
-        taken = self._free[-n:] if n else []
-        del self._free[len(self._free) - n:]
+        take = min(n, len(self._free))
+        taken = list(reversed(self._free[-take:])) if take else []
+        del self._free[len(self._free) - take:]
+        while len(taken) < n:
+            b, _ = self._lru.popitem(last=False)  # oldest cached first
+            self._drop_cache_entry(b)
+            taken.append(b)
+        for b in taken:
+            self._ref[b] = 1
         self.peak_occupancy = max(self.peak_occupancy, self.occupancy())
-        return list(reversed(taken))
+        return taken
 
     def free(self, blocks: Sequence[int]) -> None:
-        seen = set(self._free)
+        """Drop one reference per listed block.  At refcount 0 a block
+        returns to the free list — or, when its content is cached, parks
+        on the LRU tail, still matchable until reclaimed."""
         for b in blocks:
             if not 0 < b < self.num_blocks:
                 raise ValueError(f"block id {b} out of range")
-            if b in seen:
+            if self._ref[b] <= 0:
                 raise ValueError(f"double free of block {b}")
-            seen.add(b)
-        self._free.extend(blocks)
+            self._ref[b] -= 1
+            if self._ref[b] == 0:
+                if self.prefix_cache and b in self._meta:
+                    self._lru[b] = None  # most-recently-freed at the tail
+                else:
+                    if b in self._meta:  # cache disabled mid-flight
+                        self._drop_cache_entry(b)
+                    self._free.append(b)
+
+    # -- the prefix cache ----------------------------------------------------
+
+    def register(self, block: int, parent_hash: int,
+                 tokens: Sequence[int]) -> Optional[int]:
+        """Publish a FULL, immutable block's content into the prefix
+        index; returns its chain hash (or None when caching is off).
+        First registration of a hash wins — a second block with
+        identical content simply stays private (no device-side dedup:
+        re-pointing live block tables mid-sequence is not worth the
+        churn).  Only ever call this for blocks all ``block_size``
+        positions of which are written and will never be written again
+        (the CoW invariant: a cached block is immutable)."""
+        if not self.prefix_cache:
+            return None
+        if len(tokens) != self.block_size:
+            raise ValueError(
+                f"register() takes exactly one full block "
+                f"({self.block_size} tokens), got {len(tokens)}")
+        if self._ref[block] <= 0 and block not in self._meta:
+            # a block registered after release could be handed out by
+            # the free list while the index still points at it — the
+            # scheduler publishes BEFORE emission/release for this
+            # reason, and this guard turns the misuse into a loud error
+            raise ValueError(
+                f"register of unreferenced block {block} — publish "
+                f"full blocks before releasing the sequence")
+        toks = tuple(int(t) for t in tokens)
+        h = self.hash_fn(parent_hash, toks)
+        if h not in self._index:
+            self._index[h] = block
+            self._meta[block] = (h, parent_hash, toks)
+        return h
+
+    def match_prefix(self, tokens: Sequence[int],
+                     max_blocks: Optional[int] = None
+                     ) -> Tuple[List[int], List[int]]:
+        """Longest cached block-aligned prefix of ``tokens``: walks the
+        hash chain over full blocks, confirms every index hit with a
+        full token-id + parent compare (hash-collision safety), and
+        bumps the refcount of each matched block (un-parking it from
+        the LRU) — the caller now owns one reference and releases it
+        through :meth:`free` like any other block.  ``max_blocks`` caps
+        the match (the scheduler passes ``(len(prompt) - 1) //
+        block_size`` so at least one prompt token is always left to
+        compute — the prefill step must emit a first token).  Returns
+        (block ids, chain hashes), both possibly empty."""
+        if not self.prefix_cache:
+            return [], []
+        bs = self.block_size
+        n_full = len(tokens) // bs
+        if max_blocks is not None:
+            n_full = min(n_full, max_blocks)
+        blocks: List[int] = []
+        hashes: List[int] = []
+        parent = PREFIX_HASH_ROOT
+        for i in range(n_full):
+            toks = tuple(int(t) for t in tokens[i * bs:(i + 1) * bs])
+            h = self.hash_fn(parent, toks)
+            b = self._index.get(h)
+            if b is None:
+                break
+            _h, m_parent, m_tokens = self._meta[b]
+            if m_parent != parent or m_tokens != toks:
+                break  # hash collision — the full compare rejects it
+            if self._ref[b] == 0:
+                self._lru.pop(b, None)
+            self._ref[b] += 1
+            blocks.append(b)
+            hashes.append(h)
+            parent = h
+        self.peak_occupancy = max(self.peak_occupancy, self.occupancy())
+        return blocks, hashes
+
+    def clear_cache(self) -> None:
+        """Drop every prefix-cache entry (bench A/B legs): parked
+        blocks return to the plain free list; referenced blocks lose
+        their index entries and free normally when released."""
+        for b in list(self._lru):
+            self._free.append(b)
+        self._lru.clear()
+        for b in list(self._meta):
+            self._drop_cache_entry(b)
 
 
 @jax.tree_util.register_pytree_node_class
@@ -114,8 +287,19 @@ class PagedKVState:
     rows padded with 0 (the trash block).
     ``lens``: (B,) int32 — tokens already written for each sequence
     BEFORE this step's token(s); pad slots carry 0.
-    ``mode``: 'prefill' | 'decode' (static — selects the write/attend
-    shape inside the traced step).
+    ``mode``: 'decode' | 'chunk' (static — selects the write/attend
+    shape inside the traced step).  'chunk' is the mixed
+    prefill+decode step: each row writes/attends ``chunk_lens[i]`` new
+    tokens starting at its own global offset ``lens[i]`` — a decode row
+    is simply a chunk of length 1, a prefill chunk at offset k is just
+    another batch row, and whole-prompt prefill is the offset-0 case
+    (docs/SERVING.md).
+    ``chunk_lens``: (B,) int32, chunk mode only — valid new tokens per
+    row within the padded chunk width; pad rows carry 0.
+    ``gather_pages``: static page bound for the unwindowed
+    :meth:`gather` copy — the engine passes the batch's live
+    max-context *page tier* so the copy is O(live context), not
+    ``max_blocks``, while shapes stay static per tier.
     """
 
     k: jax.Array
@@ -123,14 +307,19 @@ class PagedKVState:
     tables: jax.Array
     lens: jax.Array
     mode: str = "decode"
+    chunk_lens: Optional[jax.Array] = None
+    gather_pages: Optional[int] = None
 
     def tree_flatten(self):
-        return (self.k, self.v, self.tables, self.lens), (self.mode,)
+        return ((self.k, self.v, self.tables, self.lens, self.chunk_lens),
+                (self.mode, self.gather_pages))
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        k, v, tables, lens = children
-        return cls(k=k, v=v, tables=tables, lens=lens, mode=aux[0])
+        k, v, tables, lens, chunk_lens = children
+        return cls(k=k, v=v, tables=tables, lens=lens,
+                   chunk_lens=chunk_lens, mode=aux[0],
+                   gather_pages=aux[1])
 
     # -- static geometry -----------------------------------------------------
 
@@ -144,21 +333,6 @@ class PagedKVState:
 
     # -- traced cache ops (called from inside the model's attention) ---------
 
-    def write_prefill(self, layer: int, k_new: jax.Array,
-                      v_new: jax.Array) -> None:
-        """Scatter a prefill batch's K/V — (B, P, H_kv, D), positions
-        0..P-1 — into the pools through the block tables.  Rows beyond a
-        sequence's true length land in the trash block (padded table
-        slots) or in the owned tail block at not-yet-attendable offsets
-        (overwritten by the decode write before they become visible)."""
-        b, p = k_new.shape[0], k_new.shape[1]
-        pos = jnp.arange(p, dtype=jnp.int32)
-        blk = jnp.take_along_axis(
-            self.tables, pos[None, :] // self.block_size, axis=1)  # (B, P)
-        off = jnp.broadcast_to(pos[None, :] % self.block_size, (b, p))
-        self.k = self.k.at[layer, blk, off].set(k_new)
-        self.v = self.v.at[layer, blk, off].set(v_new)
-
     def write_decode(self, layer: int, k_new: jax.Array,
                      v_new: jax.Array) -> None:
         """Scatter one decode token's K/V — (B, 1, H_kv, D) at position
@@ -170,23 +344,58 @@ class PagedKVState:
         self.k = self.k.at[layer, blk, off].set(k_new[:, 0])
         self.v = self.v.at[layer, blk, off].set(v_new[:, 0])
 
-    def gather(self, layer: int, window: Optional[int] = None):
-        """Gather each sequence's pages contiguous for the decode kernel:
-        returns (k, v, kv_start) with k/v (B, n_blocks*block_size, H_kv,
-        D) and kv_start (B,) the global position of each gathered row 0.
+    def write_chunk(self, layer: int, k_new: jax.Array,
+                    v_new: jax.Array) -> None:
+        """Scatter one mixed-step chunk's K/V — (B, C, H_kv, D), row i's
+        tokens at global positions ``lens[i] .. lens[i]+chunk_lens[i]-1``
+        — through the block tables.  Columns beyond a row's
+        ``chunk_lens`` land in the trash block (their table lookup is
+        clamped first so a pad position past ``max_blocks`` can never
+        alias a real tail block — the oversize-tier hazard the engine
+        documents).  Writes only ever touch positions ≥ ``lens``, i.e.
+        each row's PRIVATE tail — never a shared prefix block (the CoW
+        invariant; the scheduler asserts refcounts on the host side)."""
+        b, c = k_new.shape[0], k_new.shape[1]
+        rel = jnp.arange(c, dtype=jnp.int32)[None]  # (1, C)
+        pos = self.lens[:, None] + rel  # (B, C) global positions
+        valid = rel < self.chunk_lens[:, None]
+        col = jnp.minimum(pos // self.block_size, self.max_blocks - 1)
+        blk = jnp.take_along_axis(self.tables, col, axis=1)
+        blk = jnp.where(valid, blk, 0)  # pad columns -> trash block
+        off = pos % self.block_size
+        self.k = self.k.at[layer, blk, off].set(k_new)
+        self.v = self.v.at[layer, blk, off].set(v_new)
+
+    def gather(self, layer: int, window: Optional[int] = None,
+               q_span: int = 1):
+        """Gather each sequence's pages contiguous for the decode/chunk
+        kernel: returns (k, v, kv_start) with k/v (B, n_blocks*
+        block_size, H_kv, D) and kv_start (B,) the global position of
+        each gathered row 0.
 
         With ``window`` set only the trailing pages that can hold the
         window are gathered — the static gather width drops from
         ``max_blocks`` to ~``window/block_size`` pages, which with the
-        in-kernel block skip is the O(window) decode read."""
+        in-kernel block skip is the O(window) decode read.  ``q_span``
+        widens that reach for chunk steps (the chunk's last query sits
+        ``q_span - 1`` positions past ``lens``).
+
+        Without a window, ``gather_pages`` (static, set per step by the
+        engine from the batch's live max-context PAGE TIER) bounds the
+        copy: pages ``[0, gather_pages)`` instead of the full
+        ``max_blocks`` width — the tier-bounded gather that recovers
+        the paging savings on the copy while keeping shapes static per
+        tier (PERF.md round 8's honest second term)."""
         bs = self.block_size
         if window is None:
-            tbl = self.tables
+            n = self.gather_pages or self.max_blocks
+            tbl = self.tables[:, :n] if n < self.max_blocks else self.tables
             kv_start = jnp.zeros((self.tables.shape[0],), jnp.int32)
         else:
-            # pages covering positions [lens - window, lens]: the window
-            # plus the in-flight token, plus one page of alignment slack
-            n_win = min(self.max_blocks, window // bs + 2)
+            # pages covering positions [lens - window + 1, lens + q_span
+            # - 1]: the window, the in-flight chunk, one page of
+            # alignment slack
+            n_win = min(self.max_blocks, (window + q_span - 1) // bs + 2)
             first = jnp.clip(
                 (self.lens + 1 - window) // bs, 0, self.max_blocks - n_win)
             idx = first[:, None] + jnp.arange(n_win, dtype=jnp.int32)[None]
@@ -219,7 +428,8 @@ def modeled_decode_read_bytes(context_len: int, *, block_size: int,
                               head_dim: int, num_layers: int,
                               window: Optional[int] = None,
                               dtype_bytes: int = 2,
-                              max_seq_len: Optional[int] = None) -> dict:
+                              max_seq_len: Optional[int] = None,
+                              gather_pages: Optional[int] = None) -> dict:
     """Modeled K/V bytes ONE sequence's decode step reads, paged vs the
     dense full-context baseline — the serve_bench column pinning the
     paged + GQA + window read reduction (CPU-measurable: it is pure
@@ -232,10 +442,12 @@ def modeled_decode_read_bytes(context_len: int, *, block_size: int,
       (``_kb_range`` skips the rest of the gathered buffer).
     * ``gathered_bytes`` — what :meth:`PagedKVState.gather` materializes
       first: with ``window`` set, ~``window/block_size`` trailing pages
-      (the O(window) claim); with ``window=None`` the gather is
-      ``max_blocks`` wide regardless of context (static shapes — the
-      honest cost of this engine's gather-then-attend layout, and why
-      windowed configs are the production recommendation).
+      (the O(window) claim); with ``window=None``, the live-context
+      PAGE TIER the engine bounds the copy by (``gather_pages`` — pass
+      the tier the engine would pick, i.e. the smallest page tier
+      covering the batch's max context; omit it for the pre-tier
+      ``max_blocks``-wide copy, the honest cost PERF.md round 8 named
+      and this bound removes).
 
     baseline ``full_bytes``: a contiguous ``max_seq_len`` MHA buffer —
     what a non-paged, non-GQA cache re-reads every step.
@@ -245,8 +457,12 @@ def modeled_decode_read_bytes(context_len: int, *, block_size: int,
     pages = blocks_for(span, block_size) + (
         0 if window is None else 1)  # alignment slack page
     pages = min(pages, max_pages)
-    gathered = max_pages if window is None else min(
-        max_pages, window // block_size + 2)
+    if window is not None:
+        gathered = min(max_pages, window // block_size + 2)
+    elif gather_pages is not None:
+        gathered = min(max_pages, max(gather_pages, pages))
+    else:
+        gathered = max_pages
     per_kv_page = 2 * block_size * num_kv_heads * head_dim  # K+V, one page
     full = max_seq_len if max_seq_len is not None else context_len
     per_layer_full = 2 * full * num_heads * head_dim

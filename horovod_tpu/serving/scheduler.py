@@ -14,11 +14,25 @@ The policy here (documented in docs/SERVING.md):
 * **Prefill-prioritized**: when admissible requests are waiting, the
   next step is a prefill — time-to-first-token is the latency SLO,
   and a full batch is the throughput SLO; both want admission early.
-* **Admission gates**: the prompt-token sum of one prefill batch is
-  capped by ``token_budget`` (bounds the prefill step's cost so decode
-  latency can't spike arbitrarily), the decode batch by the largest
-  padding tier, and block allocation must leave ``watermark`` free
-  blocks (headroom so running sequences can keep growing without
+  With chunked prefill the engine packs prefill chunks INTO the decode
+  step (one mixed program), so prioritizing prefill no longer stalls
+  running decodes.
+* **Prefix cache on admit**: the longest cached block-aligned prefix
+  of each prompt is mapped straight into the new sequence's block
+  table with refcount bumps (:meth:`BlockAllocator.match_prefix`) —
+  zero prefill compute and zero pool writes for the shared span; only
+  the uncached tail is booked against the token budget and prefilled.
+  The match is capped one block short of the prompt so the prefill
+  step always has a token to compute (it must emit the first token),
+  and the partially-filled last block is always private — CoW by
+  construction.  LIFO recompute eviction re-admits through this same
+  match, so a recomputed sequence reuses whatever of its blocks
+  survived in the cache instead of re-prefilling from token 0.
+* **Admission gates**: the *uncached* prompt-token sum of one prefill
+  batch is capped by ``token_budget`` (bounds outstanding prefill work
+  so decode latency can't spike arbitrarily), the decode batch by the
+  largest padding tier, and block allocation must leave ``watermark``
+  free blocks (headroom so running sequences can keep growing without
   immediate eviction thrash).
 * **LIFO eviction (recompute-style)**: when a growing sequence needs a
   block and the pool is empty, the most recently admitted sequence is
@@ -42,7 +56,7 @@ from typing import Deque, List, Optional, Tuple
 import numpy as np
 
 from ..metrics import instruments as _instr
-from .kv_cache import BlockAllocator, blocks_for
+from .kv_cache import PREFIX_HASH_ROOT, BlockAllocator, blocks_for
 
 
 @dataclasses.dataclass
@@ -72,11 +86,37 @@ class Sequence:
     staged: object = None  # device-resident padded prompt row (staging queue)
     first_token_at: Optional[float] = None
     last_token_at: Optional[float] = None
+    #: context tokens whose K/V are already in the cache (prefix-cache
+    #: hits at admit + chunks computed since); == len(context) once
+    #: prefill is complete and the sequence is decoding
+    prefilled: int = 0
+    #: of ``prefilled``, how many came from prefix-cache hits at admit
+    cached_len: int = 0
+    #: chain hashes of this stream's full blocks (hashes depend only on
+    #: token ids, so the list survives eviction/readmission unchanged)
+    block_hashes: List[int] = dataclasses.field(default_factory=list)
+    #: how many of ``blocks`` are published in the prefix index
+    published: int = 0
 
     @property
     def length(self) -> int:
         """Tokens currently in the KV cache once prefill has run."""
         return len(self.context) + len(self.generated)
+
+    @property
+    def in_decode(self) -> bool:
+        """Prefill complete — the sequence decodes one token per step."""
+        return self.prefilled >= len(self.context)
+
+    @property
+    def tokens_in_cache(self) -> int:
+        """Tokens whose K/V are physically written (full blocks up to
+        here are immutable and publishable): during prefill that is
+        ``prefilled``; during decode the newest generated token's K/V
+        lands only on the NEXT step, so it is ``length - 1``."""
+        if not self.in_decode:
+            return self.prefilled
+        return len(self.context) + max(len(self.generated) - 1, 0)
 
     @property
     def done(self) -> bool:
@@ -112,6 +152,9 @@ class ContinuousBatchingScheduler:
         self.pending: Deque[Sequence] = collections.deque()
         self.running: List[Sequence] = []
         self.evictions = 0
+        #: prefix-cache admit statistics (bench hit-rate columns)
+        self.prefix_hit_blocks = 0
+        self.prefix_lookup_blocks = 0
         #: extra waiting requests not yet in ``pending`` (the engine
         #: points this at its device-staging queue so the queue-depth
         #: gauge counts staged + pending, as documented)
@@ -126,9 +169,14 @@ class ContinuousBatchingScheduler:
     def _book(self) -> None:
         _instr.SERVE_QUEUE_DEPTH.set(len(self.pending) + self.staged_depth())
         _instr.SERVE_KV_OCCUPANCY.set(self.allocator.occupancy())
+        _instr.SERVE_KV_CACHED.set(
+            self.allocator.cached_blocks / self.allocator.capacity)
 
     def finish(self, seq: Sequence) -> None:
-        """Release a completed sequence's blocks and batch slot."""
+        """Release a completed sequence's blocks and batch slot (one
+        reference each — shared prefix blocks stay alive for their
+        other holders, and cached blocks park on the allocator's LRU,
+        still matchable)."""
         self.running.remove(seq)
         self.allocator.free(seq.blocks)
         seq.blocks = []
@@ -141,16 +189,52 @@ class ContinuousBatchingScheduler:
         victim = self.running.pop()
         self.allocator.free(victim.blocks)
         victim.blocks = []
-        # recompute preemption: re-prefill prompt + generated so far
+        # recompute preemption: re-prefill prompt + generated so far.
+        # Re-admission goes through the same prefix match as any other
+        # request, so whatever full blocks survived in the cache (this
+        # victim's own, freshly parked, included) are remapped instead
+        # of recomputed — and only the uncached tail is re-booked
+        # against the token budget.
         victim.context = np.concatenate([
             victim.context, np.asarray(victim.generated, np.int32)])
         victim.generated = []
+        victim.prefilled = 0
+        victim.cached_len = 0
+        victim.published = 0
         victim.staged = None  # host re-pads/re-stages at re-admission
         self.pending.appendleft(victim)
         self.evictions += 1
         _instr.SERVE_EVICTIONS.inc()
         self._book()
         return True
+
+    # -- prefix-cache publication --------------------------------------------
+
+    def publish_full_blocks(self, seq: Sequence) -> None:
+        """Register ``seq``'s newly-FULL blocks in the prefix index
+        (the engine calls this after every step).  Only blocks all
+        ``block_size`` positions of which are written are published —
+        the partial tail stays private (CoW) — and generated tokens
+        publish too, so an evicted sequence's re-admission can match
+        its own surviving blocks."""
+        if not self.allocator.prefix_cache:
+            return
+        bs = self.allocator.block_size
+        n_full = min(seq.tokens_in_cache // bs, len(seq.blocks))
+        if seq.published >= n_full:
+            return
+        stream = seq.context if not seq.generated else np.concatenate(
+            [seq.context, np.asarray(seq.generated, np.int32)])
+        while seq.published < n_full:
+            i = seq.published
+            parent = seq.block_hashes[i - 1] if i else PREFIX_HASH_ROOT
+            h = self.allocator.register(
+                seq.blocks[i], parent, stream[i * bs:(i + 1) * bs])
+            if len(seq.block_hashes) > i:
+                seq.block_hashes[i] = h
+            else:
+                seq.block_hashes.append(h)
+            seq.published += 1
 
     # -- the per-step decision ----------------------------------------------
 
@@ -175,34 +259,70 @@ class ContinuousBatchingScheduler:
         self._book()
 
     def admit(self) -> List[Sequence]:
-        """Admit pending sequences for one prefill batch: token budget,
-        decode-batch slots, and block watermark all permitting.  The
-        admitted sequences get their context's blocks allocated here and
-        join ``running``; returns them (empty = no prefill this step)."""
+        """Admit pending sequences: token budget, decode-batch slots,
+        and block watermark all permitting.  Each admitted sequence
+        first matches the longest cached block-aligned prefix of its
+        context — those blocks map into its table with refcount bumps
+        (zero prefill compute for the span) — then allocates only the
+        uncached tail's blocks, and only the *uncached* tail tokens are
+        booked against the token budget (an evicted-then-readmitted
+        sequence whose prefix blocks survived is NOT re-booked at full
+        length).  Admitted sequences join ``running`` with
+        ``prefilled = cached_len``; the engine prefills the tail in
+        chunks.  Returns the admitted batch (empty = nothing admitted).
+        """
         batch: List[Sequence] = []
         tokens = 0
+        bs = self.allocator.block_size
         while self.pending:
             seq = self.pending[0]
             ctx = len(seq.context)  # <= max_seq_len: engine validates at
             # submit and caps generation at max_seq_len
-            if batch and tokens + ctx > self.token_budget:
-                break
             if len(self.running) + len(batch) + 1 > self.max_decode_batch:
                 break
-            need = blocks_for(ctx + 1, self.allocator.block_size)
+            # longest cached prefix, capped one block short of the
+            # context: the prefill step must have >= 1 token to compute
+            # (it emits the first token), and the cap also keeps the
+            # last, partially-filled block private — CoW by construction
+            matched, hashes = self.allocator.match_prefix(
+                seq.context, max_blocks=(ctx - 1) // bs)
+            cached = len(matched) * bs
+            tail = ctx - cached
+            if batch and tokens + tail > self.token_budget:
+                self.allocator.free(matched)  # undo the match's refs
+                break
+            need = blocks_for(ctx + 1, bs) - len(matched)
             # the watermark bypass exists ONLY for the progress
             # guarantee (an idle engine must admit SOMETHING); with
             # sequences already running, draining below the watermark
             # just sets up the admit→grow→evict thrash it prevents
             if self.allocator.free_blocks - need < self.watermark and (
                     batch or self.running):
+                self.allocator.free(matched)
                 break
             got = self.allocator.alloc(need)
             if got is None:
+                self.allocator.free(matched)
                 break
-            seq.blocks = got
+            # CoW invariant: everything the prefill will write (positions
+            # >= cached) lands in freshly-allocated private blocks
+            assert all(self.allocator.ref(b) == 1 for b in got)
+            if self.allocator.prefix_cache:
+                # booked only on successful admission (a gated-out
+                # sequence re-matches next step — counting its lookups
+                # every retry would skew the hit rate)
+                lookup = (ctx - 1) // bs
+                self.prefix_lookup_blocks += lookup
+                self.prefix_hit_blocks += len(matched)
+                _instr.SERVE_PREFIX_HITS.inc(len(matched))
+                _instr.SERVE_PREFIX_MISSES.inc(lookup - len(matched))
+            seq.blocks = matched + got
+            seq.cached_len = cached
+            seq.prefilled = cached
+            seq.published = len(matched)
+            seq.block_hashes[:len(hashes)] = hashes
             batch.append(self.pending.popleft())
-            tokens += ctx
+            tokens += tail
         self.running.extend(batch)
         self._book()
         return batch

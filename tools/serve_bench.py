@@ -1,9 +1,9 @@
 #!/usr/bin/env python
-"""Continuous-batching serving benchmark (PERF.md round 8).
+"""Continuous-batching serving benchmark (PERF.md rounds 8 + 9).
 
-Generates a synthetic OPEN-LOOP load — requests arrive on their own
+Generates synthetic OPEN-LOOP loads — requests arrive on their own
 clock, independent of completions, the way real traffic does — and
-drives it through ``horovod_tpu.serving`` twice:
+drives them through ``horovod_tpu.serving``:
 
   continuous   the ServingEngine: iteration-level admit/evict over the
                paged KV cache (Orca-style), requests staged to device
@@ -12,21 +12,35 @@ drives it through ``horovod_tpu.serving`` twice:
                fixed request batches held until every member finishes,
                contiguous worst-case KV reservations.  Batches start
                only once all members have ARRIVED (honest open-loop
-               head-of-line blocking).
+               head-of-line blocking);
+  prefix_off / prefix_on
+               the round-9 shared-prefix A/B: N requests over K prompt
+               templates (the shared-system-prompt production shape)
+               on ONE shared engine, prefix cache toggled between legs
+               — same params, same compiled tier programs, so the A/B
+               isolates the CACHE.  Emits TTFT p50/p99,
+               ``prefix_hit_rate`` and ``prefill_tokens_computed``;
+  unchunked / chunked
+               the round-9 burst A/B: a steady decode load with a
+               long-prompt burst injected mid-run, once on an engine
+               that prefills whole prompts and once on one that
+               streams them in ``HVD_TPU_SERVE_PREFILL_CHUNK``-token
+               chunks packed beside the decode batch.  Emits the
+               steady requests' inter-token decode-gap p50/p99 and the
+               spike ratio — chunking's claim is the flat p99.
 
-Both legs share ONE engine instance — same params, same jitted tier
-programs, same pools — so the A/B isolates the SCHEDULING policy, and
-both sample greedily, so the bench asserts token-for-token identical
-outputs before it reports a single number (the oracle from
-tests/test_serving.py, run on the bench's own load).
+Greedy sampling everywhere, so the bench asserts token-for-token
+identical outputs across every A/B before it reports a single number
+(the oracle from tests/test_serving.py, run on the bench's own load —
+including bit-identical streams with the prefix cache on vs off).
 
 Every leg emits ONE bench-style JSON line on stdout (human summary on
-stderr).  The scheduling win is CPU-measurable — it is steps saved, not
-FLOPs saved — so the smoke leg runs in CI; the ``kv_model`` leg carries
-the modeled per-decode-step K/V read bytes (paged + GQA + window vs a
-contiguous max-seq MHA cache), pinning the memory-traffic claim that
-needs a chip to measure in wall-clock (re-run there when the axon
-tunnel returns).
+stderr).  Scheduling, caching and chunking wins are CPU-measurable —
+they are steps/tokens saved, not FLOPs saved — so the smoke legs run
+in CI; the ``kv_model`` leg carries the modeled per-decode-step K/V
+read bytes (paged + GQA + window + page-tier gather vs a contiguous
+max-seq MHA cache), pinning the memory-traffic claim that needs a chip
+to measure in wall-clock (re-run there when the axon tunnel returns).
 
 Usage:
   serve_bench.py                # full CPU-host run (more requests)
@@ -77,8 +91,32 @@ def build_load(rs, n, *, p_lo, p_hi, gen_short, gen_long, frac_long):
     return load
 
 
+def build_prefix_load(rs, n, *, templates, t_len, s_lo, s_hi, gen):
+    """N requests over K shared prompt templates — the dominant
+    production shape (shared system prompts, few-shot headers) the
+    prefix cache exists for."""
+    temps = [rs.randint(1, 120, size=t_len).astype(np.int32)
+             for _ in range(templates)]
+    load = []
+    for _ in range(n):
+        t = temps[rs.randint(templates)]
+        suffix = rs.randint(
+            1, 120, size=rs.randint(s_lo, s_hi + 1)).astype(np.int32)
+        load.append((np.concatenate([t, suffix]), int(rs.randint(1, gen + 1))))
+    return load
+
+
+def _ttfts(token_log):
+    first = {}
+    for rid, emit, arr in token_log:
+        if rid not in first:
+            first[rid] = emit - arr
+    return list(first.values())
+
+
 def _leg_stats(leg, token_log, wall_s, results):
     lats = [emit - arr for (_rid, emit, arr) in token_log]
+    ttfts = _ttfts(token_log)
     return {
         "bench": "serve",
         "leg": leg,
@@ -88,16 +126,24 @@ def _leg_stats(leg, token_log, wall_s, results):
         "throughput_tokens_per_s": round(len(token_log) / wall_s, 2),
         "p50_token_latency_s": round(_percentile(lats, 50), 4),
         "p99_token_latency_s": round(_percentile(lats, 99), 4),
+        "ttft_p50_s": round(_percentile(ttfts, 50), 4),
+        "ttft_p99_s": round(_percentile(ttfts, 99), 4),
     }
 
 
-def run_continuous(eng, load, interarrival):
+def run_continuous(eng, load, interarrival, leg="continuous", id_base=0):
+    """One open-loop continuous leg; ``load`` is [(prompt, gen)] or
+    [(prompt, gen, due_offset_s)] for non-uniform arrival (bursts)."""
     eng.token_log = []
+    hits0 = eng.scheduler.prefix_hit_blocks
+    look0 = eng.scheduler.prefix_lookup_blocks
+    comp0 = eng.prefill_tokens_computed
     t0 = time.perf_counter()
 
     def source():
-        for i, (prompt, gen) in enumerate(load):
-            due = t0 + i * interarrival
+        for i, item in enumerate(load):
+            prompt, gen = item[0], item[1]
+            due = t0 + (item[2] if len(item) > 2 else i * interarrival)
             now = time.perf_counter()
             if due > now:
                 time.sleep(due - now)
@@ -105,21 +151,28 @@ def run_continuous(eng, load, interarrival):
             # yield time: when staging backpressure pulls the generator
             # late, that queueing delay belongs IN the latency — the
             # static leg stamps due, and the A/B must match
-            yield Request(id=i, prompt=prompt, max_new_tokens=gen,
+            yield Request(id=id_base + i, prompt=prompt, max_new_tokens=gen,
                           arrival=due)
 
     eng.attach_source(source())
     results = eng.run()
     wall = time.perf_counter() - t0
-    row = _leg_stats("continuous", eng.token_log, wall, results)
+    results = {rid - id_base: results[rid]
+               for rid in (id_base + i for i in range(len(load)))}
+    row = _leg_stats(leg, eng.token_log, wall, results)
     row["kv_occupancy"] = round(eng.allocator.peak_occupancy, 4)
     row["evictions"] = eng.scheduler.evictions
     row["compiled_programs"] = eng.program_count
+    lookups = eng.scheduler.prefix_lookup_blocks - look0
+    hits = eng.scheduler.prefix_hit_blocks - hits0
+    row["prefix_hit_rate"] = round(hits / lookups, 4) if lookups else 0.0
+    row["prefill_tokens_computed"] = eng.prefill_tokens_computed - comp0
     return row, results
 
 
 def run_static(eng, load, interarrival, batch):
     eng.token_log = []
+    comp0 = eng.prefill_tokens_computed
     t0 = time.perf_counter()
     results = {}
     for at in range(0, len(load), batch):
@@ -138,11 +191,64 @@ def run_static(eng, load, interarrival, batch):
     row["kv_occupancy"] = round(eng.allocator.peak_occupancy, 4)
     row["evictions"] = 0
     row["compiled_programs"] = eng.program_count
+    row["prefix_hit_rate"] = 0.0
+    row["prefill_tokens_computed"] = eng.prefill_tokens_computed - comp0
     return row, results
 
 
-def kv_model_leg(cfg, serve_cfg, context_len):
+def _decode_gaps(token_log, steady_ids):
+    """Inter-token gaps of the steady requests — the latency a decode
+    user feels while someone else's long prompt streams in."""
+    last = {}
+    gaps = []
+    for rid, emit, _arr in token_log:
+        if rid in steady_ids and rid in last:
+            gaps.append(emit - last[rid])
+        last[rid] = emit
+    return gaps
+
+
+def run_burst_leg(cfg, params, serve_cfg, steady, burst, steady_ids, leg):
+    """One chunked-vs-unchunked burst leg on a FRESH engine (the chunk
+    tier menu differs between the two, so programs can't be shared the
+    way the prefix A/B shares them).  The steady load runs once WITHOUT
+    the burst first — the same engine's no-burst decode-gap p99 is the
+    denominator of the flatness claim (``flatness_x``: how much the
+    burst moved the steady requests' p99 inter-token latency)."""
+    eng = ServingEngine(cfg, params, serve=serve_cfg)
+    warmed = eng.warmup()
+    run_continuous(eng, steady, None, leg="baseline", id_base=500000)
+    nb_gaps = _decode_gaps(
+        eng.token_log, {500000 + i for i in range(len(steady))})
+    p99_nb = _percentile(nb_gaps, 99)
+    row, results = run_continuous(eng, steady + burst, None, leg=leg)
+    gaps = _decode_gaps(eng.token_log, steady_ids)
+    p50, p99 = _percentile(gaps, 50), _percentile(gaps, 99)
+    row["p50_decode_gap_s"] = round(p50, 4)
+    row["p99_decode_gap_s"] = round(p99, 4)
+    row["p99_decode_gap_noburst_s"] = round(p99_nb, 4)
+    row["decode_gap_spike_x"] = round(p99 / p50, 2) if p50 else 0.0
+    row["flatness_x"] = round(p99 / p99_nb, 2) if p99_nb else 0.0
+    row["compile_free"] = row.pop("compiled_programs") == warmed
+    return row, results
+
+
+def kv_model_leg(cfg, serve_cfg, context_len, page_tiers):
+    ctx_pages = -(-context_len // serve_cfg.block_size)
+    tier = next((t for t in page_tiers if t >= ctx_pages), page_tiers[-1])
     m = modeled_decode_read_bytes(
+        context_len,
+        block_size=serve_cfg.block_size,
+        num_heads=cfg.num_heads,
+        num_kv_heads=cfg.num_kv_heads or cfg.num_heads,
+        head_dim=cfg.head_dim,
+        num_layers=cfg.num_layers,
+        window=cfg.window,
+        dtype_bytes=jnp.dtype(cfg.dtype).itemsize,
+        max_seq_len=cfg.max_seq_len,
+        gather_pages=tier if cfg.window is None else None,
+    )
+    full_width = modeled_decode_read_bytes(
         context_len,
         block_size=serve_cfg.block_size,
         num_heads=cfg.num_heads,
@@ -161,10 +267,13 @@ def kv_model_leg(cfg, serve_cfg, context_len):
         "throughput_tokens_per_s": None,
         "p99_token_latency_s": None,
         # kernel reads (the _kb_range block-skip term) AND the gather
-        # copy this engine materializes first — see the
-        # modeled_decode_read_bytes docstring for why they differ
+        # copy this engine materializes first — now bounded by the live
+        # max-context PAGE TIER instead of max_blocks (the round-8
+        # honest second term, closed); gathered_bytes_untiered keeps
+        # the old max_blocks-wide number for comparison
         "paged_read_bytes_per_decode_step": m["paged_bytes"],
         "gathered_bytes_per_decode_step": m["gathered_bytes"],
+        "gathered_bytes_untiered": full_width["gathered_bytes"],
         "full_read_bytes_per_decode_step": m["full_bytes"],
         "pages_read": m["pages_read"],
         "pages_gathered": m["pages_gathered"],
@@ -193,6 +302,9 @@ def main():
             head_dim=16, max_seq_len=96, dtype=jnp.float32,
             attention_impl="dot", causal=True)
         gen_long = 56
+        n_prefix, t_len, s_hi, chunk = 24, 48, 8, 8
+        n_steady, n_burst, burst_len = 4, 3, 88
+        steady_gen, burst_at, burst_bt = 60, 0.2, 8
     else:
         n = args.requests or 96
         rate = args.rate or 100.0
@@ -201,6 +313,9 @@ def main():
             head_dim=32, max_seq_len=256, dtype=jnp.float32,
             attention_impl="dot", causal=True)
         gen_long = 96
+        n_prefix, t_len, s_hi, chunk = 64, 128, 16, 32
+        n_steady, n_burst, burst_len = 6, 4, 240
+        steady_gen, burst_at, burst_bt = 160, 1.0, 12
 
     rs = np.random.RandomState(args.seed)
     load = build_load(rs, n, p_lo=4, p_hi=24, gen_short=4,
@@ -227,12 +342,8 @@ def main():
           f"{time.perf_counter() - t0:.1f}s", file=sys.stderr)
 
     cont_row, cont_res = run_continuous(eng, load, interarrival)
-    cont_res = dict(cont_res)  # engine.results aliases; snapshot it
     eng.allocator.peak_occupancy = 0.0
     stat_row, stat_res = run_static(eng, load, interarrival, args.batch)
-    for row in (cont_row, stat_row):
-        # steady state must be all executable-cache hits
-        row["compile_free"] = row.pop("compiled_programs") == warmed
 
     # the oracle, on the bench's own load: same greedy tokens both ways
     for i in range(n):
@@ -243,16 +354,79 @@ def main():
     cont_row["speedup_vs_static"] = round(
         cont_row["throughput_tokens_per_s"]
         / max(stat_row["throughput_tokens_per_s"], 1e-9), 2)
-    kv_row = kv_model_leg(cfg, serve_cfg, context_len=cfg.max_seq_len // 2)
 
-    for row in (cont_row, stat_row, kv_row):
+    # -- round 9: shared-prefix A/B on the SAME engine (same programs) --
+    prefix_load = build_prefix_load(
+        rs, n_prefix, templates=4, t_len=t_len, s_lo=2, s_hi=s_hi, gen=4)
+    prefix_rows = []
+    prefix_outs = []
+    for leg, enabled, base in (("prefix_off", False, 100000),
+                               ("prefix_on", True, 200000)):
+        eng.allocator.prefix_cache = enabled
+        eng.allocator.clear_cache()
+        eng.allocator.peak_occupancy = 0.0
+        row, res = run_continuous(eng, prefix_load, interarrival, leg=leg,
+                                  id_base=base)
+        prefix_rows.append(row)
+        prefix_outs.append(res)
+    for i in range(n_prefix):  # the prefix-cache bit-identity oracle
+        if not np.array_equal(prefix_outs[0][i], prefix_outs[1][i]):
+            print(f"PREFIX ORACLE MISMATCH on request {i}", file=sys.stderr)
+            return 1
+    for row in (cont_row, stat_row, prefix_rows[0], prefix_rows[1]):
+        # steady state must be all executable-cache hits
+        row["compile_free"] = row.pop("compiled_programs") == warmed
+
+    # -- round 9: chunked-prefill burst A/B (fresh engine per leg) ------
+    # the HoL shape: a few LONG-LIVED decoders admitted at t~0, then a
+    # burst of long prompts arriving TOGETHER mid-decode — slots and
+    # budget are sized so the whole burst admits in one wave, which on
+    # the unchunked engine is one monopolizing whole-prompt prefill
+    # step stalling every decoder (the round-8 p50 queueing term), and
+    # on the chunked engine is a stream of bounded chunks the decode
+    # batch rides alongside
+    burst_rs = np.random.RandomState(args.seed + 1)
+    steady_load = [
+        (burst_rs.randint(1, 120, size=8).astype(np.int32),
+         steady_gen, i * 0.01) for i in range(n_steady)]
+    burst_only = [
+        (burst_rs.randint(1, 120, size=burst_len).astype(np.int32),
+         2, burst_at) for _ in range(n_burst)]
+    steady_ids = set(range(n_steady))
+    # one decode tier: every step pads to the full batch either way, so
+    # the A/B stays fair while each fresh engine warms a tiny menu
+    burst_base = dict(
+        block_size=16, num_blocks=0, token_budget=4 * cfg.max_seq_len,
+        watermark=2, prefill_tiers=(32,), decode_tiers=(burst_bt,))
+    unchunked_row, un_res = run_burst_leg(
+        cfg, eng.params, ServeConfig(prefill_chunk=0, **burst_base),
+        steady_load, burst_only, steady_ids, "unchunked")
+    chunked_row, ch_res = run_burst_leg(
+        cfg, eng.params, ServeConfig(prefill_chunk=chunk, **burst_base),
+        steady_load, burst_only, steady_ids, "chunked")
+    for i in range(n_steady + n_burst):  # chunks move time, not values
+        if not np.array_equal(un_res[i], ch_res[i]):
+            print(f"CHUNK ORACLE MISMATCH on request {i}", file=sys.stderr)
+            return 1
+
+    kv_row = kv_model_leg(cfg, serve_cfg, context_len=cfg.max_seq_len // 2,
+                          page_tiers=eng.page_tiers)
+
+    for row in (cont_row, stat_row, prefix_rows[0], prefix_rows[1],
+                unchunked_row, chunked_row, kv_row):
         print(json.dumps(row))
+    on, off = prefix_rows[1], prefix_rows[0]
     print(
         f"continuous {cont_row['throughput_tokens_per_s']} tok/s "
         f"(p99 {cont_row['p99_token_latency_s']}s) vs static "
         f"{stat_row['throughput_tokens_per_s']} tok/s "
         f"(p99 {stat_row['p99_token_latency_s']}s) — "
-        f"{cont_row['speedup_vs_static']}x; paged decode reads "
+        f"{cont_row['speedup_vs_static']}x; prefix cache TTFT p50 "
+        f"{off['ttft_p50_s']}s -> {on['ttft_p50_s']}s at hit rate "
+        f"{on['prefix_hit_rate']} ({off['prefill_tokens_computed']} -> "
+        f"{on['prefill_tokens_computed']} prefill tokens); burst decode-gap "
+        f"p99 {unchunked_row['p99_decode_gap_s']}s unchunked -> "
+        f"{chunked_row['p99_decode_gap_s']}s chunked; paged decode reads "
         f"{kv_row['read_reduction_x']}x fewer K/V bytes", file=sys.stderr)
     return 0
 
