@@ -51,7 +51,9 @@ _READ_RES = (
 )
 # std::getenv plus the validated native helpers (EnvSeconds & friends)
 _NATIVE_READ_RE = re.compile(r"(?:getenv|Env\w*)\(\s*\"(HVD_TPU_\w+)\"")
-_ENV_PARSER_GET_RE = re.compile(r"_get(?:_int|_float|_bool)?\(\s*\"(\w+)\"")
+_ENV_PARSER_GET_RE = re.compile(
+    r"_get(?:_int|_float|_bool|_int_validated)?\(\s*[\r\n]*\s*\"(\w+)\""
+)
 _RAW_PARSE_RE = re.compile(r"\b(?:int|float)\s*\(\s*os\.(?:environ|getenv)")
 _CONST_DEF_RE = re.compile(r"^\s*([A-Za-z_]\w*)\s*=\s*\"(HVD_TPU_\w+)\"\s*$")
 _DOC_TOKEN_RE = re.compile(r"(HVD_TPU_[A-Z0-9_]+)(\*)?")

@@ -221,32 +221,16 @@ def _parse_groups(literal: str, shape: str) -> List[List[int]]:
     return [nums[i * per:(i + 1) * per] for i in range(n_groups)]
 
 
-def measured_tier_bytes(
-    lowered_text: str,
-    slice_ids: Sequence[int],
-) -> Dict[str, object]:
-    """Per-tier wire bytes of a compiled program, MEASURED from its
-    lowered (StableHLO) module rather than assumed by the model: every
-    collective instruction is inventoried with its real payload
-    shape/dtype and replica groups, the ring-stream factor converts
-    payload to per-chip link bytes, and each group is attributed to DCN
-    when its members span >1 slice of ``slice_ids`` and to ICI
-    otherwise.  ``slice_ids`` must map the program's LOGICAL device
-    ids: :func:`mesh_slice_ids` for programs lowered over a
-    hierarchical mesh (replica groups follow the mesh's row-major
-    device assignment), ``Topology.slice_ids()`` for the 1-D world
-    mesh (logical order == world order there).
-
-    The lowered module is the device-agnostic program: backends may
-    legalize further (XLA:CPU promotes bf16 collectives to f32 — the
-    reason this reads the lowered text, not the backend-optimized HLO;
-    TPU executes 16-bit collectives natively).  Returns ``{"ici_bytes",
-    "dcn_bytes", "ops": [per-instruction records]}``.
-    """
-    slice_ids = list(slice_ids)
+def _collective_records(
+    lowered_text: str, default_group: int
+) -> List[Dict[str, object]]:
+    """Inventory every collective instruction of a lowered (StableHLO)
+    module: kind, line index, payload bytes, replica groups, and the
+    ring-stream per-chip link bytes.  The shared parser behind
+    :func:`measured_tier_bytes` (tier attribution) and
+    :func:`overlap_inventory` (program-order interleave check)."""
     lines = lowered_text.splitlines()
-    ici = dcn = 0
-    ops = []
+    records: List[Dict[str, object]] = []
     for i, line in enumerate(lines):
         start = _OP_START_RE.search(line)
         if start is None:
@@ -256,7 +240,7 @@ def measured_tier_bytes(
         if gm is not None:
             groups = _parse_groups(gm.group(1), gm.group(2))
         else:
-            groups = [list(range(len(slice_ids)))]
+            groups = [list(range(default_group))]
         # region ops (all_reduce / reduce_scatter) close with a
         # separate ``}) : (types) -> types`` line; single-line ops carry
         # the signature inline
@@ -280,16 +264,176 @@ def measured_tier_bytes(
         else:
             g = max(len(groups[0]), 1) if groups else 1
             stream = int(_COLLECTIVE_FACTOR[kind] * (g - 1) * payload // g)
+        records.append({
+            "op": kind, "line": i, "end_line": j, "groups": groups,
+            "payload_bytes": payload, "group_size": g,
+            "stream_bytes": stream,
+        })
+    return records
+
+
+def measured_tier_bytes(
+    lowered_text: str,
+    slice_ids: Sequence[int],
+) -> Dict[str, object]:
+    """Per-tier wire bytes of a compiled program, MEASURED from its
+    lowered (StableHLO) module rather than assumed by the model: every
+    collective instruction is inventoried with its real payload
+    shape/dtype and replica groups, the ring-stream factor converts
+    payload to per-chip link bytes, and each group is attributed to DCN
+    when its members span >1 slice of ``slice_ids`` and to ICI
+    otherwise.  ``slice_ids`` must map the program's LOGICAL device
+    ids: :func:`mesh_slice_ids` for programs lowered over a
+    hierarchical mesh (replica groups follow the mesh's row-major
+    device assignment), ``Topology.slice_ids()`` for the 1-D world
+    mesh (logical order == world order there).
+
+    The lowered module is the device-agnostic program: backends may
+    legalize further (XLA:CPU promotes bf16 collectives to f32 — the
+    reason this reads the lowered text, not the backend-optimized HLO;
+    TPU executes 16-bit collectives natively).  Returns ``{"ici_bytes",
+    "dcn_bytes", "ops": [per-instruction records]}``.
+    """
+    slice_ids = list(slice_ids)
+    ici = dcn = 0
+    ops = []
+    for rec in _collective_records(lowered_text, len(slice_ids)):
         crosses = any(
             len({slice_ids[d] for d in grp if 0 <= d < len(slice_ids)}) > 1
-            for grp in groups
+            for grp in rec["groups"]
         )
+        stream = rec["stream_bytes"]
         if crosses:
             dcn += stream
         else:
             ici += stream
         ops.append({
-            "op": kind, "payload_bytes": payload, "group_size": g,
+            "op": rec["op"], "payload_bytes": rec["payload_bytes"],
+            "group_size": rec["group_size"],
             "tier": "dcn" if crosses else "ici", "stream_bytes": stream,
         })
     return {"ici_bytes": int(ici), "dcn_bytes": int(dcn), "ops": ops}
+
+
+# -- backward/collective overlap: program-order and timing models ------------
+
+#: compute markers of the interleave check: MXU-bound ops a backward
+#: segment is made of.  Elementwise chains don't count — a collective is
+#: "overlapped" only when real (matmul-class) compute is scheduled after
+#: its launch point.
+_COMPUTE_RE = re.compile(
+    r"stablehlo\.(dot_general|dot\b|convolution)"
+)
+
+
+def overlap_inventory(
+    lowered_text: str, min_payload_bytes: int = 0
+) -> Dict[str, object]:
+    """Program-order interleave check of a compiled step
+    (docs/tensor-fusion.md): for each collective, how much matmul-class
+    compute the lowered module schedules before and after it.
+
+    A ``jax.grad``-then-allreduce step shows every collective TRAILING
+    (``compute_after == 0`` for all of them — the whole comm time is
+    exposed); the overlapped step of ``ops/overlap.py`` pins each
+    bucket's collective between segment computations, so all but the
+    last bucket carry ``compute_after > 0``.  ``exposed_fraction`` is
+    the stream-byte share of trailing collectives — the static
+    (schedule-structure) view of the exposed-comm fraction whose
+    wall-clock twin the chip bench measures.
+
+    ``min_payload_bytes`` filters scalar control collectives (the loss
+    pmean) out of a full train step's inventory.  Returns
+    ``{"collectives": [...], "total_stream_bytes",
+    "trailing_stream_bytes", "exposed_fraction", "interleaved"}``
+    (``interleaved``: at least one collective launches with compute
+    still after it AND the trailing share is below 1 — a trailing-only
+    program is False.  A single-collective bucket trails only when it
+    is the last bucket; a multi-collective bucket — the two-level
+    hierarchical reduction is three ops — legitimately trails with its
+    whole final group, which is why the flag is not "every non-final op
+    has compute after it"; the per-op records let tests pin stricter
+    shapes).
+    """
+    compute_lines = [
+        i for i, line in enumerate(lowered_text.splitlines())
+        if _COMPUTE_RE.search(line)
+    ]
+    records = [
+        r for r in _collective_records(lowered_text, 1)
+        if r["payload_bytes"] >= min_payload_bytes
+    ]
+    total = trailing = 0
+    out = []
+    for rec in records:
+        before = sum(1 for c in compute_lines if c < rec["line"])
+        after = sum(1 for c in compute_lines if c > rec["end_line"])
+        total += rec["stream_bytes"]
+        if after == 0:
+            trailing += rec["stream_bytes"]
+        out.append({
+            "op": rec["op"], "line": rec["line"],
+            "payload_bytes": rec["payload_bytes"],
+            "stream_bytes": rec["stream_bytes"],
+            "compute_before": before, "compute_after": after,
+        })
+    interleaved = (
+        bool(out)
+        and any(op["compute_after"] > 0 for op in out)
+        and trailing < total
+    )
+    return {
+        "collectives": out,
+        "total_stream_bytes": int(total),
+        "trailing_stream_bytes": int(trailing),
+        "exposed_fraction": (trailing / total) if total else 0.0,
+        "interleaved": interleaved,
+    }
+
+
+def modeled_overlap_exposed(
+    bucket_bytes: Sequence[int],
+    t_compute_s: float,
+    link_bytes_per_s: float,
+    world: int,
+    dtype_ratio: float = 1.0,
+) -> Dict[str, float]:
+    """Timing model of the bucketed backward/collective overlap
+    (docs/tensor-fusion.md derives it; the r4 scaling-model row of
+    tools/collective_bench.py evaluates it at PERF.md's measured point).
+
+    Buckets (launch order, wire bytes each) are produced by a backward
+    pass of duration ``t_compute_s`` at a rate proportional to bytes:
+    bucket ``i`` is ready at ``t_compute_s * cum_bytes_i / total``.  Its
+    ring allreduce costs ``2*(w-1)/w * bytes * dtype_ratio /
+    link_bytes_per_s`` and the link is serial, so transfers queue:
+    ``start_i = max(ready_i, end_{i-1})``.  Exposed communication is
+    whatever finishes after the compute does; the unoverlapped baseline
+    exposes everything (``exposed_fraction == 1``).
+
+    Returns ``{"t_comm_s", "t_exposed_s", "exposed_fraction",
+    "t_step_s", "n_buckets"}``.
+    """
+    sizes = [int(b) for b in bucket_bytes if int(b) > 0]
+    total = sum(sizes)
+    if not sizes or world <= 1 or link_bytes_per_s <= 0:
+        return {
+            "t_comm_s": 0.0, "t_exposed_s": 0.0, "exposed_fraction": 0.0,
+            "t_step_s": float(t_compute_s), "n_buckets": len(sizes),
+        }
+    ring = 2.0 * (world - 1) / world * dtype_ratio / link_bytes_per_s
+    t_comm = sum(s * ring for s in sizes)
+    cum = 0
+    end = 0.0
+    for s in sizes:
+        cum += s
+        ready = t_compute_s * cum / total
+        end = max(ready, end) + s * ring
+    exposed = max(0.0, end - t_compute_s)
+    return {
+        "t_comm_s": t_comm,
+        "t_exposed_s": exposed,
+        "exposed_fraction": exposed / t_comm if t_comm else 0.0,
+        "t_step_s": t_compute_s + exposed,
+        "n_buckets": len(sizes),
+    }

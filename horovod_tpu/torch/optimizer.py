@@ -73,6 +73,7 @@ class _DistributedOptimizer(torch.optim.Optimizer):
 
         self._handles = {}  # param -> (handle, ctx)
         self._passes = {}  # param -> local accumulation count
+        self._bucket_of = None  # param -> bucket launch slot (lazy)
         self._synchronized = False
         self._should_synchronize = True
         self._hook_handles = []
@@ -124,12 +125,45 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                         pass
         return hook
 
+    def _bucket_schedule(self):
+        """param -> launch-order bucket slot, from a BucketSchedule over
+        the registered parameters (ops/fusion.py): production order is
+        REVERSE registration order — autograd produces gradients roughly
+        back-to-front — and the layout is a pure function of the
+        parameter specs, so every rank buckets identically even though
+        each rank's hooks fire in their own timing-dependent order (the
+        determinism the reference's Controller negotiates for its fusion
+        buffer).  Bucket size: ``HVD_TPU_OVERLAP_BUCKET_BYTES``."""
+        if self._bucket_of is None:
+            from ..common import basics
+            from ..ops.fusion import BucketSchedule
+
+            cfg = basics._state.config
+            bucket_bytes = (
+                cfg.overlap_bucket_bytes if cfg is not None
+                else 4 * 1024 * 1024
+            )
+            params = [p for p in self._passes]
+            specs = [
+                (tuple(p.shape), str(p.dtype).replace("torch.", ""))
+                for p in params
+            ]
+            sched = BucketSchedule.from_specs(specs, bucket_bytes)
+            self._bucket_of = {}
+            for slot, (_, idxs) in enumerate(sched.buckets):
+                for i in idxs:
+                    self._bucket_of[params[i]] = slot
+        return self._bucket_of
+
     def _drain_ready(self):
-        """Worker-side: submit every gradient that became ready.  Batch
-        composition is timing-dependent and rank-local, which is safe
-        because the entries negotiate under their own per-param names
-        (NOT as an atomic group — group membership must be rank-
-        symmetric); the batching only shaves submission latency.
+        """Worker-side: submit every gradient that became ready, grouped
+        by the deterministic BucketSchedule and submitted in bucket
+        launch order (earliest-produced first), so each bucket's
+        allreduce negotiation starts while the rest of backward still
+        runs.  Batch composition is timing-dependent and rank-local,
+        which is safe because the entries negotiate under their own
+        per-param names (NOT as an atomic group — group membership must
+        be rank-symmetric); the batching only shaves submission latency.
 
         A short coalescing window (HVD_TPU_TORCH_BATCH_WINDOW_MS,
         default 1 ms ≈ one negotiation cycle) lets the hooks of a fast
@@ -159,23 +193,40 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                 batch.append(self._ready_params.popleft())
             except IndexError:
                 break
-        tensors, names, ctxs = [], [], []
+        # bucket-ordered submission: group the drained params by their
+        # schedule bucket and submit buckets earliest-launch first — one
+        # batched native call per bucket, so a bucket full of late-layer
+        # grads never queues behind an early-layer straggler
+        bucket_of = self._bucket_schedule()
+        by_bucket = {}
         for p in batch:
-            name = self._param_names.get(p, "allreduce.noname")
-            grad = p.grad
-            if self.backward_passes_per_step > 1:
-                grad = grad / self.backward_passes_per_step
-            if self._gradient_predivide_factor != 1.0:
-                grad = grad / self._gradient_predivide_factor
-            compressed, ctx = self._compression.compress(grad)
-            tensors.append(compressed)
-            names.append(name)
-            ctxs.append(ctx)
-        handles = mpi_ops.allreduce_multi_async(
-            tensors, names, op=self._op, process_set=self._process_set,
-        )
-        for p, handle, ctx in zip(batch, handles, ctxs):
-            self._handles[p] = (handle, ctx)
+            by_bucket.setdefault(bucket_of.get(p, -1), []).append(p)
+        pending_total = sum(
+            1 for q in self._passes if q not in self._handles
+        ) - len(batch)
+        for slot in sorted(by_bucket):
+            members = by_bucket[slot]
+            tensors, names, ctxs = [], [], []
+            for p in members:
+                name = self._param_names.get(p, "allreduce.noname")
+                grad = p.grad
+                if self.backward_passes_per_step > 1:
+                    grad = grad / self.backward_passes_per_step
+                if self._gradient_predivide_factor != 1.0:
+                    grad = grad / self._gradient_predivide_factor
+                compressed, ctx = self._compression.compress(grad)
+                tensors.append(compressed)
+                names.append(name)
+                ctxs.append(ctx)
+            handles = mpi_ops.allreduce_multi_async(
+                tensors, names, op=self._op,
+                process_set=self._process_set,
+            )
+            # launch lead: params still awaiting gradients when this
+            # bucket's collective was submitted (0 = it trailed backward)
+            _metrics.OVERLAP_LAUNCH_LEAD.observe(max(pending_total, 0))
+            for p, handle, ctx in zip(members, handles, ctxs):
+                self._handles[p] = (handle, ctx)
 
     # -- synchronization ----------------------------------------------------
 

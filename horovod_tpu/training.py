@@ -53,6 +53,39 @@ def create_train_state(
     )
 
 
+def _resolve_segmenter(model, segmenter):
+    """The overlap segment-chain builder for ``model``:
+    ``segmenter(model, inputs, labels, loss_fn) -> [Segment]``.  The two
+    flagship transformers ship theirs; any other model must pass one
+    explicitly (docs/tensor-fusion.md describes the chain contract)."""
+    if segmenter is not None:
+        return segmenter
+    from .models.transformer import Transformer, overlap_segments
+
+    if isinstance(model, Transformer):
+        return overlap_segments
+    raise ValueError(
+        f"overlap=True needs a segment chain for {type(model).__name__}; "
+        "pass segmenter=(model, inputs, labels, loss_fn) -> [Segment] "
+        "(models.transformer / parallel.sharded ship theirs)"
+    )
+
+
+def _overlap_bucket_reduce(axis, op, world):
+    """Per-bucket reduction of the overlapped data-parallel backward —
+    the SAME arithmetic as ``spmd_ops.allreduce`` applied leaf-wise
+    (psum, then divide for Average), so overlapped and unoverlapped
+    steps stay bit-equal."""
+
+    def bucket_reduce(buf):
+        red = jax.lax.psum(buf, axis)
+        if op == ReduceOp.AVERAGE:
+            red = red / jnp.asarray(world, red.dtype)
+        return red
+
+    return bucket_reduce
+
+
 def data_parallel_train_step(
     model,
     optimizer: optax.GradientTransformation,
@@ -60,6 +93,9 @@ def data_parallel_train_step(
     axis: str = WORLD_AXIS,
     loss_fn: Callable = softmax_cross_entropy,
     op: ReduceOp = Average,
+    overlap: bool = False,
+    segmenter: Optional[Callable] = None,
+    bucket_bytes: Optional[int] = None,
 ) -> Callable:
     """Build the compiled data-parallel train step.
 
@@ -71,11 +107,59 @@ def data_parallel_train_step(
     ``optimizer`` should be the *inner* optax optimizer — the gradient
     allreduce is inserted here (equivalent to wrapping with
     DistributedOptimizer; don't do both or gradients reduce twice).
+
+    ``overlap=True`` stages the backward at bucket boundaries
+    (``ops/overlap.py``): each :class:`~horovod_tpu.ops.fusion.
+    BucketSchedule` bucket's allreduce launches while earlier segments'
+    gradients are still computing, instead of the whole reduction
+    trailing the backward.  Gradients and updates stay bit-equal to the
+    unoverlapped step at fp32.  Requires a segment-chain model
+    (:func:`models.transformer.overlap_segments` is used for the
+    flagship ``Transformer``; pass ``segmenter`` otherwise) and no
+    ``batch_stats``; ``bucket_bytes`` overrides
+    ``HVD_TPU_OVERLAP_BUCKET_BYTES``.
     """
     if mesh is None:
         mesh = basics._require_init().process_set_registry.get(0).mesh
+    if overlap:
+        segmenter = _resolve_segmenter(model, segmenter)
+        if op not in (ReduceOp.AVERAGE, ReduceOp.SUM):
+            raise ValueError(
+                f"overlap supports Sum/Average gradient reduction, got "
+                f"{op!r}"
+            )
+        world = int(mesh.shape[axis])
 
     def _step(state: TrainState, images, labels):
+        if overlap:
+            from .ops.overlap import overlapped_value_and_grad
+
+            if state.batch_stats is not None:
+                raise ValueError(
+                    "overlap=True does not support batch_stats models"
+                )
+            loss, grads, _ = overlapped_value_and_grad(
+                segmenter(model, images, labels, loss_fn),
+                state.params, images,
+                bucket_reduce=_overlap_bucket_reduce(axis, op, world),
+                bucket_bytes=bucket_bytes,
+            )
+            new_stats = None
+            loss = spmd_ops.allreduce(loss, axis=axis)
+            updates, new_opt_state = optimizer.update(
+                grads, state.opt_state, state.params
+            )
+            new_params = optax.apply_updates(state.params, updates)
+            return (
+                TrainState(
+                    step=state.step + 1,
+                    params=new_params,
+                    opt_state=new_opt_state,
+                    batch_stats=new_stats,
+                ),
+                loss,
+            )
+
         def compute_loss(params):
             variables = {"params": params}
             if state.batch_stats is not None:
@@ -134,6 +218,9 @@ def zero_train_setup(
     op: ReduceOp = Average,
     hierarchical: bool = False,
     dcn_compression=None,
+    overlap: bool = False,
+    segmenter: Optional[Callable] = None,
+    bucket_bytes: Optional[int] = None,
 ):
     """Build a ZeRO-sharded data-parallel trainer over the world mesh.
 
@@ -158,10 +245,35 @@ def zero_train_setup(
     (state, loss)`` matches ``data_parallel_train_step``'s contract.
     Pass the INNER optax optimizer; do not wrap it in a Zero/Distributed
     wrapper yourself.
+
+    ``overlap=True`` composes the bucket-boundary backward
+    (``ops/overlap.py``) with ZeRO: the gradient exchange IS the
+    collective the buckets launch, so each bucket's reduction rides an
+    earlier segment's backward and the wrapper slices its pre-reduced
+    shard locally (``ZeroSpmdOptimizer(pre_reduced=True)``).  Exactness
+    vs the unoverlapped ZeRO step at fp32: gradients bit-equal; updates
+    bit-equal for elementwise-exact inners (sgd); fma-bearing inners
+    (adam's ``g²`` moment) may drift ≤2 ulp/step from XLA contracting
+    the fma differently across the two program shapes —
+    tests/test_overlap.py pins both, docs/OPTIM.md documents the
+    caveat.  Error-feedback DCN compression needs the reduce-scatter
+    hop the overlapped exchange folds into the buckets, so it does not
+    compose (stateless wire compression does).
     """
     from .common.topology import DCN_AXIS, ICI_AXIS
     from .optim import ZeroSpmdOptimizer, zero_opt_state_specs
 
+    if overlap and dcn_compression is not None and getattr(
+        dcn_compression, "error_feedback", False
+    ):
+        raise ValueError(
+            "overlap=True folds the gradient reduce-scatter into the "
+            "bucket collectives — error_feedback compression (which "
+            "rides that hop's residual) does not compose; use stateless "
+            "DcnCompression or overlap=False"
+        )
+    if overlap:
+        segmenter = _resolve_segmenter(model, segmenter)
     if hierarchical:
         if mesh is None:
             mesh = basics._require_init().topology.hierarchical_mesh()
@@ -171,12 +283,14 @@ def zero_train_setup(
             inner_optimizer, op=op, hierarchical=True,
             ici_axis=ICI_AXIS, dcn_axis=DCN_AXIS,
             dcn_compression=dcn_compression,
+            pre_reduced=overlap,
         )
     else:
         if mesh is None:
             mesh = basics._require_init().process_set_registry.get(0).mesh
         world = int(mesh.shape[axis])
-        zopt = ZeroSpmdOptimizer(inner_optimizer, axis=axis, op=op)
+        zopt = ZeroSpmdOptimizer(inner_optimizer, axis=axis, op=op,
+                                 pre_reduced=overlap)
 
     variables = model.init(rng, sample_input)
     params = variables["params"]
@@ -202,7 +316,88 @@ def zero_train_setup(
         batch_stats=P() if batch_stats is not None else None,
     )
 
+    def _mean(x):
+        # a tuple axis (the hierarchical fabric mesh) means over both
+        if isinstance(axis, tuple):
+            return jax.tree_util.tree_map(
+                lambda t: jax.lax.psum(t, axis)
+                / jnp.asarray(world, t.dtype),
+                x,
+            )
+        return spmd_ops.allreduce(x, axis=axis)
+
+    def _overlap_zero_reduce(buf):
+        """Full (pre-ZeRO) reduction of one bucket, run as the SAME
+        reduce-scatter (+ allgather) primitives the wrapper's own
+        exchange uses — ZeRO's reduce-scatter IS the bucket collective,
+        just launched at the bucket boundary.  Using psum here instead
+        was measured to drift 1 ulp against the unoverlapped step (XLA
+        lowers all-reduce and reduce-scatter with different reduction
+        association); the scatter/gather pair keeps every element's
+        reduction order identical, so GRADIENTS are bit-equal
+        (tests/test_overlap.py pins it; see the overlap docstring above
+        for the fma-inner update caveat)."""
+        pad = (-buf.size) % world
+        padded = (
+            jnp.concatenate([buf, jnp.zeros((pad,), buf.dtype)])
+            if pad else buf
+        )
+        if hierarchical:
+            shard, _ = spmd_ops._two_level_reduce_scatter_flat(
+                padded, ICI_AXIS, DCN_AXIS, dcn_compression, None
+            )
+        else:
+            shard = jax.lax.psum_scatter(
+                padded, axis, scatter_dimension=0, tiled=True
+            )
+        if op == ReduceOp.AVERAGE:
+            shard = shard / jnp.asarray(world, shard.dtype)
+        if hierarchical:
+            # gather the reduced GRADIENTS at full precision: this
+            # gather only exists because of the overlap composition (the
+            # unoverlapped path feeds the reduce-scatter output straight
+            # to the update), so compressing it would quantize the
+            # gradients the optimizer sees — a divergence the
+            # unoverlapped step never has.  Wire compression stays where
+            # it always was: the reduce-scatter's DCN hop above and the
+            # update-delta allgather inside ZeroSpmdOptimizer.
+            red = spmd_ops._two_level_all_gather_flat(
+                shard, ICI_AXIS, DCN_AXIS, None
+            )
+        else:
+            red = jax.lax.all_gather(shard, axis, tiled=True)
+        return red[: buf.size] if pad else red
+
     def _step(state: TrainState, images, labels):
+        if overlap:
+            from .ops.overlap import overlapped_value_and_grad
+
+            if state.batch_stats is not None:
+                raise ValueError(
+                    "overlap=True does not support batch_stats models"
+                )
+            loss, grads, _ = overlapped_value_and_grad(
+                segmenter(model, images, labels, loss_fn),
+                state.params, images,
+                bucket_reduce=_overlap_zero_reduce,
+                bucket_bytes=bucket_bytes,
+            )
+            new_stats = None
+            loss = _mean(loss)
+            updates, new_opt_state = zopt.update(
+                grads, state.opt_state, state.params
+            )
+            new_params = optax.apply_updates(state.params, updates)
+            return (
+                TrainState(
+                    step=state.step + 1,
+                    params=new_params,
+                    opt_state=new_opt_state,
+                    batch_stats=new_stats,
+                ),
+                loss,
+            )
+
         def compute_loss(params):
             variables = {"params": params}
             if state.batch_stats is not None:
@@ -218,17 +413,7 @@ def zero_train_setup(
         )(state.params)
 
         # no separate gradient allreduce: the ZeRO update IS the
-        # reduction (reduce-scatter + allgather = the split allreduce);
-        # a tuple axis (the hierarchical fabric mesh) means over both
-        def _mean(x):
-            if isinstance(axis, tuple):
-                return jax.tree_util.tree_map(
-                    lambda t: jax.lax.psum(t, axis)
-                    / jnp.asarray(world, t.dtype),
-                    x,
-                )
-            return spmd_ops.allreduce(x, axis=axis)
-
+        # reduction (reduce-scatter + allgather = the split allreduce)
         loss = _mean(loss)
         if new_stats is not None:
             new_stats = _mean(new_stats)

@@ -30,6 +30,40 @@ def _get_int(name: str, default: int) -> int:
         return default
 
 
+def _get_int_validated(name: str, default: int, minimum: int = 0) -> int:
+    """Strict integer knob: a set-but-garbage or out-of-range value is a
+    configuration ERROR, not a silent default.  Used for the fusion/
+    overlap byte thresholds, where a typo'd ``64MB`` or a negative value
+    would otherwise silently fall through to the one-bucket-per-tensor
+    path and tank collective efficiency without any signal."""
+    v = _get(name)
+    if v is None:
+        return default
+    # name the variable the user ACTUALLY set — the error must point at
+    # the HOROVOD_* compatibility alias when that is where the value
+    # came from, or "unset it" sends them after the wrong knob
+    var = (
+        f"HVD_TPU_{name}"
+        if os.environ.get(f"HVD_TPU_{name}") is not None
+        else f"HOROVOD_{name}"
+    )
+    try:
+        value = int(v)
+    except ValueError:
+        raise ValueError(
+            f"{var} must be an integer (bytes/count), got "
+            f"{v!r} — unset it or pass a plain integer"
+        ) from None
+    if value < minimum:
+        raise ValueError(
+            f"{var} must be >= {minimum}, got {value} "
+            f"(0 disables fusion: one bucket per tensor)"
+            if minimum == 0 else
+            f"{var} must be >= {minimum}, got {value}"
+        )
+    return value
+
+
 def _get_float(name: str, default: float) -> float:
     v = _get(name)
     try:
@@ -67,6 +101,14 @@ class Config:
     # Autotune (horovod/common/parameter_manager.cc):
     autotune: bool = False  # HOROVOD_AUTOTUNE
     autotune_log: str = ""  # HOROVOD_AUTOTUNE_LOG
+    # Backward/collective overlap scheduler (ops/overlap.py,
+    # docs/tensor-fusion.md): bucket size of the BucketSchedule (0 = one
+    # bucket per tensor), and the metrics-driven BucketAutotuner sweeping
+    # bucket sizes against live step time (docs/autotune.md).
+    overlap_bucket_bytes: int = 4 * 1024 * 1024  # HVD_TPU_OVERLAP_BUCKET_BYTES
+    overlap_autotune: bool = False  # HVD_TPU_OVERLAP_AUTOTUNE
+    overlap_autotune_trials: int = 8  # HVD_TPU_OVERLAP_AUTOTUNE_TRIALS
+    overlap_autotune_steps: int = 3  # HVD_TPU_OVERLAP_AUTOTUNE_STEPS
     # Hierarchical allreduce (nccl_operations.cc NCCLHierarchicalAllreduce):
     hierarchical_allreduce: bool = False  # HOROVOD_HIERARCHICAL_ALLREDUCE
     # DCN-hop wire format for routed hierarchical allreduces
@@ -83,7 +125,8 @@ class Config:
     @staticmethod
     def from_env() -> "Config":
         return Config(
-            fusion_threshold_bytes=_get_int("FUSION_THRESHOLD", 64 * 1024 * 1024),
+            fusion_threshold_bytes=_get_int_validated(
+                "FUSION_THRESHOLD", 64 * 1024 * 1024),
             cycle_time_ms=_get_float("CYCLE_TIME", 1.0),
             cache_capacity=_get_int("CACHE_CAPACITY", 1024),
             timeline_filename=_get("TIMELINE", "") or "",
@@ -93,6 +136,13 @@ class Config:
             stall_shutdown_time_seconds=_get_float("STALL_SHUTDOWN_TIME_SECONDS", 0.0),
             autotune=_get_bool("AUTOTUNE", False),
             autotune_log=_get("AUTOTUNE_LOG", "") or "",
+            overlap_bucket_bytes=_get_int_validated(
+                "OVERLAP_BUCKET_BYTES", 4 * 1024 * 1024),
+            overlap_autotune=_get_bool("OVERLAP_AUTOTUNE", False),
+            overlap_autotune_trials=_get_int_validated(
+                "OVERLAP_AUTOTUNE_TRIALS", 8, minimum=1),
+            overlap_autotune_steps=_get_int_validated(
+                "OVERLAP_AUTOTUNE_STEPS", 3, minimum=1),
             hierarchical_allreduce=_get_bool("HIERARCHICAL_ALLREDUCE", False),
             dcn_wire_dtype=(_get("DCN_WIRE_DTYPE", "") or "").lower(),
             elastic=_get_bool("ELASTIC", False),
