@@ -293,6 +293,25 @@ SERVE_REQUESTS = counter(
     ["event"],
 )
 
+#: Per-chip ICI bytes the tensor-sharded step's row-parallel psums
+#: stream (2 per decoder layer; modeled via
+#: ops.comm_model.modeled_serve_psum_bytes, == the lowered program's
+#: all_reduce inventory).  Stays 0 on an unsharded engine.
+SERVE_SHARD_PSUM_BYTES = counter(
+    "hvd_tpu_serve_shard_psum_bytes_total",
+    "Per-chip ICI bytes streamed by the sharded serving step's psums",
+)
+
+#: KV blocks resident per shard of the tensor-sharded pool.  Under
+#: kv-head sharding every chip holds ALL blocks (each at its
+#: num_kv_heads/shards head slice) — the gauge equals the pool size,
+#: pinning that block tables and allocator state replicate rather than
+#: partition (docs/SERVING.md).
+SERVE_KV_BLOCKS_PER_SHARD = gauge(
+    "hvd_tpu_serve_kv_blocks_per_shard",
+    "KV blocks resident on each shard of the tensor-sharded pool",
+)
+
 # -- elastic (runner/elastic_driver.py, elastic/worker.py) -------------------
 
 ELASTIC_WORLD_SIZE = gauge(

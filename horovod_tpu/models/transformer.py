@@ -135,6 +135,20 @@ class TransformerConfig:
     # to every block, or a tuple of num_layers names selecting PER
     # BLOCK — e.g. ("none",)*6 + ("full",)*6 remats only the deep half.
     remat_policy: Any = None
+    # Megatron-style tensor sharding (Shoeybi et al.; docs/SERVING.md
+    # sharding section): name of a mesh axis the module is being traced
+    # under (shard_map).  When set AND bound, every sublayer runs on its
+    # 1/tp slice — q/k/v projections and attention per LOCAL head group
+    # (kv heads shard too, so the paged KV pool shards with them), MLP
+    # gate/up column-split — and the two row-parallel projections
+    # (attention output, MLP down) finish with ONE psum each: the
+    # classic 2-psums-per-block TP schedule.  Unbound or None degrades
+    # to the unsharded program (identical params, identical math), so
+    # the same config serves single- and multi-chip.  num_heads,
+    # num_kv_heads and d_model*mlp_ratio must all divide by the axis
+    # size (validated at trace).  Inference-first: the serving engine
+    # is the consumer; training paths keep using parallel/sharded.py.
+    shard_axis: Optional[str] = None
 
     def __post_init__(self):
         kv = self.num_kv_heads
@@ -242,6 +256,25 @@ def causal_dot_attention(q, k, v, *, q_offset=0, k_offset=0, causal=True,
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def _shard_size(cfg: TransformerConfig) -> int:
+    """Bound size of ``cfg.shard_axis`` (1 when unset/unbound), with the
+    divisibility contract checked at trace: every per-chip slice —
+    query heads, kv heads (the paged pool shards with them) and the MLP
+    hidden — must be exact, or shards would disagree on shapes."""
+    from ..parallel._mesh_utils import axis_size_or_1
+
+    tp = axis_size_or_1(cfg.shard_axis)
+    if tp > 1:
+        kv = cfg.num_kv_heads or cfg.num_heads
+        hidden = cfg.d_model * cfg.mlp_ratio
+        if cfg.num_heads % tp or kv % tp or hidden % tp:
+            raise ValueError(
+                f"shard_axis {cfg.shard_axis!r} of size {tp} must divide "
+                f"num_heads ({cfg.num_heads}), num_kv_heads ({kv}) and "
+                f"d_model*mlp_ratio ({hidden})")
+    return tp
+
+
 class Attention(nn.Module):
     cfg: TransformerConfig
 
@@ -254,7 +287,16 @@ class Attention(nn.Module):
         # divisibility/positivity validated in TransformerConfig.__post_init__
         kv_heads = (cfg.num_heads if cfg.num_kv_heads is None
                     else cfg.num_kv_heads)
-        q = dense(features=(cfg.num_heads, cfg.head_dim), name="q")(x)
+        # Megatron head sharding: under a bound shard_axis this trace
+        # sees the LOCAL head slice — q/k/v kernels are (D, H/tp, d)
+        # column slices, attention runs on H/tp query heads over the
+        # H_kv/tp kv heads this chip owns (the GQA group ratio is
+        # shard-invariant), and the output projection below reassembles
+        # with one psum (row-parallel).
+        tp = _shard_size(cfg)
+        heads = cfg.num_heads // tp
+        kv_heads = kv_heads // tp
+        q = dense(features=(heads, cfg.head_dim), name="q")(x)
         k = dense(features=(kv_heads, cfg.head_dim), name="k")(x)
         v = dense(features=(kv_heads, cfg.head_dim), name="v")(x)
         q = rope(q, positions)
@@ -284,10 +326,6 @@ class Attention(nn.Module):
                     q, gk, gv, paged.lens, window=cfg.window,
                     kv_start=kv_start,
                 )
-                return nn.DenseGeneral(
-                    features=cfg.d_model, axis=(-2, -1), dtype=cfg.dtype,
-                    use_bias=False, name="o",
-                )(out)
             else:
                 from ..ops.flash_attention import flash_decode_attention
 
@@ -297,16 +335,12 @@ class Attention(nn.Module):
                     q, gk, gv, paged.lens + 1, window=cfg.window,
                     kv_start=kv_start,
                 )
-                return nn.DenseGeneral(
-                    features=cfg.d_model, axis=(-2, -1), dtype=cfg.dtype,
-                    use_bias=False, name="o",
-                )(out)
         # GQA needs no expansion: every impl consumes (B, S, H_kv, D)
         # K/V natively — the kernels/einsums share each kv head across
         # its query-head group, so the group factor is saved in
         # attention HBM bytes, FLOPs and ring comms, not just in the
         # projections.
-        if cfg.attention_impl in ("ring", "ring_flash"):
+        elif cfg.attention_impl in ("ring", "ring_flash"):
             from ..parallel.ring_attention import ring_attention
 
             out = ring_attention(
@@ -324,10 +358,17 @@ class Attention(nn.Module):
         else:
             out = causal_dot_attention(q, k, v, causal=cfg.causal,
                                        window=cfg.window)
-        return nn.DenseGeneral(
+        out = nn.DenseGeneral(
             features=cfg.d_model, axis=(-2, -1), dtype=cfg.dtype,
             use_bias=False, name="o",
         )(out)
+        if tp > 1:
+            # row-parallel output projection: each chip contracted its
+            # local head slice (the kernel is an (H/tp, d, D) row slice
+            # of the global one); ONE psum reassembles the sublayer —
+            # the first of Megatron's two collectives per block
+            out = jax.lax.psum(out, cfg.shard_axis)
+        return out
 
 
 class MlpBlock(nn.Module):
@@ -336,12 +377,22 @@ class MlpBlock(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
-        hidden = cfg.d_model * cfg.mlp_ratio
+        # Megatron MLP under a bound shard_axis: gate/up are COLUMN
+        # slices ((D, F/tp) kernels — no comms, the nonlinearity is
+        # elementwise on the slice), down is the ROW slice ((F/tp, D))
+        # whose partial products ONE psum reassembles — the second of
+        # Megatron's two collectives per block.  tp == 1 is the
+        # unsharded program verbatim.
+        tp = _shard_size(cfg)
+        hidden = cfg.d_model * cfg.mlp_ratio // tp
         gate = nn.Dense(hidden, dtype=cfg.dtype, use_bias=False, name="gate")(x)
         up = nn.Dense(hidden, dtype=cfg.dtype, use_bias=False, name="up")(x)
-        return nn.Dense(
+        out = nn.Dense(
             cfg.d_model, dtype=cfg.dtype, use_bias=False, name="down"
         )(nn.silu(gate) * up)
+        if tp > 1:
+            out = jax.lax.psum(out, cfg.shard_axis)
+        return out
 
 
 class Block(nn.Module):

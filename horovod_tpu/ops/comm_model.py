@@ -315,6 +315,83 @@ def measured_tier_bytes(
     return {"ici_bytes": int(ici), "dcn_bytes": int(dcn), "ops": ops}
 
 
+# -- tensor-sharded serving: the decode program's collective inventory -------
+
+
+def modeled_serve_psum_bytes(
+    batch: int,
+    q_len: int,
+    d_model: int,
+    num_layers: int,
+    shards: int,
+    dtype: str = "float32",
+) -> dict:
+    """Per-chip ICI ring-stream bytes of ONE tensor-sharded serving
+    step's collectives (docs/SERVING.md sharding section): the Megatron
+    schedule runs exactly TWO row-parallel psums per decoder layer
+    (attention output projection, MLP down projection), each an
+    all_reduce of that sublayer's ``(batch, q_len, d_model)`` output in
+    the activation dtype — nothing else in the step communicates (the
+    KV pool is head-sharded in place, block tables replicate, the
+    embedding head is replicated).  The ring stream per chip is
+    ``2*(shards-1)/shards * payload`` per psum — the same factor
+    :func:`measured_tier_bytes` applies to the lowered program's
+    ``all_reduce`` records, so modeled == measured holds op-for-op (the
+    PR-7 idiom; tools/serve_bench.py asserts it on the MULTICHIP leg).
+    """
+    if shards < 1:
+        raise ValueError(f"shards must be >= 1, got {shards}")
+    if shards == 1:
+        return {"psum_count": 0, "payload_bytes": 0, "stream_bytes": 0}
+    payload = int(batch) * int(q_len) * int(d_model) * _itemsize(dtype)
+    per = 2 * (shards - 1) * payload // shards
+    return {
+        "psum_count": 2 * num_layers,
+        "payload_bytes": payload,
+        "stream_bytes": 2 * num_layers * per,
+    }
+
+
+_GATHER_RE = re.compile(r"\"?stablehlo\.(?:dynamic_)?gather\"?\(")
+
+
+def serve_gather_read_bytes(lowered_text: str, min_rank: int = 5) -> dict:
+    """MEASURED per-chip bytes the compiled serving step's page-gather
+    copies materialize, inventoried from the lowered (StableHLO) module
+    — the measured twin of ``kv_cache.modeled_decode_read_bytes``'s
+    ``gathered_bytes`` term (× batch tier), and the number that must
+    drop by the shard factor under kv-head sharding (the lowered
+    shard_map program carries LOCAL shapes, so the inventory reads the
+    per-chip stream directly).
+
+    The pool-page copies are identified by RESULT RANK: a page gather's
+    result is ``(batch, pages, block_size, H_kv, head_dim)`` — rank 5 —
+    while every other gather in the step is lower-rank (embedding
+    lookup rank 3, block-table ``take_along_axis`` rank 2), so rank is
+    a shape-stable discriminator where a byte threshold would not be.
+    Returns ``{"gather_bytes", "ops": [{result_bytes, rank}]}``.
+    """
+    total = 0
+    ops = []
+    for line in lowered_text.splitlines():
+        if not _GATHER_RE.search(line):
+            continue
+        sig = _SIG_RE.search(line)
+        if sig is None:
+            continue
+        out_types = sig.group(2)
+        m = _TENSOR_RE.search(out_types)
+        if m is None:
+            continue
+        dims = [d for d in m.group(1).split("x") if d]
+        if len(dims) < min_rank:
+            continue
+        nbytes = _tensor_bytes(out_types)
+        total += nbytes
+        ops.append({"result_bytes": nbytes, "rank": len(dims)})
+    return {"gather_bytes": int(total), "ops": ops}
+
+
 # -- backward/collective overlap: program-order and timing models ------------
 
 #: compute markers of the interleave check: MXU-bound ops a backward

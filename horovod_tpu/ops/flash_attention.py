@@ -562,6 +562,17 @@ def flash_block_forward(q, k, v, causal, block_q=256, block_k=256,
 # so a prefill chunk at offset k is just another batch row of the mixed
 # step (Sarathi-Serve's insight, docs/SERVING.md) — decode rows are
 # chunks of length 1 and flash_decode_attention delegates here.
+#
+# TENSOR SHARDING (docs/SERVING.md sharding section): the per-kv-head
+# folding makes the head dimension a free partition axis — under a
+# shard_map'ped serving step each chip calls these same entries with
+# its LOCAL slice (H/N query heads, H_kv/N kv heads, the pool gather's
+# matching head slice).  The grid simply shrinks to b*(H/N) rows, the
+# GQA group ratio H/H_kv is shard-invariant, and per-chip K/V HBM
+# reads drop by the shard factor (kv_cache.modeled_decode_read_bytes
+# shards= models it; comm_model.serve_gather_read_bytes measures it on
+# the lowered program).  Nothing head-global exists in the kernels, so
+# no kernel change is needed to shard — that is the seam's point.
 
 
 def flash_chunk_attention(q, k, v, q_starts, *, window=None, kv_start=None,

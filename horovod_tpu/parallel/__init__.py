@@ -11,6 +11,7 @@ from .ring_attention import (  # noqa: F401
 from .ulysses import heads_to_seq, seq_to_heads, ulysses_attention  # noqa: F401
 from .tensor_parallel import (  # noqa: F401
     ColumnParallelDense, RowParallelDense, TensorParallelAttention,
-    TensorParallelMlp,
+    TensorParallelMlp, transformer_shard_specs,
 )
+from ._mesh_utils import tensor_shard_mesh  # noqa: F401
 from .moe import ExpertParallelMoe  # noqa: F401

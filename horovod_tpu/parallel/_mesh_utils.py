@@ -2,9 +2,54 @@
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Optional, Sequence
 
 import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def tensor_shard_mesh(axis: str, shards: int,
+                      devices: Optional[Sequence] = None) -> Mesh:
+    """1-D mesh of ``shards`` devices for tensor-sharded serving
+    (``serving.ServingEngine(mesh=...)``), enforcing the DCN-exclusion
+    rule: every shard must sit on ONE ICI slice, because the
+    tensor-parallel psums run twice per decoder layer on EVERY decode
+    step — a DCN hop there would put the slow fabric in the per-token
+    critical path (docs/SERVING.md).  Slice membership comes from the
+    same runtime detection `common.topology` feeds
+    ``hierarchical_mesh()``; undetectable (virtual/CPU) worlds count as
+    one slice.  Pass an explicit ``devices`` sequence to pick chips by
+    hand — the guard still applies."""
+    if shards < 1:
+        raise ValueError(f"shards must be >= 1, got {shards}")
+    if devices is not None:
+        # an explicit pick must match exactly — silently truncating a
+        # hand-chosen list would serve on different chips than intended
+        devs = list(devices)
+        if len(devs) != shards:
+            raise ValueError(
+                f"explicit devices list has {len(devs)} entries but "
+                f"shards={shards} — pass exactly the chips to shard over")
+    else:
+        devs = jax.devices()
+        if len(devs) < shards:
+            raise ValueError(
+                f"need {shards} devices for the serving shard axis, have "
+                f"{len(devs)}")
+        devs = devs[:shards]
+    # raw slice_index tags rather than topology._detect_slice_ids: that
+    # helper returns None for subsets that don't partition equally —
+    # exactly the mixed-slice picks this guard exists to reject
+    ids = {getattr(d, "slice_index", None) for d in devs}
+    ids.discard(None)
+    if len(ids) > 1:
+        raise ValueError(
+            f"serving shard axis {axis!r} would span slices {sorted(ids)}"
+            " — tensor-parallel psums run per decode step and must stay on"
+            " ICI (the DCN-exclusion rule, docs/SERVING.md); shard within"
+            " one slice and replicate engines across slices instead")
+    return Mesh(np.asarray(devs, dtype=object), (axis,))
 
 
 def axis_size_or_1(axis: Optional[str]) -> int:

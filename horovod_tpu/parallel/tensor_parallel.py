@@ -30,9 +30,48 @@ from typing import Callable, Optional
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 
 from ._mesh_utils import axis_size_or_1 as _axis_size
+
+
+def transformer_shard_specs(params, axis: str):
+    """PartitionSpec tree laying ``models.transformer.Transformer``
+    params out Megatron-style over one tensor axis — the layout
+    ``TransformerConfig.shard_axis`` consumes inside ``shard_map``
+    (serving.ServingEngine's sharded step programs; docs/SERVING.md):
+
+      * ``attn/{q,k,v}`` kernels (D, H, d): COLUMN-parallel on the head
+        dim — each chip projects its local head slice, no comms;
+      * ``attn/o`` kernel (H, d, D): ROW-parallel on the head dim — the
+        per-chip partial outputs meet in the block's first psum;
+      * ``mlp/{gate,up}`` kernels (D, F): column-parallel on F;
+      * ``mlp/down`` kernel (F, D): row-parallel on F — the second psum;
+      * embedding, norms, everything else: replicated.
+
+    Same-name layers in :class:`MultiAxisTransformer`'s blocks are NOT
+    this layout (its attention is one fused qkv) — this helper is
+    specific to the flagship ``Transformer`` param tree.
+    """
+    col_qkv, row_o = P(None, axis, None), P(axis, None, None)
+    col_mlp, row_mlp = P(None, axis), P(axis, None)
+
+    def spec(path, leaf):
+        names = [getattr(p, "key", str(p)) for p in path]
+        if "attn" in names:
+            if any(n in names for n in ("q", "k", "v")):
+                return col_qkv
+            if "o" in names:
+                return row_o
+        if "mlp" in names:
+            if "gate" in names or "up" in names:
+                return col_mlp
+            if "down" in names:
+                return row_mlp
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec, params)
 
 
 class ColumnParallelDense(nn.Module):

@@ -4,11 +4,14 @@ The millions-of-users workload (ROADMAP item 2): iteration-level
 request scheduling (Orca, OSDI '22) over block-granular KV paging
 (vLLM's PagedAttention, SOSP '23), hash-indexed prefix caching over
 the same blocks (shared system prompts prefill once, copy-on-write by
-construction) and Sarathi-style chunked prefill (prompt bursts stream
-in beside the decode batch), with decode/chunk attention driven
-through the repo's own flash kernels' ``kv_offset``/block-skip
-machinery — see docs/SERVING.md for the policy, tuning and exactness
-contract.
+construction), Sarathi-style chunked prefill (prompt bursts stream
+in beside the decode batch), and tensor-sharded multi-chip serving
+(one model across an ICI mesh: kv heads + the paged pool
+head-sharded, Megatron FFN, per-chip decode reads cut by the shard
+factor — ``ServingEngine(mesh=...)`` / ``HVD_TPU_SERVE_SHARDS``),
+with decode/chunk attention driven through the repo's own flash
+kernels' ``kv_offset``/block-skip machinery — see docs/SERVING.md for
+the policy, tuning and exactness contract.
 
 Not imported by ``import horovod_tpu`` (training jobs shouldn't pay the
 model-stack import); use ``from horovod_tpu import serving``.

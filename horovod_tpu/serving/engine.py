@@ -45,6 +45,7 @@ from __future__ import annotations
 import bisect
 import collections
 import dataclasses
+import functools
 import os
 import time
 from typing import Dict, Iterable, List, Optional, Tuple
@@ -52,11 +53,13 @@ from typing import Dict, Iterable, List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..common.retry import env_int
 from ..data.prefetch import DevicePrefetcher
 from ..metrics import instruments as _instr
 from ..models.transformer import Transformer, TransformerConfig
+from ..ops.comm_model import modeled_serve_psum_bytes
 from ..utils.logging import get_logger
 from .kv_cache import (
     BlockAllocator, PagedKVState, blocks_for, make_pools, pool_bytes,
@@ -78,22 +81,45 @@ _REQ_COMPLETED = _instr.SERVE_REQUESTS.labels("completed")
 _PREFILL_TIERS_ENV = "HVD_TPU_SERVE_PREFILL_TIERS"
 _DECODE_TIERS_ENV = "HVD_TPU_SERVE_DECODE_TIERS"
 
+#: Mesh axis name of an engine-built serving shard mesh (an explicit
+#: ``mesh=`` may use any name; the engine reads it off the mesh).
+SHARD_AXIS = "tp"
+
 
 def _env_tiers(name: str, default: Tuple[int, ...]) -> Tuple[int, ...]:
-    """Comma-separated ascending int tiers from the environment, with the
-    package's warn-and-fall-back convention (see common.retry.env_int)."""
+    """Comma-separated tier menu from the environment, validated at
+    PARSE time: entries must be positive powers of two in strictly
+    ascending order, or a clear ValueError names the variable and the
+    rule.  Strict rather than warn-and-fall-back: a malformed tier list
+    used to surface only at warmup as a confusing menu-size/program-key
+    mismatch (tiers are the program-menu axis — _tier_for bisects an
+    ascending list, and the page/chunk menus assume power-of-two
+    growth), and silently serving the default menu instead of the
+    operator's intended one is a capacity misconfiguration, not a
+    tolerable degradation."""
     raw = os.environ.get(name)
     if raw is None or raw == "":
         return default
     try:
-        tiers = tuple(sorted({int(x) for x in raw.split(",") if x.strip()}))
-        if not tiers or tiers[0] < 1:
-            raise ValueError(raw)
-        return tiers
+        tiers = tuple(int(x) for x in raw.split(",") if x.strip())
+        if not tiers:
+            raise ValueError("empty")
     except ValueError:
-        get_logger().warning("%s=%r is not a comma-separated positive int "
-                             "list; using %s", name, raw, default)
-        return default
+        raise ValueError(
+            f"{name}={raw!r} is not a comma-separated int list") from None
+    bad = [t for t in tiers if t < 1 or t & (t - 1)]
+    if bad:
+        raise ValueError(
+            f"{name}={raw!r}: tiers must be powers of two >= 1 "
+            f"(got {bad}) — tiers key compiled step programs and the "
+            f"menus assume power-of-two growth.  (A non-power-of-two "
+            f"max_seq_len needs no entry: the engine appends it to the "
+            f"prefill menu itself for post-evict re-prefills.)")
+    if any(b <= a for a, b in zip(tiers, tiers[1:])):
+        raise ValueError(
+            f"{name}={raw!r}: tiers must be strictly ascending "
+            f"(_tier_for bisects the menu)")
+    return tiers
 
 
 def _pow2_tiers(lo: int, hi: int) -> Tuple[int, ...]:
@@ -132,6 +158,11 @@ class ServeConfig:
     decode_tiers: Tuple[int, ...] = (1, 2, 4, 8)
     prefill_chunk: int = 0
     prefix_cache: bool = True
+    #: tensor-shard the engine over this many chips of one ICI slice
+    #: (kv heads + paged pool head-sharded, Megatron FFN; must divide
+    #: num_kv_heads/num_heads/d_model*mlp_ratio — docs/SERVING.md).
+    #: 1 = single-device; ignored when an explicit mesh is passed.
+    shards: int = 1
 
     @classmethod
     def from_env(cls, **overrides) -> "ServeConfig":
@@ -161,6 +192,8 @@ class ServeConfig:
         if "prefix_cache" not in overrides:
             fields["prefix_cache"] = bool(env_int(
                 "HVD_TPU_SERVE_PREFIX_CACHE", int(base.prefix_cache)))
+        if "shards" not in overrides:
+            fields["shards"] = env_int("HVD_TPU_SERVE_SHARDS", base.shards)
         return cls(**fields)
 
 
@@ -179,20 +212,68 @@ class ServingEngine:
     model config must be causal with attention_impl 'dot' or 'flash';
     GQA (``num_kv_heads``) and sliding windows (``window``) both shrink
     the cache and the decode reads natively.
+
+    ``mesh`` (or ``ServeConfig.shards`` > 1) tensor-shards the engine
+    over one ICI slice's chips (docs/SERVING.md sharding section):
+    attention kv heads + the paged pool head-shard, the FFN runs
+    Megatron column/row-parallel, and each step is ONE ``shard_map``
+    program with two psums per decoder layer.  Per-chip HBM decode
+    reads — the stream decode throughput is bound by — drop by the
+    shard factor; block tables, the allocator and this scheduler loop
+    replicate bit-for-bit and run once on the host.  Greedy outputs
+    stay token-identical to the single-device engine (the psums move
+    fp32 reduction order only), and the warmup menu/compile-freedom
+    contract is unchanged.
     """
 
     def __init__(self, cfg: TransformerConfig, params, *,
                  serve: Optional[ServeConfig] = None,
+                 mesh: Optional[Mesh] = None,
                  clock=time.perf_counter):
         if cfg.attention_impl not in ("dot", "flash") or not cfg.causal:
             raise ValueError(
                 "serving requires a causal 'dot' or 'flash' config, got "
                 f"attention_impl={cfg.attention_impl!r} causal={cfg.causal}")
         self.cfg = cfg
-        self.params = params
         self.serve_cfg = serve = serve or ServeConfig.from_env()
         self._clock = clock
+        # -- tensor sharding (docs/SERVING.md): one model over the ICI
+        # mesh — kv heads + the paged pool head-sharded, Megatron FFN,
+        # scheduler/allocator untouched (their decisions are a pure
+        # function of token ids and pool geometry, which replicate)
+        if mesh is None and serve.shards > 1:
+            from ..parallel._mesh_utils import tensor_shard_mesh
+
+            mesh = tensor_shard_mesh(SHARD_AXIS, serve.shards)
+        if mesh is not None and mesh.devices.ndim != 1:
+            raise ValueError(
+                f"serving mesh must be 1-D (the tensor shard axis), got "
+                f"shape {mesh.devices.shape} — pass one ICI row; DCN "
+                f"tiers stay out of the token loop (docs/SERVING.md)")
+        self.mesh = mesh
+        self.shards = int(mesh.devices.size) if mesh is not None else 1
+        self.shard_axis = mesh.axis_names[0] if mesh is not None else None
+        kv_heads = cfg.num_kv_heads or cfg.num_heads
+        if self.shards > 1:
+            hidden = cfg.d_model * cfg.mlp_ratio
+            if (cfg.num_heads % self.shards or kv_heads % self.shards
+                    or hidden % self.shards):
+                raise ValueError(
+                    f"shards ({self.shards}) must divide num_heads "
+                    f"({cfg.num_heads}), num_kv_heads ({kv_heads}) and "
+                    f"d_model*mlp_ratio ({hidden}) — kv heads are the "
+                    f"pool's shard seam")
+            cfg = dataclasses.replace(cfg, shard_axis=self.shard_axis)
         self._model = Transformer(cfg)
+        if mesh is not None:
+            from ..parallel.tensor_parallel import transformer_shard_specs
+
+            # computed ONCE on the incoming tree: _place_params lays
+            # leaves out by it and the shard_map in_specs below reuse it
+            self._pspecs = transformer_shard_specs(params, self.shard_axis)
+        else:
+            self._pspecs = None
+        self.params = self._place_params(params)
         bs = serve.block_size
         self.max_blocks_per_seq = blocks_for(cfg.max_seq_len, bs)
         max_batch = max(serve.decode_tiers)
@@ -235,13 +316,26 @@ class ServingEngine:
             self.page_tiers = _pow2_tiers(1, self.max_blocks_per_seq)
         else:
             self.page_tiers = (self.max_blocks_per_seq,)
-        kv_heads = cfg.num_kv_heads or cfg.num_heads
         self.k_pool, self.v_pool = make_pools(
             cfg.num_layers, num_blocks, bs, kv_heads, cfg.head_dim,
             cfg.dtype)
+        if self.mesh is not None:
+            # each chip owns its kv heads' slice of EVERY block —
+            # tables, refcounts and eviction state replicate, so the
+            # host-side scheduler runs once, unsharded
+            pool_sharding = NamedSharding(
+                self.mesh, P(None, None, None, self.shard_axis, None))
+            self.k_pool = jax.device_put(self.k_pool, pool_sharding)
+            self.v_pool = jax.device_put(self.v_pool, pool_sharding)
         self.pool_bytes = pool_bytes(
             cfg.num_layers, num_blocks, bs, kv_heads, cfg.head_dim,
             cfg.dtype)
+        #: HBM a single chip dedicates to the K+V pools — the resident
+        #: footprint the shard factor divides (bench column)
+        self.pool_bytes_per_shard = pool_bytes(
+            cfg.num_layers, num_blocks, bs, kv_heads, cfg.head_dim,
+            cfg.dtype, shards=self.shards)
+        _instr.SERVE_KV_BLOCKS_PER_SHARD.set(num_blocks)
         self.allocator = BlockAllocator(
             num_blocks, bs, prefix_cache=serve.prefix_cache)
         self.scheduler = ContinuousBatchingScheduler(
@@ -265,9 +359,66 @@ class ServingEngine:
         #: and pad columns excluded) — the bench's
         #: ``prefill_tokens_computed`` column
         self.prefill_tokens_computed = 0
-        self._mixed_fn = jax.jit(self._mixed_step)
-        self._decode_fn = jax.jit(self._decode_step,
-                                  static_argnames=("pages",))
+        #: per-chip ICI bytes the sharded steps' psums streamed so far
+        #: (modeled, == the lowered inventory; 0 unsharded)
+        self.shard_psum_bytes = 0
+        if self.mesh is None:
+            self._mixed_fn = jax.jit(self._mixed_step)
+            self._decode_fn = jax.jit(self._decode_step,
+                                      static_argnames=("pages",))
+        else:
+            # ONE shard_map program per tier: params enter pre-sliced
+            # (Megatron specs), pools on their kv-head shard, tables/
+            # lens/tokens replicated; the traced body is the SAME
+            # _mixed_step/_decode_step the single-device engine jits —
+            # cfg.shard_axis inside makes the model run its local
+            # slice with one psum per sublayer.  Outputs: next tokens
+            # replicated (identical on every chip after the psums),
+            # pools back on their shard.
+            pspecs = self._pspecs
+            pool = P(None, None, None, self.shard_axis, None)
+            rep = P()
+            self._mixed_fn = jax.jit(jax.shard_map(
+                self._mixed_step, mesh=self.mesh,
+                in_specs=(pspecs, pool, pool, rep, rep, rep, rep),
+                out_specs=(rep, pool, pool), check_vma=False))
+
+            def _decode_sharded(params, k, v, tables, lens, last, pages):
+                return jax.shard_map(
+                    functools.partial(self._decode_step, pages=pages),
+                    mesh=self.mesh,
+                    in_specs=(pspecs, pool, pool, rep, rep, rep),
+                    out_specs=(rep, pool, pool), check_vma=False,
+                )(params, k, v, tables, lens, last)
+
+            self._decode_fn = jax.jit(_decode_sharded,
+                                      static_argnames=("pages",))
+
+    def _place_params(self, params):
+        """Lay the param pytree out for the engine's programs: under a
+        mesh, each leaf is device_put to its Megatron spec
+        (``self._pspecs``, shared with the step programs' in_specs) so
+        the per-chip HBM param footprint drops by ~the shard factor
+        alongside the pool slice; unsharded, params pass through."""
+        if self.mesh is None:
+            return params
+        flat, treedef = jax.tree_util.tree_flatten(params)
+        flat_specs = treedef.flatten_up_to(self._pspecs)
+        return jax.tree_util.tree_unflatten(treedef, [
+            jax.device_put(x, NamedSharding(self.mesh, s))
+            for x, s in zip(flat, flat_specs)])
+
+    def _book_psum_bytes(self, batch_tier: int, q_len: int) -> None:
+        """Book one sharded step's modeled per-chip psum stream into
+        the PR-1 counter (the comm model the MULTICHIP bench asserts
+        == the lowered program's all_reduce inventory)."""
+        if self.shards <= 1:
+            return
+        m = modeled_serve_psum_bytes(
+            batch_tier, q_len, self.cfg.d_model, self.cfg.num_layers,
+            self.shards, dtype=str(jnp.dtype(self.cfg.dtype)))
+        self.shard_psum_bytes += m["stream_bytes"]
+        _instr.SERVE_SHARD_PSUM_BYTES.inc(m["stream_bytes"])
 
     # -- the two tiered program families ------------------------------------
 
@@ -315,6 +466,24 @@ class ServingEngine:
     def program_count(self) -> int:
         """Distinct (kind, tier...) step programs compiled so far."""
         return len(self._progs)
+
+    def lowered_decode_text(self, batch_tier: Optional[int] = None,
+                            pages: Optional[int] = None) -> str:
+        """StableHLO text of ONE decode-step program (smallest tiers by
+        default) — the input to the ``ops.comm_model`` inventories
+        (``measured_tier_bytes`` for the sharded psums,
+        ``serve_gather_read_bytes`` for the page-gather copies), so
+        "modeled == measured" is asserted against the program the
+        engine actually dispatches, per the PR-7 idiom.  Under a mesh
+        the lowering carries per-chip (local) shapes, so the inventory
+        reads the per-chip stream directly."""
+        bt = batch_tier or self.decode_tiers[0]
+        pt = pages or self.page_tiers[0]
+        tables = jnp.zeros((bt, self.max_blocks_per_seq), jnp.int32)
+        return self._decode_fn.lower(
+            self.params, self.k_pool, self.v_pool, tables,
+            jnp.ones((bt,), jnp.int32), jnp.zeros((bt,), jnp.int32),
+            pages=pt).as_text()
 
     def warmup(self) -> int:
         """Compile the WHOLE tier menu up front — every (batch tier,
@@ -527,6 +696,7 @@ class ServingEngine:
         tables, lens = self._tables_lens(
             decode_rows + [s for s, _ in chunk_sel], bt, lens_list)
         self._book_program("mixed", bt, width)
+        self._book_psum_bytes(bt, width)
         next_tok, self.k_pool, self.v_pool = self._mixed_fn(
             self.params, self.k_pool, self.v_pool, tables, lens,
             jnp.asarray(chunk_lens), tokens)
@@ -553,6 +723,7 @@ class ServingEngine:
         last = np.zeros((bt,), np.int32)
         last[:len(seqs)] = [s.generated[-1] for s in seqs]
         self._book_program("decode", bt, pages)
+        self._book_psum_bytes(bt, 1)
         next_tok, self.k_pool, self.v_pool = self._decode_fn(
             self.params, self.k_pool, self.v_pool, tables, lens,
             jnp.asarray(last), pages=pages)

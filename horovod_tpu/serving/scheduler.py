@@ -45,6 +45,13 @@ The policy here (documented in docs/SERVING.md):
 Everything here is host-side bookkeeping over the
 :class:`~horovod_tpu.serving.kv_cache.BlockAllocator`; the device work
 happens in :mod:`horovod_tpu.serving.engine`.
+
+Tensor sharding never reaches this module BY DESIGN (docs/SERVING.md
+sharding section): every decision here — admission, prefix matching,
+CoW publication, eviction — is a pure function of token ids and pool
+geometry (block count/size), and kv-head sharding changes neither, so
+one unsharded scheduler loop drives any shard factor and the block
+tables it emits replicate bit-for-bit across chips.
 """
 
 from __future__ import annotations

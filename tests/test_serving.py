@@ -507,6 +507,159 @@ def test_eviction_readmits_through_prefix_match(model_and_params):
             err_msg=f"req {i}")
 
 
+# -- tensor-sharded serving (ISSUE 12) ----------------------------------------
+
+
+def _shard_mesh(n):
+    from horovod_tpu.parallel import tensor_shard_mesh
+
+    return tensor_shard_mesh("tp", n)
+
+
+def test_modeled_decode_read_bytes_shards_pin():
+    """The shards= satellite: per-chip modeled reads at shard factors
+    1/2/4 equal the kernel term exactly — pages x one page's K+V bytes
+    at THIS CHIP's kv-head slice x layers — and drop by the factor."""
+    kw = dict(block_size=16, num_heads=8, num_kv_heads=4, head_dim=64,
+              num_layers=4, dtype_bytes=2, max_seq_len=2048)
+    base = modeled_decode_read_bytes(256, **kw)
+    for s in (1, 2, 4):
+        m = modeled_decode_read_bytes(256, shards=s, **kw)
+        kernel_term = (kw["num_layers"] * m["pages_read"] * 2
+                       * kw["block_size"] * (kw["num_kv_heads"] // s)
+                       * kw["head_dim"] * kw["dtype_bytes"])
+        assert m["paged_bytes"] == kernel_term == base["paged_bytes"] // s
+        assert m["gathered_bytes"] == base["gathered_bytes"] // s
+        assert m["pages_read"] == base["pages_read"], "geometry replicates"
+        assert m["full_bytes"] == base["full_bytes"], "baseline unsharded"
+    with pytest.raises(ValueError, match="divide"):
+        modeled_decode_read_bytes(256, shards=3, **kw)
+
+
+def test_env_tiers_reject_malformed(monkeypatch):
+    """ServeConfig.from_env tier knobs fail at PARSE time with a clear
+    ValueError — not as a confusing menu/program-key miss at warmup."""
+    for bad, msg in (("1,banana", "int list"),
+                     ("3,5", "powers of two"),
+                     ("8,4", "ascending"),
+                     ("4,4", "ascending"),
+                     ("0,2", "powers of two"),
+                     ("-2,4", "powers of two")):
+        monkeypatch.setenv("HVD_TPU_SERVE_DECODE_TIERS", bad)
+        with pytest.raises(ValueError, match=msg):
+            ServeConfig.from_env()
+    monkeypatch.setenv("HVD_TPU_SERVE_DECODE_TIERS", "2,8,32")
+    monkeypatch.setenv("HVD_TPU_SERVE_PREFILL_TIERS", "16,64")
+    got = ServeConfig.from_env()
+    assert got.decode_tiers == (2, 8, 32)
+    assert got.prefill_tiers == (16, 64)
+
+
+def test_sharded_engine_validates(model_and_params):
+    cfg, _, params = model_and_params  # num_kv_heads=2
+    with pytest.raises(ValueError, match="divide"):
+        ServingEngine(cfg, params, serve=ServeConfig(
+            block_size=8, num_blocks=0, decode_tiers=(1, 2), shards=4),
+            mesh=_shard_mesh(4))
+    from horovod_tpu.parallel import tensor_shard_mesh
+    with pytest.raises(ValueError, match="devices"):
+        tensor_shard_mesh("tp", 99)
+
+
+def test_sharded_decode_token_identical_with_evictions(model_and_params):
+    """The standing oracle, sharded: prefix hits, CoW tails, chunked
+    schedules AND forced LIFO evictions on a 2-shard engine emit
+    token-for-token what the single-device engine emits."""
+    cfg, model, params = model_and_params
+    serve = dict(block_size=4, num_blocks=25, token_budget=64,
+                 watermark=0, decode_tiers=(1, 2, 4), prefill_chunk=8)
+    rs = np.random.RandomState(11)
+    prompts = _template_prompts(rs, 4, t_len=11, s_lo=2, s_hi=5)
+    outs = []
+    for mesh in (None, _shard_mesh(2)):
+        eng = ServingEngine(cfg, params, serve=ServeConfig(**serve),
+                            mesh=mesh)
+        ids = [eng.submit(p, max_new_tokens=14) for p in prompts]
+        out = eng.run()
+        outs.append([out[r] for r in ids])
+        assert eng.scheduler.evictions > 0, "pool sized to force evictions"
+        assert eng.scheduler.prefix_hit_blocks > 0, "templates must hit"
+    for i, (a, b) in enumerate(zip(*outs)):
+        np.testing.assert_array_equal(a, b, err_msg=f"req {i}")
+        np.testing.assert_array_equal(
+            a, ref_decode(model, params, prompts[i], 14),
+            err_msg=f"req {i} vs no-cache reference")
+
+
+def test_sharded_menu_compile_free_under_load(model_and_params):
+    """Zero post-warmup compiles on the SHARDED program menu: warmup
+    compiles |decode|x(|chunk|+|page|) shard_map programs, a randomized
+    templated load adds no executable-cache misses, and the sharded
+    psum byte counter grows per the comm model."""
+    cfg, model, params = model_and_params
+    eng = ServingEngine(cfg, params, serve=ServeConfig(
+        block_size=8, num_blocks=0, token_budget=128, watermark=2,
+        decode_tiers=(1, 2, 4), prefill_chunk=16, shards=2))
+    assert eng.shards == 2
+    menu = len(eng.decode_tiers) * (
+        len(eng.chunk_tiers) + len(eng.page_tiers))
+    warmed = eng.warmup()
+    assert warmed == menu == eng.program_count
+    miss0 = _instr.EXEC_CACHE.labels("miss").get()
+    psum0 = _instr.SERVE_SHARD_PSUM_BYTES.get()
+    rs = np.random.RandomState(12)
+    templates = [rs.randint(1, 97, size=16).astype(np.int32)
+                 for _ in range(2)]
+    load = _templated_load(rs, 24, templates, lo=3, hi=20)
+    ids = [eng.submit(p, max_new_tokens=g) for p, g in load]
+    out = eng.run()
+    assert eng.program_count == menu
+    assert _instr.EXEC_CACHE.labels("miss").get() == miss0
+    assert eng.shard_psum_bytes > 0
+    assert _instr.SERVE_SHARD_PSUM_BYTES.get() - psum0 == \
+        eng.shard_psum_bytes
+    for i in (0, 13, 23):  # spot-check the oracle at this scale
+        prompt, gen = load[i]
+        np.testing.assert_array_equal(
+            out[ids[i]], ref_decode(model, params, prompt, gen))
+
+
+def test_sharded_models_match_lowering(model_and_params):
+    """Modeled == measured per the PR-7 idiom, on the decode program
+    the engine actually dispatches: the StableHLO all_reduce inventory
+    equals the psum model, the rank-5 page-gather inventory equals the
+    per-chip gathered-bytes model x batch tier, and BOTH drop by the
+    shard factor vs the single-device lowering."""
+    from horovod_tpu.ops.comm_model import (
+        measured_tier_bytes, modeled_serve_psum_bytes,
+        serve_gather_read_bytes,
+    )
+
+    cfg, _, params = model_and_params  # 2 kv heads, f32
+    bt, pt = 2, 2
+    gathered = {}
+    for s in (1, 2):
+        eng = ServingEngine(cfg, params, serve=ServeConfig(
+            block_size=8, num_blocks=0, decode_tiers=(1, bt), shards=s))
+        txt = eng.lowered_decode_text(batch_tier=bt, pages=pt)
+        measured = measured_tier_bytes(txt, [0] * s)
+        modeled = modeled_serve_psum_bytes(
+            bt, 1, cfg.d_model, cfg.num_layers, s, "float32")
+        assert measured["ici_bytes"] == modeled["stream_bytes"]
+        n_psums = sum(1 for op in measured["ops"]
+                      if op["op"] == "all_reduce")
+        assert n_psums == modeled["psum_count"]
+        m = modeled_decode_read_bytes(
+            pt * 8, block_size=8, num_heads=cfg.num_heads,
+            num_kv_heads=cfg.num_kv_heads, head_dim=cfg.head_dim,
+            num_layers=cfg.num_layers, dtype_bytes=4,
+            max_seq_len=cfg.max_seq_len, gather_pages=pt, shards=s)
+        g = serve_gather_read_bytes(txt)
+        assert g["gather_bytes"] == bt * m["gathered_bytes"]
+        gathered[s] = g["gather_bytes"]
+    assert gathered[2] == gathered[1] // 2, "per-chip reads halve"
+
+
 def test_pool_watermark_defers_admission(model_and_params):
     """With a deep queue and a watermark, admission stops before the
     pool drains: running sequences keep headroom to grow."""

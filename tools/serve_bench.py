@@ -27,7 +27,14 @@ drives them through ``horovod_tpu.serving``:
                streams them in ``HVD_TPU_SERVE_PREFILL_CHUNK``-token
                chunks packed beside the decode batch.  Emits the
                steady requests' inter-token decode-gap p50/p99 and the
-               spike ratio — chunking's claim is the flat p99.
+               spike ratio — chunking's claim is the flat p99;
+  multichip    the round-10 tensor-sharded A/B (--shards, default 8,
+               smoke 2): one model head-sharded over the virtual ICI
+               mesh vs the single-device engine on the same templated
+               load — token-identity asserted, per-chip decode read
+               bytes and psum stream both modeled AND measured from
+               the lowered StableHLO (modeled == measured or the leg
+               fails).  The full run writes MULTICHIP_r06.json.
 
 Greedy sampling everywhere, so the bench asserts token-for-token
 identical outputs across every A/B before it reports a single number
@@ -58,13 +65,29 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np  # noqa: E402
 
+# expose the virtual multichip world BEFORE jax can be imported (the
+# MULTICHIP sharded leg needs the devices; the single-device legs are
+# unaffected — they run on device 0): raw parse, same bootstrap as
+# collective_bench/transformer_bench
+try:  # contract-ok: env -- bootstrap runs before the package's env_int is importable
+    _WORLD = max(1, int(os.environ.get("HVD_TPU_BENCH_WORLD", "") or 8))
+except ValueError:
+    _WORLD = 8
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + f" --xla_force_host_platform_device_count={_WORLD}"
+    ).strip()
 
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 
 from horovod_tpu.models.transformer import (  # noqa: E402
     Transformer, TransformerConfig,
+)
+from horovod_tpu.ops.comm_model import (  # noqa: E402
+    measured_tier_bytes, modeled_serve_psum_bytes, serve_gather_read_bytes,
 )
 from horovod_tpu.serving import (  # noqa: E402
     Request, ServeConfig, ServingEngine, modeled_decode_read_bytes,
@@ -282,6 +305,118 @@ def kv_model_leg(cfg, serve_cfg, context_len, page_tiers):
     }
 
 
+def run_multichip_leg(shards, n_requests, seed, write_json):
+    """The tensor-sharded A/B (ISSUE 12): ONE model over ``shards``
+    chips of the ICI mesh — kv heads + the paged pool head-sharded,
+    Megatron FFN, one psum per sublayer — against a single-device
+    engine on the SAME templated load.  The oracle (token-identical
+    streams) is asserted before any number is reported; the byte
+    columns carry modeled AND StableHLO-measured per-chip decode reads
+    and psum stream (the PR-7 modeled == measured idiom), which is the
+    CPU-measurable form of the claim (per-chip HBM decode reads cut by
+    the shard factor — the wall-clock twin needs a chip)."""
+    kv = max(2, shards)  # kv heads are the shard seam: kv % shards == 0
+    cfg = TransformerConfig(
+        vocab_size=128, num_layers=2, num_heads=2 * kv, num_kv_heads=kv,
+        head_dim=16, max_seq_len=96, dtype=jnp.float32,
+        attention_impl="dot", causal=True)
+    serve = dict(block_size=8, num_blocks=0, token_budget=256, watermark=2,
+                 prefill_tiers=(32,), decode_tiers=(1, 2, 4),
+                 prefill_chunk=8)
+    params = params_for(cfg)
+    rs = np.random.RandomState(seed + 2)
+    load = build_prefix_load(rs, n_requests, templates=4, t_len=24,
+                             s_lo=2, s_hi=8, gen=6)
+
+    def drive(eng):
+        t0 = time.perf_counter()
+        ids = [eng.submit(p, max_new_tokens=g) for p, g in load]
+        out = eng.run()
+        return [out[r] for r in ids], time.perf_counter() - t0
+
+    single = ServingEngine(cfg, params, serve=ServeConfig(**serve))
+    single.warmup()
+    ref_out, _ = drive(single)
+    eng = ServingEngine(cfg, params,
+                        serve=ServeConfig(shards=shards, **serve))
+    warmed = eng.warmup()
+    out, wall = drive(eng)
+    for i, (a, b) in enumerate(zip(out, ref_out)):  # the standing oracle
+        if not np.array_equal(a, b):
+            print(f"MULTICHIP ORACLE MISMATCH on request {i}",
+                  file=sys.stderr)
+            return None
+
+    # modeled == measured on the decode program the engine dispatches:
+    # per-chip page-gather reads and the per-step psum stream, at the
+    # largest decode tier over a half-max-context page tier
+    bt = max(eng.decode_tiers)
+    ctx_ref = cfg.max_seq_len // 2
+    pt = next(t for t in eng.page_tiers
+              if t >= -(-ctx_ref // serve["block_size"]))
+    rows = {}
+    for name, e, s in (("shard1", single, 1), ("sharded", eng, shards)):
+        txt = e.lowered_decode_text(batch_tier=bt, pages=pt)
+        m = modeled_decode_read_bytes(
+            ctx_ref, block_size=serve["block_size"],
+            num_heads=cfg.num_heads, num_kv_heads=kv,
+            head_dim=cfg.head_dim, num_layers=cfg.num_layers,
+            dtype_bytes=4, max_seq_len=cfg.max_seq_len,
+            gather_pages=pt, shards=s)
+        psum = modeled_serve_psum_bytes(
+            bt, 1, cfg.d_model, cfg.num_layers, s, "float32")
+        measured_reads = serve_gather_read_bytes(txt)["gather_bytes"]
+        measured_psum = measured_tier_bytes(txt, [0] * s)["ici_bytes"]
+        if measured_reads != bt * m["gathered_bytes"] or \
+                measured_psum != psum["stream_bytes"]:
+            print(f"MULTICHIP MODEL MISMATCH ({name}): reads "
+                  f"{measured_reads} vs {bt * m['gathered_bytes']}, psum "
+                  f"{measured_psum} vs {psum['stream_bytes']}",
+                  file=sys.stderr)
+            return None
+        rows[name] = (m, psum, measured_reads, measured_psum)
+    m, psum, meas_r, meas_p = rows["sharded"]
+    m1, _, meas_r1, _ = rows["shard1"]
+    toks = sum(len(t) for t in out)
+    row = {
+        "bench": "serve",
+        "leg": "multichip",
+        "n_devices": jax.device_count(),
+        "shard_factor": shards,
+        "requests": len(load),
+        "tokens": toks,
+        "wall_s": round(wall, 4),
+        "throughput_tokens_per_s": round(toks / wall, 2),
+        "compile_free": eng.program_count == warmed,
+        "kv_occupancy": round(eng.allocator.peak_occupancy, 4),
+        "prefix_hit_rate": round(
+            eng.scheduler.prefix_hit_blocks
+            / max(eng.scheduler.prefix_lookup_blocks, 1), 4),
+        # per-chip decode reads at (bt, page tier): the Pope et al.
+        # HBM-bound stream the shard factor divides
+        "per_chip_decode_read_bytes_modeled": bt * m["gathered_bytes"],
+        "per_chip_decode_read_bytes_measured": meas_r,
+        "shard1_decode_read_bytes_modeled": bt * m1["gathered_bytes"],
+        "shard1_decode_read_bytes_measured": meas_r1,
+        "read_reduction_x": round(meas_r1 / meas_r, 2),
+        # the price of the reduction: one psum per sublayer on ICI
+        "psum_bytes_per_step_modeled": psum["stream_bytes"],
+        "psum_bytes_per_step_measured": meas_p,
+        "psum_count_per_step": psum["psum_count"],
+        "pool_bytes_per_shard": eng.pool_bytes_per_shard,
+        "shard_psum_bytes_total": eng.shard_psum_bytes,
+    }
+    if write_json:
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "MULTICHIP_r06.json")
+        with open(path, "w") as f:
+            json.dump({"n_devices": jax.device_count(), "ok": True,
+                       "leg": row}, f, indent=2)
+            f.write("\n")
+        print(f"wrote {path}", file=sys.stderr)
+    return row
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--smoke", action="store_true",
@@ -291,6 +426,9 @@ def main():
                     help="request arrivals per second (open loop)")
     ap.add_argument("--batch", type=int, default=8,
                     help="static-baseline batch size AND max decode batch")
+    ap.add_argument("--shards", type=int, default=None,
+                    help="tensor-shard factor of the MULTICHIP leg "
+                         "(default 8, smoke 2; 0 skips the leg)")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
 
@@ -412,8 +550,19 @@ def main():
     kv_row = kv_model_leg(cfg, serve_cfg, context_len=cfg.max_seq_len // 2,
                           page_tiers=eng.page_tiers)
 
+    # -- round 10: the tensor-sharded MULTICHIP leg ---------------------
+    shards = args.shards if args.shards is not None else (
+        2 if args.smoke else 8)
+    mc_rows = []
+    if shards > 1:
+        mc = run_multichip_leg(shards, 12 if args.smoke else 32,
+                               args.seed, write_json=not args.smoke)
+        if mc is None:
+            return 1
+        mc_rows.append(mc)
+
     for row in (cont_row, stat_row, prefix_rows[0], prefix_rows[1],
-                unchunked_row, chunked_row, kv_row):
+                unchunked_row, chunked_row, kv_row, *mc_rows):
         print(json.dumps(row))
     on, off = prefix_rows[1], prefix_rows[0]
     print(
@@ -428,6 +577,16 @@ def main():
         f"p99 {unchunked_row['p99_decode_gap_s']}s unchunked -> "
         f"{chunked_row['p99_decode_gap_s']}s chunked; paged decode reads "
         f"{kv_row['read_reduction_x']}x fewer K/V bytes", file=sys.stderr)
+    if mc_rows:
+        mc = mc_rows[0]
+        print(
+            f"multichip x{mc['shard_factor']}: per-chip decode reads "
+            f"{mc['shard1_decode_read_bytes_measured']} -> "
+            f"{mc['per_chip_decode_read_bytes_measured']} B "
+            f"({mc['read_reduction_x']}x, modeled == measured) at "
+            f"{mc['psum_bytes_per_step_measured']} psum B/step on ICI; "
+            f"oracle token-identical, compile_free={mc['compile_free']}",
+            file=sys.stderr)
     return 0
 
 
