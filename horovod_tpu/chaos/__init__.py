@@ -63,6 +63,7 @@ SITES = (
     "data.prefetch",           # device staging in the prefetcher
     "elastic.commit",          # elastic state commit (per training step)
     "training.step",           # fit_epoch loop body
+    "fleet.preempt",           # preemption-notice poll (fleet/preemption.py)
 )
 
 
@@ -268,6 +269,15 @@ def point(site: str, payload: Any = None) -> Any:
             f"chaos: injected failure at {site} (eval {fire.evals - 1})"
         )
     if action == "kill":
+        if fire.code < 0:
+            # code=-N delivers signal N to this process instead of
+            # exiting — the preemption-notice drill (a SIGTERM the
+            # fleet.preemption guard's grace path then handles); the
+            # point returns and the handler runs asynchronously
+            get_logger().error("chaos: delivering signal %d to self at %s",
+                               -fire.code, site)
+            os.kill(os.getpid(), -fire.code)
+            return payload
         get_logger().error("chaos: self-kill at %s", site)
         os._exit(fire.code)
     if action == "hang":

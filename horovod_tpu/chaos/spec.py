@@ -19,7 +19,11 @@ Params:
     rank=R    only on the process with cross-rank R at install time
               (default: every rank)
     delay=F   seconds to sleep for action=delay (default 0.05)
-    code=N    exit code for action=kill (default 137)
+    code=N    exit code for action=kill (default 137).  A NEGATIVE N
+              delivers signal -N to the process instead of exiting
+              (Python sites only) — the preemption drill:
+              ``fleet.preempt:kill,code=-15`` is a SIGTERM notice the
+              fleet.preemption guard's grace path handles
     fuse=PATH fire at most once ACROSS process generations: the first
               fire creates PATH (O_EXCL) and any process that finds it
               existing skips the rule.  This is how a kill/corrupt

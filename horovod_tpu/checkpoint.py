@@ -196,18 +196,27 @@ def _read_pytree(path: str, state: Any) -> Any:
 
 
 def save_state_checkpoint(directory: str, state: Any, step: int,
-                          keep: int = 3) -> Optional[str]:
+                          keep: int = 3, *, snapshot: Any = None,
+                          all_ranks: bool = False) -> Optional[str]:
     """Persist an ``hvd.elastic`` state's snapshot as ``ckpt-<step>``
     (rank 0 only; crash-atomic).  The state must expose ``_snapshot()``
     (ObjectState/TpuState do); anything picklable inside survives.
 
+    ``snapshot`` publishes an ALREADY-TAKEN snapshot instead of calling
+    ``state._snapshot()`` (the preemption guard took its bounded under
+    a deadline — re-snapshotting could block on the very condition it
+    raced).  ``all_ranks=True`` bypasses the rank-0 gate: a preempted
+    worker is the sole authority on its own progress, whatever its
+    rank (crash-atomic publication makes concurrent writers safe).
+
     Pairs with :func:`restore_state_checkpoint` and with the automatic
     reset-epoch path ``state.enable_auto_resume(directory)``.
     """
-    if not _is_root():
+    if not all_ranks and not _is_root():
         return None
     payload = _STATE_MAGIC + pickle.dumps(
-        {"step": int(step), "snapshot": state._snapshot()}
+        {"step": int(step),
+         "snapshot": state._snapshot() if snapshot is None else snapshot}
     )
     path = _atomic_publish(directory, f"ckpt-{int(step)}", payload)
     _prune(directory, keep)

@@ -163,6 +163,10 @@ class WorkerNotificationManager:
         self._sock: Optional[socket.socket] = None
         self._watched_state = None
         self._watchdog_armed = False
+        # set when the driver acks a 'leaving' report: the departure is
+        # BOOKED driver-side and the worker may exit without racing the
+        # driver's exit observation (fleet/preemption.py)
+        self._leaving_acked = threading.Event()
 
     def watch_state(self, state) -> None:
         """Register the state whose last committed snapshot the failure
@@ -197,7 +201,9 @@ class WorkerNotificationManager:
                 return
             if msg is None:
                 return
-            if msg.get("type") == "hosts_updated":
+            if msg.get("type") == "leaving_ack":
+                self._leaving_acked.set()
+            elif msg.get("type") == "hosts_updated":
                 arm = False
                 with self._lock:
                     self._pending_epoch = msg.get("epoch")
@@ -312,6 +318,21 @@ class WorkerNotificationManager:
             "worker_id": env_int(ENV_WORKER_ID, -1),
         }
 
+    def report_leaving(self, reason: str, ack_timeout: float = 2.0
+                       ) -> bool:
+        """Worker->driver notice of a PLANNED departure (preemption:
+        SIGTERM grace -> snapshot -> exit 0), sent before the exit so
+        the driver marks the worker ``leaving`` — its clean exit then
+        books as a scale-down (slot held against refill, planned reset
+        epoch for the survivors), never as job completion or a
+        failure.  BLOCKS (bounded) for the driver's ``leaving_ack`` so
+        the mark is booked, not merely in a socket buffer, before the
+        caller exits; returns whether the ack arrived (False = old
+        driver or lost conn — the caller should leave a small grace)."""
+        self._leaving_acked.clear()
+        self._report("leaving", reason)
+        return self._leaving_acked.wait(ack_timeout)
+
     def report_failing(self, reason: str) -> None:
         """Best-effort worker->driver failure report on the persistent
         notification connection, sent on the way into exec-restart
@@ -322,12 +343,15 @@ class WorkerNotificationManager:
         jax coordination service's fatal handler can win when the dying
         rank hosted the service (observed: follower SIGABRT'd by
         PollForError before its first post-failure commit)."""
+        self._report("failing", reason)
+
+    def _report(self, kind: str, reason: str) -> None:
         with self._lock:
             sock = self._sock
         if sock is None:
             return
         try:
-            _send_line(sock, {"type": "failing",
+            _send_line(sock, {"type": kind,
                               "worker_id": _worker_id(),
                               "reason": reason[:512]})
         except (OSError, KeyError, ValueError):

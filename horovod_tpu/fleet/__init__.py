@@ -1,0 +1,62 @@
+"""SLO-driven elastic autoscaling + prefix-affinity serving fleet.
+
+The closed loop ROADMAP item 3 names (docs/FLEET.md): PRs 1+3 built
+the sensors (gauges, heartbeats, chaos, crash-atomic recovery), PRs
+8-12 built a serving engine that scales inside one ICI slice — this
+package DECIDES capacity and placement on top of both:
+
+* :mod:`.policy` — target-tracking SLO controller + timed drill plans
+  (:class:`TargetTrackingPolicy`, :class:`SchedulePolicy`);
+* :mod:`.autoscaler` — the evaluate-and-apply loop; training worlds
+  resize through ``ElasticDriver.request_world_size`` at epoch
+  boundaries, signals come from worker metrics endpoints or
+  ``cluster_snapshot()`` dicts;
+* :mod:`.router` / :mod:`.replica` — N in-process ``ServingEngine``
+  replicas behind prefix-affinity placement (route to the replica
+  whose published block-hash index already holds the prompt's prefix;
+  least-queue fallback), scaled against p99-TTFT/queue-depth SLOs
+  with drain-before-teardown;
+* :mod:`.preemption` — SIGTERM grace → planned snapshot → clean
+  leave, drillable through the ``fleet.preempt`` chaos site.
+
+Import shape: ``policy``/``autoscaler`` are import-light (stdlib +
+metrics — the elastic driver loads them before jax exists);
+``router``/``replica`` pull in the serving stack and are re-exported
+lazily here.
+"""
+
+from __future__ import annotations
+
+from .autoscaler import (  # noqa: F401
+    Autoscaler, EndpointSignalSource, maybe_training_autoscaler,
+    register_targets_endpoint,
+)
+from .policy import (  # noqa: F401
+    Decision, SchedulePolicy, Target, TargetTrackingPolicy,
+    histogram_quantile, plan_from_env, snapshot_signals,
+)
+
+__all__ = [
+    "Autoscaler", "Decision", "EndpointSignalSource", "FleetRouter",
+    "PreemptionGuard", "SchedulePolicy", "ServingReplica", "Target",
+    "TargetTrackingPolicy", "histogram_quantile",
+    "maybe_training_autoscaler", "plan_from_env",
+    "register_targets_endpoint", "snapshot_signals",
+]
+
+_LAZY = {
+    "FleetRouter": ".router",
+    "ServingReplica": ".replica",
+    "PreemptionGuard": ".preemption",
+}
+
+
+def __getattr__(name: str):
+    # router/replica import the serving stack (jax, flax); the driver
+    # imports this package pre-jax, so they load on first touch only
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(name)
+    import importlib
+
+    return getattr(importlib.import_module(mod, __name__), name)

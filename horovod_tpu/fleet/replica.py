@@ -1,0 +1,227 @@
+"""Serving-replica lifecycle: spawn → warmup → ready → drain → retire.
+
+One :class:`ServingReplica` wraps one
+:class:`~horovod_tpu.serving.engine.ServingEngine` behind the small
+surface the :class:`~horovod_tpu.fleet.router.FleetRouter` needs, and
+reuses the PR-1/PR-3 machinery instead of growing its own:
+
+* **spawn** builds + warms the engine through
+  :func:`~horovod_tpu.common.retry.retry_call`
+  (site ``fleet.replica_spawn`` — transient construction failures ride
+  the shared backoff+jitter policy and land in
+  ``hvd_tpu_retry_attempts``), and pins the warmup program count so
+  ``compile_free`` is checkable per replica for its whole life;
+* **heartbeat**: a replica that HAS work but hasn't completed a step
+  within ``HVD_TPU_FLEET_REPLICA_STALL_SECONDS`` reports unhealthy —
+  the same has-progress-vs-has-work distinction the PR-3 transport
+  heartbeats draw (busy-compiling peers keep beating; a wedged one
+  doesn't).  Each replica registers a ``/healthz`` source
+  (``fleet_replica_<name>``) for the life of its engine;
+* **drain** stops intake (the engine's ``accepting`` gate) while
+  in-flight and already-queued sequences keep stepping to completion;
+  ``drained`` is the router's teardown gate — a retiring replica's
+  work is never dropped;
+* **retire** releases the engine (params + KV pools) and the health
+  source.
+
+The replica never decides anything: placement and scaling live in the
+router/policy.  It is deliberately process-local — the in-process
+fleet is the bench/CI shape, and the lifecycle surface is what a
+multi-process deployment would speak over RPC.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from ..common.retry import env_float, env_int, retry_call
+from ..metrics.exposition import (
+    register_health_source, unregister_health_source,
+)
+from ..utils.logging import get_logger
+
+__all__ = ["ServingReplica", "DRAINING", "NEW", "PARKED", "READY",
+           "RETIRED"]
+
+NEW = "new"
+#: spawned + warmed but not taking traffic — the warm-spare pool the
+#: router unparks on scale-out (activation is instant; building and
+#: warming an engine mid-traffic is seconds of compile)
+PARKED = "parked"
+READY = "ready"
+DRAINING = "draining"
+RETIRED = "retired"
+
+ENV_STALL = "HVD_TPU_FLEET_REPLICA_STALL_SECONDS"
+ENV_SPAWN_RETRIES = "HVD_TPU_FLEET_REPLICA_SPAWN_RETRIES"
+
+
+class ServingReplica:
+    """One engine's lifecycle wrapper (module docstring)."""
+
+    def __init__(self, name: str, build_fn: Callable[[], object], *,
+                 clock=time.perf_counter):
+        self.name = str(name)
+        self._build = build_fn
+        self._clock = clock
+        self.state = NEW
+        self.engine = None
+        self.warmed_programs = 0
+        self.spawned_at: Optional[float] = None
+        self.retired_at: Optional[float] = None
+        self._last_progress: Optional[float] = None
+        self._stall_s = env_float(ENV_STALL, 60.0)
+        #: peak of :meth:`queue_depth` over this replica's life (bench)
+        self.peak_queue_depth = 0
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def spawn(self, park: bool = False) -> "ServingReplica":
+        """Build + warm the engine (retry-wrapped); READY on return —
+        or PARKED with ``park=True`` (a warm spare: fully compiled,
+        taking no traffic until :meth:`unpark`).  Warmup compiles the
+        engine's WHOLE tier menu, so a replica activated mid-traffic
+        serves its first request compile-free — the menu discipline
+        every serving PR has held."""
+        if self.state != NEW:
+            raise RuntimeError(f"replica {self.name} already spawned "
+                               f"({self.state})")
+        self.engine = retry_call(
+            self._build,
+            site="fleet.replica_spawn",
+            retry_on=(RuntimeError, OSError),
+            attempts=max(1, env_int(ENV_SPAWN_RETRIES, 3)),
+            describe=f"serving replica {self.name} build",
+        )
+        self.warmed_programs = self.engine.warmup()
+        self.engine.token_log = []
+        self.state = PARKED if park else READY
+        self.spawned_at = self._last_progress = self._clock()
+        register_health_source(f"fleet_replica_{self.name}", self._health)
+        get_logger().info("fleet: replica %s %s (%d tier programs)",
+                          self.name, self.state, self.warmed_programs)
+        return self
+
+    def unpark(self) -> None:
+        """Activate a warm spare (instant — the engine is compiled)."""
+        if self.state != PARKED:
+            raise RuntimeError(
+                f"replica {self.name} is {self.state}, not parked")
+        self.state = READY
+        self._last_progress = self._clock()
+
+    def drain(self) -> None:
+        """Stop intake; in-flight + queued sequences keep stepping."""
+        if self.state in (READY, PARKED):
+            self.state = DRAINING
+            self.engine.accepting = False
+
+    @property
+    def drained(self) -> bool:
+        """True once nothing is left in flight (the teardown gate)."""
+        return self.engine is None or not self.has_work
+
+    def retire(self) -> None:
+        """Release the engine (params + KV pools) and health source.
+        Call only when :attr:`drained` — the router enforces it."""
+        if self.state == RETIRED:
+            return
+        if not self.drained:
+            raise RuntimeError(
+                f"replica {self.name} still has work; drain before retire")
+        unregister_health_source(f"fleet_replica_{self.name}")
+        # final accounting outlives the engine (fleet-wide bench stats)
+        sched = self.engine.scheduler
+        self._final_hits = sched.prefix_hit_blocks
+        self._final_lookups = sched.prefix_lookup_blocks
+        self._final_compile_free = self.compile_free
+        self._final_ttfts = self.ttft_samples()
+        self.state = RETIRED
+        self.retired_at = self._clock()
+        self.engine = None
+        get_logger().info("fleet: replica %s retired", self.name)
+
+    # -- the router's working surface ----------------------------------------
+
+    @property
+    def accepting(self) -> bool:
+        return self.state == READY
+
+    @property
+    def has_work(self) -> bool:
+        sched = self.engine.scheduler
+        return bool(sched.running or sched.pending
+                    or sched.staged_depth())
+
+    def submit(self, prompt, max_new_tokens: int, *, eos_id=None,
+               arrival: Optional[float] = None) -> int:
+        if not self.accepting:
+            raise RuntimeError(
+                f"replica {self.name} is {self.state}, not accepting")
+        return self.engine.submit(prompt, max_new_tokens, eos_id=eos_id,
+                                  arrival=arrival)
+
+    def step(self) -> bool:
+        """One engine step; progress timestamps feed the heartbeat."""
+        more = self.engine.step()
+        self._last_progress = self._clock()
+        return more
+
+    def queue_depth(self) -> int:
+        """Requests waiting for admission on this replica (scheduler
+        pending + device-staged) — the least-queue routing signal,
+        the same sum the ``hvd_tpu_serve_queue_depth`` gauge carries."""
+        depth = self.engine.scheduler.queue_depth()
+        self.peak_queue_depth = max(self.peak_queue_depth, depth)
+        return depth
+
+    def cached_prefix_blocks(self, tokens: Sequence[int]) -> int:
+        """Blocks of ``tokens``' longest prefix this replica's
+        published block-hash index already holds — the affinity
+        placement score.  A pure peek: no refcounts move (the real
+        match happens at admission on whichever replica wins)."""
+        prompt = np.asarray(tokens).reshape(-1)
+        bs = self.engine.allocator.block_size
+        return self.engine.allocator.peek_prefix(
+            prompt, max_blocks=(len(prompt) - 1) // bs)
+
+    @property
+    def compile_free(self) -> bool:
+        """No program compiled after warmup — the standing zero
+        post-warmup-compiles contract, per replica."""
+        return (self.engine is not None
+                and self.engine.program_count == self.warmed_programs)
+
+    def ttft_samples(self):
+        """(request_id, ttft_seconds) for every first token this
+        replica emitted — the router's SLO signal feed; survives
+        retirement (the final list is captured before the engine is
+        released)."""
+        if self.engine is None:
+            return list(getattr(self, "_final_ttfts", ()))
+        seen = set()
+        out = []
+        for rid, emit, arr in (self.engine.token_log or ()):
+            if rid not in seen:
+                seen.add(rid)
+                out.append((rid, emit - arr))
+        return out
+
+    # -- heartbeat -----------------------------------------------------------
+
+    def _health(self):
+        stalled = False
+        if self.state in (READY, DRAINING) and self.engine is not None \
+                and self.has_work and self._last_progress is not None:
+            stalled = (self._clock() - self._last_progress) > self._stall_s
+        return not stalled, {
+            "state": self.state,
+            "queue_depth": self.queue_depth() if self.engine else 0,
+            "stalled": stalled,
+        }
+
+    def healthy(self) -> bool:
+        return self._health()[0]

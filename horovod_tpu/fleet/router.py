@@ -1,0 +1,322 @@
+"""Fleet router: prefix-affinity placement + SLO-driven replica scale.
+
+The serving half of the closed loop (ROADMAP item 3, docs/FLEET.md).
+PR 12's DCN-exclusion rule deliberately keeps one engine inside one
+ICI slice; serving more traffic than one slice can carry means
+*replicating* engines — and once there are replicas, placement IS
+latency: PR 10 measured the prefix cache as a 6.7× TTFT lever, and a
+request routed to a replica that has never seen its template pays the
+full prefill that another replica would have served from cache.
+
+**Placement rule** (SGLang's RadixAttention routing, on this repo's
+block-hash index instead of a radix tree):
+
+1. score every accepting replica by
+   :meth:`~horovod_tpu.fleet.replica.ServingReplica.cached_prefix_blocks`
+   — the longest leading run of the prompt's chain hashes present in
+   that replica's published block index (a pure peek; no refcounts
+   move);
+2. route to the best scorer (``affinity``);
+3. on an all-zero tie — an unseen template — fall back to the
+   replica with the least queue depth (``least_queue``), which both
+   balances load AND spreads templates across replicas, so the cache
+   working set partitions instead of replicating;
+4. ``mode="round_robin"`` bypasses 1-3 — the A/B baseline
+   ``tools/serve_bench.py --fleet`` measures against.
+
+Placement moves *time*, never values: greedy decode is deterministic,
+so outputs are token-identical under any routing (the bench asserts
+it before reporting a number).
+
+**Scaling**: the same :mod:`.policy` engine that resizes training
+worlds evaluates the router's in-process signals — sliding-window p99
+TTFT and mean queue depth per accepting replica — against the
+``HVD_TPU_FLEET_*`` SLOs.  Scale-out spawns + warms a replica before
+it takes traffic (zero mid-traffic compiles, the standing menu
+contract); scale-in picks the accepting replica with the least queued
+work, **drains** it (no new placements; in-flight and queued
+sequences step to completion) and retires it only once empty.
+
+The router is single-threaded and in-process: callers drive it with
+:meth:`submit` + :meth:`step` (or :meth:`run_until_drained`), the
+same way the engine itself is driven.  That is the bench/CI shape;
+the surface (submit/step/scale) is what a multi-process front-end
+would put behind RPC.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..metrics import instruments as _instr
+from ..utils.logging import get_logger
+from .policy import TargetTrackingPolicy
+from .replica import DRAINING, PARKED, READY, RETIRED, ServingReplica
+
+__all__ = ["FleetRouter"]
+
+_ROUTE_AFFINITY = _instr.FLEET_ROUTED.labels("affinity")
+_ROUTE_LEAST_QUEUE = _instr.FLEET_ROUTED.labels("least_queue")
+_ROUTE_RR = _instr.FLEET_ROUTED.labels("round_robin")
+
+
+class FleetRouter:
+    """Spread open-loop load across N serving replicas (module
+    docstring).  ``build_engine`` constructs one fresh
+    :class:`~horovod_tpu.serving.engine.ServingEngine` per replica
+    (replicas must be homogeneous — same params, same menus — for
+    placement-independent outputs)."""
+
+    def __init__(self, build_engine: Callable[[], object], *,
+                 replicas: int = 2, mode: str = "affinity",
+                 policy: Optional[TargetTrackingPolicy] = None,
+                 spares: int = 0, max_skew: int = 32,
+                 ttft_window: int = 64,
+                 clock=time.perf_counter):
+        if mode not in ("affinity", "round_robin"):
+            raise ValueError(f"unknown routing mode {mode!r}")
+        if replicas < 1:
+            raise ValueError(f"need >= 1 replica, got {replicas}")
+        self._build = build_engine
+        self.mode = mode
+        self.policy = policy
+        #: cache affinity yields to load balance past this queue skew:
+        #: when the cache-best replica's queue exceeds the fleet
+        #: minimum by more than ``max_skew``, the request routes
+        #: least-queue instead (and the new replica caches the
+        #: template — load-driven cache replication, the RadixAttention
+        #: balance rule)
+        self.max_skew = int(max_skew)
+        self._clock = clock
+        self._next_name = 0
+        self._rr = 0  # round-robin cursor
+        self.replicas: List[ServingReplica] = []
+        self.retired: List[ServingReplica] = []
+        #: global id -> (replica, replica-local request id)
+        self._placed: Dict[int, Tuple[ServingReplica, int]] = {}
+        self._next_gid = 0
+        self.results: Dict[int, np.ndarray] = {}
+        #: (arrival-ordered) sliding window of recent TTFTs — the
+        #: policy's p99_ttft signal
+        self._ttfts: collections.deque = collections.deque(
+            maxlen=max(8, int(ttft_window)))
+        self._ttft_seen: Dict[ServingReplica, int] = {}
+        #: per-router placement counts (the metric counters aggregate
+        #: across routers/legs; the bench wants per-leg numbers)
+        self.route_counts = {"affinity": 0, "least_queue": 0,
+                             "round_robin": 0}
+        #: applied scale actions, in order: (direction, new_size)
+        self.scale_events: List[Tuple[str, int]] = []
+        for _ in range(replicas):
+            self._spawn_replica()
+        # warm spares: spawned + fully compiled now (before traffic),
+        # activated instantly at scale-out — building an engine
+        # mid-traffic is seconds of XLA compile the SLO can't absorb
+        for _ in range(max(0, int(spares))):
+            self._spawn_replica(park=True)
+        if self.policy is not None:
+            self.policy.min_size = max(1, self.policy.min_size)
+
+    # -- replica lifecycle ---------------------------------------------------
+
+    def _spawn_replica(self, park: bool = False) -> ServingReplica:
+        r = ServingReplica(str(self._next_name), self._build,
+                           clock=self._clock)
+        self._next_name += 1
+        r.spawn(park=park)
+        self.replicas.append(r)
+        self._ttft_seen[r] = 0
+        self._book_replica_gauges()
+        return r
+
+    def _book_replica_gauges(self) -> None:
+        for state in (READY, DRAINING, PARKED):
+            _instr.FLEET_REPLICAS.labels(state).set(
+                sum(1 for r in self.replicas if r.state == state))
+
+    def _accepting(self) -> List[ServingReplica]:
+        return [r for r in self.replicas if r.accepting]
+
+    @property
+    def size(self) -> int:
+        """Accepting replicas — what the policy scales."""
+        return len(self._accepting())
+
+    def scale_to(self, n: int) -> bool:
+        """Converge the accepting-replica count to ``n``: unpark warm
+        spares (instant) or spawn+warm new replicas to grow, drain the
+        least-loaded (retired once empty, by :meth:`step`) to shrink.
+        Returns True when the resize was applied."""
+        n = max(1, int(n))
+        acc = self._accepting()
+        if n > len(acc):
+            for _ in range(n - len(acc)):
+                spare = next((r for r in self.replicas
+                              if r.state == PARKED), None)
+                if spare is not None:
+                    spare.unpark()
+                else:
+                    self._spawn_replica()
+            self._book_replica_gauges()
+            return True
+        while len(acc) > n and len(acc) > 1:
+            victim = min(acc, key=lambda r: (r.queue_depth(),
+                                             len(r.engine.scheduler.running)))
+            get_logger().info(
+                "fleet: draining replica %s (queue %d)", victim.name,
+                victim.queue_depth())
+            victim.drain()
+            acc = self._accepting()
+        self._book_replica_gauges()
+        return True
+
+    # -- placement -----------------------------------------------------------
+
+    def _route(self, prompt: np.ndarray) -> ServingReplica:
+        acc = self._accepting()
+        if not acc:
+            raise RuntimeError("no accepting replicas")
+        if self.mode == "round_robin":
+            r = acc[self._rr % len(acc)]
+            self._rr += 1
+            _ROUTE_RR.inc()
+            self.route_counts["round_robin"] += 1
+            return r
+        scores = [(r.cached_prefix_blocks(prompt), r) for r in acc]
+        best_score = max(s for s, _ in scores)
+        if best_score > 0:
+            # ties (same cached span on several replicas) break toward
+            # the shorter queue — affinity must not defeat balance
+            r = min((r for s, r in scores if s == best_score),
+                    key=lambda r: r.queue_depth())
+            # the balance escape: a cache hit is worth a bounded queue
+            # penalty, not an unbounded one — past max_skew the
+            # request routes least-queue and the template replicates
+            # onto the cooler replica (load-driven cache replication)
+            if r.queue_depth() - min(x.queue_depth() for x in acc) \
+                    <= self.max_skew:
+                _ROUTE_AFFINITY.inc()
+                self.route_counts["affinity"] += 1
+                return r
+        r = min(acc, key=lambda r: r.queue_depth())
+        _ROUTE_LEAST_QUEUE.inc()
+        self.route_counts["least_queue"] += 1
+        return r
+
+    def submit(self, prompt, max_new_tokens: int, *, eos_id=None,
+               arrival: Optional[float] = None) -> int:
+        """Place one request; returns a router-global id (key into
+        :attr:`results`)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        r = self._route(prompt)
+        rid = r.submit(prompt, max_new_tokens, eos_id=eos_id,
+                       arrival=arrival)
+        gid = self._next_gid
+        self._next_gid += 1
+        self._placed[gid] = (r, rid)
+        return gid
+
+    # -- driving -------------------------------------------------------------
+
+    def step(self) -> bool:
+        """One pass: step every replica that has work, collect
+        completions and TTFT samples, retire drained replicas, tick
+        the scale policy.  Returns True while anything is in flight."""
+        busy = False
+        for r in list(self.replicas):
+            if r.state == RETIRED or r.engine is None:
+                continue
+            r.queue_depth()  # sample: keeps peak_queue_depth honest
+            # in every routing mode, not just where routing reads it
+            if r.has_work:
+                busy = True
+                r.step()
+            self._collect(r)
+            if r.state == DRAINING and r.drained:
+                r.retire()
+                self.replicas.remove(r)
+                self.retired.append(r)
+                self._book_replica_gauges()
+        if self.policy is not None:
+            self._maybe_scale()
+        return busy
+
+    def run_until_drained(self) -> Dict[int, np.ndarray]:
+        while self.step():
+            pass
+        return self.results
+
+    def _collect(self, r: ServingReplica) -> None:
+        for _rid, ttft in r.ttft_samples()[self._ttft_seen.get(r, 0):]:
+            self._ttfts.append(ttft)
+            self._ttft_seen[r] = self._ttft_seen.get(r, 0) + 1
+        # map replica-local completions back to router-global ids
+        for gid, (rep, rid) in list(self._placed.items()):
+            if rep is r and rid in r.engine.results:
+                self.results[gid] = r.engine.results[rid]
+                del self._placed[gid]
+
+    # -- SLO signals + scaling ----------------------------------------------
+
+    def signals(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        acc = self._accepting()
+        if acc:
+            out["queue_depth"] = sum(
+                r.queue_depth() for r in acc) / len(acc)
+        if self._ttfts:
+            xs = sorted(self._ttfts)
+            # exact small-window p99 (the registry histograms stay the
+            # durable record; the policy wants the recent window)
+            idx = min(len(xs) - 1, int(0.99 * len(xs)))
+            out["p99_ttft"] = xs[idx]
+            _instr.FLEET_ROUTER_P99_TTFT.set(out["p99_ttft"])
+        return out
+
+    def _maybe_scale(self) -> None:
+        d = self.policy.evaluate(self.signals(), self.size,
+                                 self._clock())
+        _instr.FLEET_DESIRED_SIZE.labels("serve").set(d.desired)
+        if d.direction != "hold" and d.desired != self.size:
+            get_logger().info("fleet: serve scale %s %d -> %d (%s)",
+                              d.direction, self.size, d.desired, d.reason)
+            if self.scale_to(d.desired):
+                _instr.FLEET_SCALE_EVENTS.labels(
+                    "serve", d.direction).inc()
+                self.scale_events.append((d.direction, d.desired))
+                self.policy.note_applied(self._clock())
+
+    # -- bench/introspection columns -----------------------------------------
+
+    def prefix_stats(self) -> Tuple[int, int]:
+        """(hit blocks, lookup blocks) aggregated over every replica,
+        live and retired — the fleet-wide hit rate numerator and
+        denominator."""
+        hits = lookups = 0
+        for r in self.replicas + self.retired:
+            sched = getattr(r.engine, "scheduler", None) \
+                if r.engine is not None else None
+            if sched is not None:
+                hits += sched.prefix_hit_blocks
+                lookups += sched.prefix_lookup_blocks
+            else:  # retired replicas keep their final counts
+                hits += getattr(r, "_final_hits", 0)
+                lookups += getattr(r, "_final_lookups", 0)
+        return hits, lookups
+
+    def all_ttfts(self) -> List[float]:
+        """Every TTFT sample across live AND retired replicas — the
+        bench's full-leg distribution (the policy's sliding window is
+        deliberately smaller)."""
+        out: List[float] = []
+        for r in self.replicas + self.retired:
+            out.extend(t for _rid, t in r.ttft_samples())
+        return out
+
+    def all_compile_free(self) -> bool:
+        return all(r.compile_free for r in self.replicas) and all(
+            getattr(r, "_final_compile_free", True) for r in self.retired)

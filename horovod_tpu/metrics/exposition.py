@@ -31,6 +31,7 @@ import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional, Tuple
+from urllib.parse import unquote
 
 from ..utils.logging import get_logger
 from .registry import REGISTRY, Histogram, MetricsRegistry
@@ -39,7 +40,8 @@ __all__ = [
     "render", "start_http_server", "stop_http_server", "http_server",
     "maybe_start_from_env", "register_health_source",
     "unregister_health_source", "health_snapshot", "ENV_METRICS_PORT",
-    "ENV_METRICS_BIND",
+    "ENV_METRICS_BIND", "register_control_handler",
+    "unregister_control_handler",
 ]
 
 ENV_METRICS_PORT = "HVD_TPU_METRICS_PORT"
@@ -152,6 +154,33 @@ def health_snapshot() -> Tuple[bool, dict]:
     return healthy, details
 
 
+# -- control handlers --------------------------------------------------------
+
+_control_lock = threading.Lock()
+_control_handlers: Dict[str, Callable[[Dict[str, str]], Tuple[int, dict]]] \
+    = {}
+
+
+def register_control_handler(name: str,
+                             fn: Callable[[Dict[str, str]],
+                                          Tuple[int, dict]],
+                             ) -> None:
+    """Mount a small control surface at ``GET /control/<name>`` on the
+    worker's endpoint (the same registration shape as health sources).
+    ``fn`` receives the parsed query parameters and returns
+    ``(http_status, json_dict)``; it must be cheap and thread-safe —
+    it runs on the scrape server's threads.  First user: the fleet
+    autoscaler's runtime-settable SLO targets
+    (``/control/fleet/targets``, docs/FLEET.md)."""
+    with _control_lock:
+        _control_handlers[name] = fn
+
+
+def unregister_control_handler(name: str) -> None:
+    with _control_lock:
+        _control_handlers.pop(name, None)
+
+
 # -- HTTP endpoint -----------------------------------------------------------
 
 
@@ -171,6 +200,36 @@ class _Handler(BaseHTTPRequestHandler):
                 sort_keys=True,
             ).encode()
             self._reply(200 if healthy else 503, "application/json", body)
+        elif path.startswith("/control/"):
+            # the scrape surface is read-only and binds all interfaces
+            # by default; the control surface MUTATES (SLO targets) —
+            # loopback peers only, unless the operator opts remote
+            # callers in explicitly (put a real proxy in front then)
+            if not self.client_address[0].startswith("127.") and \
+                    self.client_address[0] != "::1" and \
+                    os.environ.get("HVD_TPU_CONTROL_REMOTE", "") != "1":
+                self._reply(403, "text/plain",
+                            b"control surface is loopback-only "
+                            b"(HVD_TPU_CONTROL_REMOTE=1 opts in)\n")
+                return
+            name = path[len("/control/"):].rstrip("/")
+            with _control_lock:
+                fn = _control_handlers.get(name)
+            if fn is None:
+                self._reply(404, "text/plain", b"no such control\n")
+                return
+            query = self.path.split("?", 1)[1] if "?" in self.path else ""
+            params = {}
+            for pair in query.split("&"):
+                if "=" in pair:
+                    k, v = pair.split("=", 1)
+                    params[unquote(k)] = unquote(v)
+            try:
+                code, payload = fn(params)
+            except Exception as e:
+                code, payload = 400, {"error": f"{type(e).__name__}: {e}"}
+            self._reply(code, "application/json",
+                        json.dumps(payload, sort_keys=True).encode())
         elif path == "/":
             body = (b'<html><body><a href="/metrics">/metrics</a> '
                     b'<a href="/healthz">/healthz</a></body></html>')

@@ -312,6 +312,56 @@ SERVE_KV_BLOCKS_PER_SHARD = gauge(
     "KV blocks resident on each shard of the tensor-sharded pool",
 )
 
+# -- fleet autoscaling + routing (fleet/ — docs/FLEET.md) --------------------
+
+#: Capacity the policy engine last decided the fleet should converge
+#: to (training workers or serving replicas, per the autoscaler's
+#: ``kind`` label) — desired vs the live world-size/replica gauges is
+#: the convergence view.
+FLEET_DESIRED_SIZE = gauge(
+    "hvd_tpu_fleet_desired_size",
+    "Capacity the autoscale policy last decided on, by fleet kind",
+    ["kind"],  # train / serve
+)
+
+#: Applied scale actions, by fleet kind and direction.
+FLEET_SCALE_EVENTS = counter(
+    "hvd_tpu_fleet_scale_events_total",
+    "Scale actions the autoscaler applied, by fleet kind and direction",
+    ["kind", "direction"],  # direction: out / in
+)
+
+#: Serving replicas by lifecycle state (ready/draining); retired
+#: replicas leave the gauge.
+FLEET_REPLICAS = gauge(
+    "hvd_tpu_fleet_replicas",
+    "Serving replicas currently held by the router, by lifecycle state",
+    ["state"],
+)
+
+#: Router placement outcomes: ``affinity`` = prefix-index hit chose
+#: the replica, ``least_queue`` = no cached prefix anywhere (fallback),
+#: ``round_robin`` = the non-affinity baseline mode.
+FLEET_ROUTED = counter(
+    "hvd_tpu_fleet_routed_total",
+    "Requests placed by the fleet router, by placement rule",
+    ["route"],
+)
+
+#: The router's sliding-window p99 TTFT — the SLO signal its policy
+#: evaluates (the per-replica histograms stay the durable record).
+FLEET_ROUTER_P99_TTFT = gauge(
+    "hvd_tpu_fleet_router_p99_ttft_seconds",
+    "Sliding-window p99 time-to-first-token observed by the fleet router",
+)
+
+#: Preemption notices honored: SIGTERM grace -> planned snapshot ->
+#: clean leave (fleet/preemption.py; the chaos ``fleet.preempt`` site).
+FLEET_PREEMPTIONS = counter(
+    "hvd_tpu_fleet_preemptions_total",
+    "Preemption notices this worker honored with a planned leave",
+)
+
 # -- elastic (runner/elastic_driver.py, elastic/worker.py) -------------------
 
 ELASTIC_WORLD_SIZE = gauge(
@@ -379,7 +429,7 @@ RETRY_ATTEMPTS = histogram(
 RECOVERY_SECONDS = gauge(
     "hvd_tpu_recovery_seconds",
     "Wall time of the most recent failure recovery, by phase",
-    ["phase"],  # restart / auto_resume
+    ["phase"],  # restart / auto_resume / planned (preemption leave)
 )
 
 # -- adapters (torch/optimizer.py, keras/callbacks.py) -----------------------

@@ -179,7 +179,10 @@ class ElasticDriver:
         # driver's own environ so _spawn's env copies inherit it
         os.environ.setdefault(wire_auth.SECRET_ENV, wire_auth.make_secret())
 
-        self._lock = threading.Lock()
+        # reentrant: _desired_slots guards the hold map internally and
+        # is called both with and without the lock held (the min-np
+        # refill wait holds it; the discovery reconcile does not)
+        self._lock = threading.RLock()
         self._cv = threading.Condition(self._lock)
         self._workers: Dict[int, _Worker] = {}
         self._blacklist: set = set()  # (host, slot) pairs
@@ -193,6 +196,21 @@ class ElasticDriver:
         # a live worker reported control-plane failure ("failing" line):
         # drives a failure=True reset epoch even with no process exit
         self._failure_reported = False
+        # -- fleet autoscaling state (docs/FLEET.md) -----------------------
+        # explicit world-size target (request_world_size); None = track
+        # discovery capacity, the pre-fleet behavior, unchanged
+        self._world_target: Optional[int] = None
+        # wake the discovery poll immediately after a resize request
+        self._poll_asap = False
+        # a 'leaving' worker's clean exit must trigger a planned reset
+        # epoch for the survivors (the preemption path: the worker
+        # leaves FIRST, unlike driver-ordered scale-down)
+        self._leaver_exited = False
+        # (host, slot) -> monotonic expiry: slots vacated by preemption
+        # are held against immediate refill (the machine is going away;
+        # discovery is the authority again once the hold expires)
+        self._slot_hold: Dict[Tuple[str, int], float] = {}
+        self._autoscaler = None
 
     # -- server ------------------------------------------------------------
 
@@ -272,6 +290,31 @@ class ElasticDriver:
                 with self._cv:
                     self._failure_reported = True
                     self._cv.notify_all()
+            elif msg.get("type") == "leaving":
+                # planned departure (preemption notice): mark the
+                # worker leaving BEFORE its exit 0 can be observed (so
+                # it books as a scale-down, not job completion) and
+                # hold its slot against an immediate refill
+                get_logger().warning(
+                    "elastic: worker %s leaving (planned): %s",
+                    wid, msg.get("reason", ""))
+                with self._cv:
+                    w = self._workers.get(wid)
+                    if w is not None:
+                        w.leaving = True
+                        self._slot_hold[(w.host, w.slot)] = (
+                            time.monotonic() + env_float(
+                                "HVD_TPU_FLEET_REFILL_HOLD", 60.0))
+                    self._cv.notify_all()
+                # ack on the same connection: the worker's leave path
+                # waits for this before exiting, so the 'leaving' mark
+                # is BOOKED (not merely in a socket buffer) when the
+                # exit 0 lands — a slow reader thread can't mis-book
+                # the preemption as job completion
+                try:
+                    conn.sendall(_signed_line({"type": "leaving_ack"}))
+                except OSError:
+                    pass
 
     # -- worker lifecycle --------------------------------------------------
 
@@ -341,6 +384,11 @@ class ElasticDriver:
                         # exiting 0 is just a scale-down; elasticity must
                         # survive it.)
                         self._completing = True
+                    elif code == 0 and w.leaving:
+                        # a preempted worker left on its own (unlike a
+                        # driver-ordered scale-down, where the epoch ran
+                        # first): the survivors need a planned reset epoch
+                        self._leaver_exited = True
                     if code != 0:
                         log.warning(
                             "elastic: worker %d (%s:%d) failed with exit "
@@ -357,14 +405,64 @@ class ElasticDriver:
         return {(w.host, w.slot) for w in self._workers.values() if w.alive}
 
     def _desired_slots(self, hosts: List[Tuple[str, int]]) -> List[Tuple[str, int]]:
+        # the hold map is written by the notification-reader thread
+        # (a 'leaving' report) — expire + snapshot it under the lock
+        # (reentrant, so callers already holding _cv are fine)
+        now = time.monotonic()
+        with self._cv:
+            for k in [k for k, exp in self._slot_hold.items()
+                      if exp <= now]:
+                del self._slot_hold[k]
+            held = set(self._slot_hold)
         slots = []
         for h, n in hosts:
             for s in range(n):
-                if (h, s) not in self._blacklist:
+                if (h, s) not in self._blacklist and (h, s) not in held:
                     slots.append((h, s))
         if self.max_np is not None:
             slots = slots[: self.max_np]
+        # the autoscaler's explicit target caps capacity-tracking: the
+        # LOWEST slots stay, so a shrink always removes the same
+        # (deterministic) members and a later grow refills from where
+        # it shrank
+        if self._world_target is not None:
+            slots = slots[: self._world_target]
         return slots
+
+    # -- autoscaler entry point (docs/FLEET.md) ----------------------------
+
+    def request_world_size(self, n: Optional[int]) -> int:
+        """Resize the training world to ``n`` workers, honored at the
+        next epoch boundary: the discovery reconcile spawns into free
+        (non-blacklisted, non-held) slots to grow, or marks the
+        highest-slot members ``leaving`` to shrink — those members get
+        the driver's ``shutdown`` reply at the rendezvous their next
+        commit check delivers them to, so no step is ever cut mid-air.
+        The explicit entry point the fleet autoscaler calls instead of
+        faking failures (upstream elastic's only lever, SURVEY §5.3).
+
+        ``n`` is clamped to ``[min_np, max_np]``; ``None`` returns the
+        driver to pure capacity tracking (every discovered slot, the
+        pre-fleet behavior).  Thread-safe; returns the clamped target
+        (or -1 for None).  Fewer discovered slots than the target is
+        not an error — the world converges as far as capacity allows,
+        and further when discovery finds more."""
+        with self._cv:
+            if n is not None:
+                n = max(self.min_np, int(n))
+                if self.max_np is not None:
+                    n = min(n, self.max_np)
+            self._world_target = n
+            self._poll_asap = True
+            self._cv.notify_all()
+        get_logger().info("elastic: world-size target set to %s", n)
+        return -1 if n is None else n
+
+    def current_world(self) -> int:
+        """Live, non-leaving workers — the autoscaler's ``current``."""
+        with self._cv:
+            return sum(1 for w in self._workers.values()
+                       if w.alive and not w.leaving)
 
     # -- rendezvous epoch --------------------------------------------------
 
@@ -508,6 +606,36 @@ class ElasticDriver:
                       file=sys.stderr)
         return True
 
+    def _reconcile(self, hosts: List[Tuple[str, int]],
+                   local_addr: str) -> bool:
+        """Converge the spawned-worker set onto the desired slot set
+        (discovery capacity minus blacklist/holds, capped by max-np and
+        the autoscaler's :meth:`request_world_size` target): spawn into
+        added slots, mark workers on removed slots ``leaving`` (they
+        stay members until the next rendezvous hands them ``shutdown``
+        — the epoch boundary).  Returns whether membership changed, so
+        the caller drives the reset epoch.  A method (not loop-inline)
+        so resize unit tests exercise both directions processlessly."""
+        desired = set(self._desired_slots(hosts))
+        with self._cv:
+            occupied = self._occupied_slots()
+            added = desired - occupied
+            # already-leaving workers are in flight toward their
+            # shutdown reply — re-marking them every poll would spin
+            # membership epochs until they exit
+            removed = {(w.host, w.slot) for w in self._alive_workers()
+                       if not w.leaving} - desired
+            if not added and not removed:
+                return False
+            for w in self._alive_workers():
+                if (w.host, w.slot) in removed:
+                    # keep it alive through the next rendezvous; it
+                    # exits after the "shutdown" reply
+                    w.leaving = True
+            for h, s in sorted(added):
+                self._spawn(h, s, local_addr)
+        return True
+
     # -- main loop ---------------------------------------------------------
 
     def run(self) -> int:
@@ -531,6 +659,8 @@ class ElasticDriver:
             return self._run(driver_addr, host)
         finally:
             self._shutdown = True
+            if self._autoscaler is not None:
+                self._autoscaler.stop()
             try:
                 self._server.close()
             except OSError:
@@ -545,6 +675,15 @@ class ElasticDriver:
 
     def _run(self, driver_addr: str, driver_host: str) -> int:
         log = get_logger()
+        # a resize plan whose first entry is t=0 sets the INITIAL world
+        # target too (the autoscaler only starts after the first
+        # rendezvous — without this, a "start at 2 of 4 slots" drill
+        # would boot at capacity and immediately shrink)
+        from ..fleet.policy import plan_from_env
+
+        plan = plan_from_env()
+        if plan is not None and plan.plan[0][0] <= 0:
+            self.request_world_size(plan.plan[0][1])
         # wait for the initial host set to satisfy min_np
         deadline = time.time() + self.timeout
         while True:
@@ -566,6 +705,18 @@ class ElasticDriver:
                 self._spawn(h, s, local_addr)
         if not self._complete_rendezvous(driver_host):
             return 1
+
+        # fleet autoscaler (docs/FLEET.md): a timed drill plan
+        # (HVD_TPU_FLEET_PLAN) or armed SLO targets start the loop
+        # that drives request_world_size; nothing set = pre-fleet
+        # capacity tracking, untouched
+        from ..fleet.autoscaler import maybe_training_autoscaler
+
+        self._autoscaler = maybe_training_autoscaler(
+            self.request_world_size, self.current_world,
+            min_size=self.min_np, max_size=self.max_np)
+        if self._autoscaler is not None:
+            self._autoscaler.start()
 
         last_poll = time.time()
         while True:
@@ -591,9 +742,20 @@ class ElasticDriver:
                 )
                 return 0 if ok else 1
 
+            # a leaving (preempted) worker's clean exit happened: the
+            # survivors need a planned reset epoch NOW, and a resize
+            # request wants its reconcile before the next poll tick
+            with self._cv:
+                if self._leaver_exited:
+                    self._leaver_exited = False
+                    membership_changed = True
+                poll_now = self._poll_asap
+                self._poll_asap = False
+
             # discovery poll (suspended once the job is completing)
-            if not getattr(self, "_completing", False) and \
-                    time.time() - last_poll >= self.poll_interval:
+            if not getattr(self, "_completing", False) and (
+                    poll_now
+                    or time.time() - last_poll >= self.poll_interval):
                 last_poll = time.time()
                 try:
                     hosts = self.discovery.find_available_hosts()
@@ -601,21 +763,8 @@ class ElasticDriver:
                     log.warning("elastic: discovery failed: %s", e)
                     hosts = None
                 if hosts is not None:
-                    desired = set(self._desired_slots(hosts))
-                    occupied = self._occupied_slots()
-                    added = desired - occupied
-                    removed = occupied - desired
-                    if added or removed:
-                        membership_changed = True
-                        with self._cv:
-                            for w in self._alive_workers():
-                                if (w.host, w.slot) in removed:
-                                    # keep it alive through the next
-                                    # rendezvous; it exits after the
-                                    # "shutdown" reply
-                                    w.leaving = True
-                            for h, s in sorted(added):
-                                self._spawn(h, s, local_addr)
+                    membership_changed |= self._reconcile(hosts,
+                                                          local_addr)
 
             # a worker that exec-restarted itself (failure recovery) shows
             # up as an out-of-band rendezvous request: serve it with a new

@@ -343,7 +343,15 @@ class ServingEngine:
             watermark=serve.watermark, max_decode_batch=max_batch,
             max_seq_len=cfg.max_seq_len)
         # queue depth = scheduler pending + device-staged-but-undrained
+        # (the satellite-pinned honesty contract: the gauge and the
+        # fleet router's least-queue fallback read the same sum —
+        # scheduler.queue_depth() — and _drain_staging re-books it
+        # every step so staged rows are never invisible between
+        # scheduler events)
         self.scheduler.staged_depth = lambda: len(self._staging_meta)
+        #: intake gate: False = draining (fleet replica teardown) —
+        #: submit/attach_source reject, in-flight work keeps stepping
+        self.accepting = True
         self.results: Dict[int, np.ndarray] = {}
         self._ids_seen: set = set()
         #: set to a list to record (request_id, emit_time, arrival) per
@@ -542,6 +550,9 @@ class ServingEngine:
     def submit(self, prompt, max_new_tokens: int, *, eos_id=None,
                arrival: Optional[float] = None) -> int:
         """Enqueue one request; returns its id (key into ``results``)."""
+        if not self.accepting:
+            raise RuntimeError(
+                "engine is draining (accepting=False); submit rejected")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         self._validate_request(len(prompt), max_new_tokens)
         req = Request(
@@ -574,6 +585,9 @@ class ServingEngine:
         """Open-loop intake: stage ``requests`` (an iterator that may
         block until each request's arrival time) through the device
         prefetcher while steps compute."""
+        if not self.accepting:
+            raise RuntimeError(
+                "engine is draining (accepting=False); source rejected")
         if self._staging is not None and not self._source_done:
             raise RuntimeError("a request source is already attached")
         gen = self._stage_rows(requests)
@@ -592,8 +606,10 @@ class ServingEngine:
             block = False  # at most one blocking wait per drain
             if item is self._staging.EXHAUSTED:
                 self._source_done = True
+                self.scheduler._book()  # staged rows just became pending
                 return
             if item is None:
+                self.scheduler._book()  # refresh staged-depth gauge
                 return
             req = self._staging_meta.popleft()
             # caller-chosen ids and submit()'s counter share `results`:

@@ -226,14 +226,39 @@ class BlockAllocator:
             self._meta[block] = (h, parent_hash, toks)
         return h
 
+    def _walk_prefix(self, tokens: Sequence[int],
+                     max_blocks: Optional[int]):
+        """Yield ``(block, chain_hash)`` per verified cached block of
+        ``tokens``' block-aligned prefix, in order: ONE definition of
+        the chain rules (hash chaining from :data:`PREFIX_HASH_ROOT`,
+        index lookup, full parent + token-id compare so collisions are
+        rejected) shared by the side-effecting :meth:`match_prefix`
+        and the read-only :meth:`peek_prefix` — the router's placement
+        score must agree with what admission will actually match."""
+        bs = self.block_size
+        n_full = len(tokens) // bs
+        if max_blocks is not None:
+            n_full = min(n_full, max_blocks)
+        parent = PREFIX_HASH_ROOT
+        for i in range(n_full):
+            toks = tuple(int(t) for t in tokens[i * bs:(i + 1) * bs])
+            h = self.hash_fn(parent, toks)
+            b = self._index.get(h)
+            if b is None:
+                return
+            _h, m_parent, m_tokens = self._meta[b]
+            if m_parent != parent or m_tokens != toks:
+                return  # hash collision — the full compare rejects it
+            yield b, h
+            parent = h
+
     def match_prefix(self, tokens: Sequence[int],
                      max_blocks: Optional[int] = None
                      ) -> Tuple[List[int], List[int]]:
         """Longest cached block-aligned prefix of ``tokens``: walks the
-        hash chain over full blocks, confirms every index hit with a
-        full token-id + parent compare (hash-collision safety), and
-        bumps the refcount of each matched block (un-parking it from
-        the LRU) — the caller now owns one reference and releases it
+        hash chain over full blocks (:meth:`_walk_prefix`) and bumps
+        the refcount of each matched block (un-parking it from the
+        LRU) — the caller now owns one reference and releases it
         through :meth:`free` like any other block.  ``max_blocks`` caps
         the match (the scheduler passes ``(len(prompt) - 1) //
         block_size`` so at least one prompt token is always left to
@@ -241,30 +266,29 @@ class BlockAllocator:
         (block ids, chain hashes), both possibly empty."""
         if not self.prefix_cache:
             return [], []
-        bs = self.block_size
-        n_full = len(tokens) // bs
-        if max_blocks is not None:
-            n_full = min(n_full, max_blocks)
         blocks: List[int] = []
         hashes: List[int] = []
-        parent = PREFIX_HASH_ROOT
-        for i in range(n_full):
-            toks = tuple(int(t) for t in tokens[i * bs:(i + 1) * bs])
-            h = self.hash_fn(parent, toks)
-            b = self._index.get(h)
-            if b is None:
-                break
-            _h, m_parent, m_tokens = self._meta[b]
-            if m_parent != parent or m_tokens != toks:
-                break  # hash collision — the full compare rejects it
+        for b, h in self._walk_prefix(tokens, max_blocks):
             if self._ref[b] == 0:
                 self._lru.pop(b, None)
             self._ref[b] += 1
             blocks.append(b)
             hashes.append(h)
-            parent = h
         self.peak_occupancy = max(self.peak_occupancy, self.occupancy())
         return blocks, hashes
+
+    def peek_prefix(self, tokens: Sequence[int],
+                    max_blocks: Optional[int] = None) -> int:
+        """How many leading full blocks of ``tokens`` the index holds —
+        :meth:`match_prefix` minus every side effect (no refcount
+        bumps, no LRU un-parking, no peak-occupancy update).  This is
+        the published prefix index the fleet router scores replicas by
+        (prefix-affinity placement, docs/FLEET.md): the probe must be
+        free to run against N replicas per request, and only the
+        winning replica's admission may take references."""
+        if not self.prefix_cache:
+            return 0
+        return sum(1 for _ in self._walk_prefix(tokens, max_blocks))
 
     def clear_cache(self) -> None:
         """Drop every prefix-cache entry (bench A/B legs): parked

@@ -164,17 +164,26 @@ class ContinuousBatchingScheduler:
         self.prefix_lookup_blocks = 0
         #: extra waiting requests not yet in ``pending`` (the engine
         #: points this at its device-staging queue so the queue-depth
-        #: gauge counts staged + pending, as documented)
+        #: gauge counts staged + pending, as documented; a standalone
+        #: scheduler has no staging queue, hence 0)
         self.staged_depth = lambda: 0
 
     # -- bookkeeping ---------------------------------------------------------
+
+    def queue_depth(self) -> int:
+        """Requests waiting for admission: scheduler-pending plus
+        device-staged-but-undrained.  THE number behind the
+        ``hvd_tpu_serve_queue_depth`` gauge and the fleet router's
+        least-queue-depth fallback — both must see the same sum, so
+        both read it here (pinned by tests/test_serving.py)."""
+        return len(self.pending) + self.staged_depth()
 
     def submit(self, seq: Sequence) -> None:
         self.pending.append(seq)
         self._book()
 
     def _book(self) -> None:
-        _instr.SERVE_QUEUE_DEPTH.set(len(self.pending) + self.staged_depth())
+        _instr.SERVE_QUEUE_DEPTH.set(self.queue_depth())
         _instr.SERVE_KV_OCCUPANCY.set(self.allocator.occupancy())
         _instr.SERVE_KV_CACHED.set(
             self.allocator.cached_blocks / self.allocator.capacity)

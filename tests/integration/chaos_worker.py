@@ -60,6 +60,17 @@ def main():
     state = cls(step=0, weight=np.zeros(()))
     state.enable_auto_resume(ckpt_dir, step_attr="step")
 
+    # preemption guard (docs/FLEET.md): SIGTERM (or a fleet.preempt
+    # chaos drill) -> planned snapshot -> clean leave; the logged
+    # "leave" event carries the planned_s the soak bounds
+    from horovod_tpu.fleet.preemption import PreemptionGuard
+
+    PreemptionGuard(
+        state,
+        on_leave=lambda info: log(logdir, event="leave",
+                                  rank=hvd.cross_rank(), **info),
+    ).install()
+
     log(logdir, event="init", rank=hvd.cross_rank(), world=hvd.cross_size(),
         pid=os.getpid())
 
@@ -73,8 +84,12 @@ def main():
     def train(state):
         # first visible step after boot/reset: >0 here on a FRESH worker
         # proves checkpoint auto-resume kicked in (it had no snapshot)
+        from horovod_tpu.elastic import worker as _ew
+
+        stats = _ew.last_restart_stats
         log(logdir, event="boot", step=int(state.step),
-            rank=hvd.cross_rank(), world=hvd.cross_size())
+            rank=hvd.cross_rank(), world=hvd.cross_size(),
+            restart_total_s=(stats["total_s"] if stats else None))
         while state.step < batches:
             state.weight = np.asarray(state.weight) + 1.0
             state.step = int(state.step) + 1

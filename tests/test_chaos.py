@@ -290,8 +290,9 @@ def test_auto_resume_noop_without_enable_or_checkpoint(tmp_path):
 @pytest.mark.integration
 def test_chaos_soak_end_to_end():
     """Full recovery proof: kill + checkpoint auto-resume, native frame
-    corruption + exec-restart recovery, seeded replay, idle overhead.
-    See tools/chaos_soak.py."""
+    corruption + exec-restart recovery, the fleet autoscale 2->4->2
+    plan under an injected kill, the fleet.preempt SIGTERM-grace leave,
+    seeded replay, idle overhead.  See tools/chaos_soak.py."""
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "chaos_soak.py")],
         cwd=REPO, timeout=900, capture_output=True, text=True,

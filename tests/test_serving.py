@@ -674,3 +674,95 @@ def test_pool_watermark_defers_admission(model_and_params):
     # watermark 6 -> at most 5 admitted (16 - 5*2 = 6)
     assert 0 < len(admitted) <= 5
     assert eng.allocator.free_blocks >= 6
+
+
+# -- PR 13: queue-depth honesty, the published prefix index, drain ----------
+
+
+def test_queue_depth_gauge_counts_staged_rows(model_and_params):
+    """The ISSUE-13 satellite pin: ``hvd_tpu_serve_queue_depth`` must
+    count device-STAGED rows (attach_source's prefetcher queue), not
+    just scheduler-pending ones — the fleet router's least-queue
+    fallback reads the same sum (scheduler.queue_depth()), so an
+    undercount would route new load onto a replica that is already
+    backed up behind its staging queue."""
+    import time as _time
+
+    cfg, _, params = model_and_params
+    eng = ServingEngine(cfg, params, serve=ServeConfig(
+        block_size=8, num_blocks=0, token_budget=128, watermark=2,
+        decode_tiers=(1, 2)))
+    reqs = [Request(id=i, prompt=np.ones((8,), np.int32),
+                    max_new_tokens=2) for i in range(6)]
+    eng.attach_source(iter(reqs), depth=8)
+    # the staging producer runs on its own thread: wait until it has
+    # staged every row (meta appended at yield time, before device put)
+    deadline = _time.time() + 10
+    while len(eng._staging_meta) < 6 and _time.time() < deadline:
+        _time.sleep(0.01)
+    assert len(eng._staging_meta) == 6, "staging never filled"
+    # nothing drained yet: pending==0, staged==6 — the sum is 6, on
+    # both the router's read and (after a booking pass) the gauge
+    assert eng.scheduler.queue_depth() == 6
+    eng.scheduler._book()
+    assert _instr.SERVE_QUEUE_DEPTH.get() == 6
+    # draining moves rows staged -> pending -> admitted; the gauge
+    # tracks the honest waiting count at every step of the way
+    eng._drain_staging(block=True)
+    assert eng.scheduler.queue_depth() == len(eng.scheduler.pending) \
+        + len(eng._staging_meta)
+    assert _instr.SERVE_QUEUE_DEPTH.get() == eng.scheduler.queue_depth()
+    eng.run()
+    assert _instr.SERVE_QUEUE_DEPTH.get() == 0
+
+
+def test_peek_prefix_matches_match_prefix_without_side_effects():
+    """peek_prefix (the router's placement probe) agrees with
+    match_prefix on the match length but moves NO state: refcounts,
+    LRU order and peak occupancy are untouched."""
+    alloc = BlockAllocator(num_blocks=12, block_size=4)
+    stream = np.arange(1, 13, dtype=np.int32)  # 3 full blocks
+    blocks = alloc.alloc(3)
+    parent = PREFIX_HASH_ROOT
+    for i, b in enumerate(blocks):
+        parent = alloc.register(b, parent, stream[i * 4:(i + 1) * 4])
+    alloc.free(blocks)  # ref 0 -> parked on the LRU, still matchable
+    refs_before = list(alloc._ref)
+    lru_before = list(alloc._lru)
+    peak_before = alloc.peak_occupancy
+    assert alloc.peek_prefix(stream) == 3
+    assert alloc.peek_prefix(stream, max_blocks=2) == 2
+    assert alloc.peek_prefix(stream[:7]) == 1  # one full block only
+    assert alloc.peek_prefix(np.flip(stream)) == 0
+    assert list(alloc._ref) == refs_before, "peek bumped a refcount"
+    assert list(alloc._lru) == lru_before, "peek un-parked a block"
+    assert alloc.peak_occupancy == peak_before
+    # the real match still works afterwards and DOES take references
+    matched, _ = alloc.match_prefix(stream)
+    assert len(matched) == 3 and all(alloc.ref(b) == 1 for b in matched)
+    # collision safety: peek confirms content like match_prefix does
+    alloc2 = BlockAllocator(num_blocks=6, block_size=4)
+    alloc2.hash_fn = lambda parent, toks: 7  # every block collides
+    b2 = alloc2.alloc(1)
+    alloc2.register(b2[0], PREFIX_HASH_ROOT, stream[:4])
+    assert alloc2.peek_prefix(stream[:4]) == 1
+    assert alloc2.peek_prefix(np.flip(stream[:4]).copy()) == 0
+
+
+def test_engine_drain_gate_rejects_new_intake(model_and_params):
+    """accepting=False (the fleet drain hook): new submits and sources
+    are rejected, in-flight work steps to completion untouched."""
+    cfg, model, params = model_and_params
+    eng = ServingEngine(cfg, params, serve=ServeConfig(
+        block_size=8, num_blocks=0, token_budget=128, watermark=2,
+        decode_tiers=(1, 2)))
+    prompt = np.arange(1, 9, dtype=np.int32)
+    rid = eng.submit(prompt, max_new_tokens=4)
+    eng.accepting = False
+    with pytest.raises(RuntimeError, match="draining"):
+        eng.submit(prompt, max_new_tokens=4)
+    with pytest.raises(RuntimeError, match="draining"):
+        eng.attach_source(iter(()))
+    out = eng.run()
+    np.testing.assert_array_equal(out[rid],
+                                  ref_decode(model, params, prompt, 4))

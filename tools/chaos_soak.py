@@ -18,6 +18,17 @@ workers' weight bookkeeping.  Scenarios:
                   control plane dies on both ranks, ``commit()``'s
                   liveness poll raises, both workers exec-restart with
                   live snapshots, re-rendezvous, and finish exactly.
+  autoscale       world of 2 with spare slots; the fleet autoscaler's
+                  timed plan (HVD_TPU_FLEET_PLAN) scales 2 -> peak -> 2
+                  through ElasticDriver.request_world_size while chaos
+                  SIGKILLs a member mid-run; exact final counts, peak
+                  reached, every exec-restart bounded.
+  preempt         a chaos kill rule with code=-15 at the fleet.preempt
+                  site SIGTERMs rank 1 (a preemption notice); the
+                  fleet guard takes a planned snapshot, reports
+                  'leaving' and exits 0; the driver books a scale-down
+                  (not a failure), the survivor converges exactly, and
+                  recovery_seconds{phase="planned"} stays bounded.
   replay          the same HVD_TPU_CHAOS_SEED must reproduce the same
                   injection trace, event for event.
   overhead        chaos OFF must cost one module-bool per injection point
@@ -87,7 +98,7 @@ def _read_events(logdir):
 
 
 def _run_job(tmp, *, np_, min_np, max_np, slots, batches, chaos, seed,
-             timeout=420):
+             timeout=420, extra_env=None):
     logdir = os.path.join(tmp, "logs")
     ckpt = os.path.join(tmp, "ckpt")
     os.makedirs(logdir)
@@ -99,7 +110,8 @@ def _run_job(tmp, *, np_, min_np, max_np, slots, batches, chaos, seed,
     if max_np is not None:
         cmd += ["--max-np", str(max_np)]
     cmd += ["--", sys.executable, WORKER, logdir, str(batches), ckpt]
-    env = _env({"HVD_TPU_CHAOS": chaos, "HVD_TPU_CHAOS_SEED": str(seed)})
+    env = _env({"HVD_TPU_CHAOS": chaos, "HVD_TPU_CHAOS_SEED": str(seed),
+                **(extra_env or {})})
     proc = subprocess.run(cmd, env=env, cwd=REPO, timeout=timeout,
                           capture_output=True, text=True)
     return proc, _read_events(logdir)
@@ -167,6 +179,93 @@ def scenario_corrupt_recover(batches, seed):
         return {"resets": len(resets)}
 
 
+def scenario_autoscale(batches, seed, peak=4):
+    """The PR-13 closed-loop scale drill (docs/FLEET.md): the driver's
+    fleet autoscaler runs a timed plan 2 -> peak -> 2 through
+    ``request_world_size`` while chaos SIGKILLs one member mid-run
+    (blacklist + replacement).  Every resize lands as a planned reset
+    epoch at a commit boundary; the final world's members must finish
+    with EXACT counts (scale-up members auto-resume from the fleet
+    checkpoint, never from step 0) and every exec-restart must stay
+    bounded."""
+    # the plan spans scale-up at 6 s and scale-down at 18 s of driver
+    # time; the workers must still be training after both (plus the
+    # injected kill's recovery), so the step count keys off the plan
+    batches = max(batches, 560)  # ~28 s of 0.05 s steps
+    with tempfile.TemporaryDirectory(prefix="chaos_soak_") as tmp:
+        fuse = os.path.join(tmp, "kill.fuse")
+        proc, events = _run_job(
+            tmp, np_=2, min_np=2, max_np=peak, slots=peak + 1,
+            batches=batches,
+            chaos=f"elastic.commit:kill,after=60,rank=1,times=1,fuse={fuse}",
+            seed=seed, timeout=560,
+            extra_env={"HVD_TPU_FLEET_PLAN": f"0:2,6:{peak},18:2"},
+        )
+        assert proc.returncode == 0, (
+            f"job failed rc={proc.returncode}\n{proc.stderr[-4000:]}")
+        dones = [e for e in events if e["event"] == "done"]
+        assert len(dones) == 2, f"expected the scaled-down world of 2 " \
+            f"finishers: {dones}"
+        for d in dones:
+            assert abs(d["weight"] - batches) < 1e-6, f"wrong count: {d}"
+            assert d["world"] == 2, f"final world not 2: {d}"
+        peak_seen = max(e["world"] for e in events if e["event"] == "batch")
+        assert peak_seen == peak, \
+            f"world never reached the plan's peak {peak}: {peak_seen}"
+        assert os.path.exists(fuse), "chaos kill never fired"
+        # scale-up members had no snapshot: step > 0 at boot is the
+        # checkpoint auto-resume (exact counts depend on it)
+        boots = [e for e in events if e["event"] == "boot"]
+        restarts = [e["restart_total_s"] for e in boots
+                    if e.get("restart_total_s")]
+        assert all(r < 120.0 for r in restarts), \
+            f"unbounded exec-restart: {restarts}"
+        return {"peak_world": peak_seen, "finishers": len(dones),
+                "exec_restarts": len(restarts),
+                "max_restart_s": round(max(restarts), 2) if restarts
+                else None}
+
+
+def scenario_preempt(batches, seed):
+    """The preemption path (ISSUE 13 satellite): a chaos ``kill`` rule
+    with a NEGATIVE code at the new ``fleet.preempt`` site delivers
+    SIGTERM to rank 1 mid-training; the fleet guard takes a bounded
+    planned snapshot (HVD_TPU_ELASTIC_PLANNED_SNAPSHOT_SECONDS),
+    checkpoints it, reports 'leaving', and exits 0.  The driver books
+    a scale-down (slot held, planned reset epoch — NOT a failure, NOT
+    job completion), and the survivor converges to the exact count."""
+    batches = max(batches, 160)  # ~8 s: the notice lands ~2.5 s in
+    with tempfile.TemporaryDirectory(prefix="chaos_soak_") as tmp:
+        fuse = os.path.join(tmp, "preempt.fuse")
+        proc, events = _run_job(
+            tmp, np_=2, min_np=1, max_np=2, slots=2, batches=batches,
+            chaos=f"fleet.preempt:kill,code=-15,at=4,rank=1,fuse={fuse}",
+            seed=seed,
+        )
+        assert proc.returncode == 0, (
+            f"job failed rc={proc.returncode}\n{proc.stderr[-4000:]}")
+        assert os.path.exists(fuse), "chaos preemption never fired"
+        leaves = [e for e in events if e["event"] == "leave"]
+        assert len(leaves) == 1, f"expected exactly one leave: {leaves}"
+        leave = leaves[0]
+        # bounded planned recovery: notice -> snapshot -> exit within
+        # the snapshot budget (30 s default) + margin — the
+        # hvd_tpu_recovery_seconds{phase="planned"} bound
+        assert 0 <= leave["planned_s"] < 35.0, leave
+        assert leave["snapshot"] in ("live", "commit"), leave
+        assert leave["step"] > 0, f"preempted before any progress: {leave}"
+        dones = [e for e in events if e["event"] == "done"]
+        assert len(dones) == 1, f"expected 1 finisher: {dones}"
+        assert abs(dones[0]["weight"] - batches) < 1e-6, dones
+        assert dones[0]["world"] == 1, f"survivor world not 1: {dones}"
+        # before the notice the world really was 2 (the leave shrank it)
+        assert any(e["event"] == "batch" and e["world"] == 2
+                   for e in events), "never trained at world 2"
+        return {"leave_step": leave["step"],
+                "planned_s": round(leave["planned_s"], 2),
+                "snapshot": leave["snapshot"]}
+
+
 def _replay_trace(tmp, tag, seed):
     trace = os.path.join(tmp, f"trace_{tag}.jsonl")
     code = (
@@ -221,13 +320,18 @@ def main(argv=None):
     ap.add_argument("--seed", type=int, default=42)
     ap.add_argument("--scenario", default="all",
                     choices=["all", "kill-resume", "corrupt-recover",
-                             "replay", "overhead"])
+                             "autoscale", "preempt", "replay", "overhead"])
+    ap.add_argument("--peak", type=int, default=4,
+                    help="autoscale scenario's peak world (CI smoke: 3)")
     args = ap.parse_args(argv)
 
     runs = {
         "kill-resume": lambda: scenario_kill_resume(args.batches, args.seed),
         "corrupt-recover": lambda: scenario_corrupt_recover(
             args.batches, args.seed),
+        "autoscale": lambda: scenario_autoscale(args.batches, args.seed,
+                                                peak=args.peak),
+        "preempt": lambda: scenario_preempt(args.batches, args.seed),
         "replay": lambda: scenario_replay(args.seed),
         "overhead": scenario_overhead,
     }

@@ -417,6 +417,157 @@ def run_multichip_leg(shards, n_requests, seed, write_json):
     return row
 
 
+def _drive_router(router, load, arrivals, t0=None):
+    """Open-loop drive of a FleetRouter: submit each request at its
+    arrival offset, stepping the fleet in between (the router is
+    single-threaded by design — this loop IS the front end)."""
+    t0 = time.perf_counter() if t0 is None else t0
+    n = len(load)
+    gids = [None] * n
+    i = 0
+    while True:
+        now = time.perf_counter()
+        while i < n and now >= t0 + arrivals[i]:
+            prompt, gen = load[i]
+            gids[i] = router.submit(prompt, gen, arrival=t0 + arrivals[i])
+            i += 1
+            now = time.perf_counter()
+        busy = router.step()
+        if i >= n and not busy and not router._placed:
+            break
+        if not busy and i < n:
+            time.sleep(max(0.0, t0 + arrivals[i] - time.perf_counter()))
+    return gids, time.perf_counter() - t0
+
+
+def _fleet_row(leg, router, gids, wall):
+    ttfts = router.all_ttfts()
+    hits, lookups = router.prefix_stats()
+    toks = sum(len(router.results[g]) for g in gids)
+    peaks = [r.peak_queue_depth for r in router.replicas + router.retired]
+    return {
+        "bench": "serve",
+        "leg": leg,
+        "requests": len(gids),
+        "tokens": toks,
+        "wall_s": round(wall, 4),
+        "throughput_tokens_per_s": round(toks / wall, 2),
+        "replicas": len(router.replicas) + len(router.retired),
+        "ttft_p50_s": round(_percentile(ttfts, 50), 4),
+        "ttft_p99_s": round(_percentile(ttfts, 99), 4),
+        "prefix_hit_rate": round(hits / lookups, 4) if lookups else 0.0,
+        "per_replica_peak_queue_depth": peaks,
+        "routed": dict(router.route_counts),
+        "compile_free": router.all_compile_free(),
+    }
+
+
+def run_fleet_legs(args):
+    """The PR-13 fleet A/B (docs/FLEET.md): N in-process replicas
+    under a ramping open-loop load over shared templates, routed
+    round-robin vs prefix-affinity (fresh replicas per leg, same
+    params, same load), plus an SLO-driven scale leg (start at 1
+    replica, the queue-depth policy grows the fleet under the ramp,
+    drains it back as load falls).  Every leg asserts the standing
+    oracle — placement moves time, never tokens — and zero
+    post-warmup compiles on EVERY replica before reporting."""
+    from horovod_tpu.fleet.policy import Target, TargetTrackingPolicy
+    from horovod_tpu.fleet.router import FleetRouter
+
+    if args.smoke:
+        n, replicas, templates, t_len, s_hi, gen = 72, 2, 6, 48, 8, 6
+        rate_lo, rate_hi = 100.0, 1200.0
+    else:
+        n, replicas, templates, t_len, s_hi, gen = 160, 3, 8, 96, 12, 8
+        rate_lo, rate_hi = 60.0, 900.0
+    cfg = TransformerConfig(
+        vocab_size=128, num_layers=2, num_heads=4, num_kv_heads=2,
+        head_dim=16, max_seq_len=2 * t_len, dtype=jnp.float32,
+        attention_impl="dot", causal=True)
+    params = params_for(cfg)
+    serve_kw = dict(block_size=16, num_blocks=0, token_budget=256,
+                    watermark=2, prefill_tiers=(t_len + 16,),
+                    decode_tiers=(1, 2, 4), prefill_chunk=16)
+
+    def build_engine():
+        return ServingEngine(cfg, params, serve=ServeConfig(**serve_kw))
+
+    rs = np.random.RandomState(args.seed)
+    temps = [rs.randint(1, 120, size=t_len).astype(np.int32)
+             for _ in range(templates)]
+    load = []
+    for _ in range(n):
+        t = temps[int(rs.randint(templates))]
+        sfx = rs.randint(1, 120,
+                         size=int(rs.randint(2, s_hi + 1))).astype(np.int32)
+        load.append((np.concatenate([t, sfx]),
+                     int(rs.randint(1, gen + 1))))
+    # the load RAMP: interarrival shrinks linearly rate_lo -> rate_hi,
+    # so queueing builds through the leg — the regime where placement
+    # (and, in the scale leg, capacity) decides the TTFT tail
+    arrivals = []
+    t = 0.0
+    for i in range(n):
+        rate = rate_lo + (rate_hi - rate_lo) * i / max(n - 1, 1)
+        t += 1.0 / rate
+        arrivals.append(t)
+
+    rows = []
+    outs = {}
+    for mode, leg in (("round_robin", "fleet_rr"),
+                      ("affinity", "fleet_affinity")):
+        router = FleetRouter(build_engine, replicas=replicas, mode=mode)
+        gids, wall = _drive_router(router, load, arrivals)
+        rows.append(_fleet_row(leg, router, gids, wall))
+        outs[leg] = [router.results[g] for g in gids]
+    for i, (a, b) in enumerate(zip(outs["fleet_rr"],
+                                   outs["fleet_affinity"])):
+        if not np.array_equal(a, b):  # placement moves time, not values
+            print(f"FLEET ORACLE MISMATCH on request {i}", file=sys.stderr)
+            return None
+    rr, aff = rows[0], rows[1]
+    aff["affinity_vs_rr"] = {
+        "hit_rate_x": round(aff["prefix_hit_rate"]
+                            / max(rr["prefix_hit_rate"], 1e-9), 3),
+        "ttft_p99_x": round(rr["ttft_p99_s"]
+                            / max(aff["ttft_p99_s"], 1e-9), 3),
+    }
+
+    # -- the SLO-driven scale leg: start at 1 accepting replica with
+    # warm spares parked; the queue-depth policy grows the fleet under
+    # the ramp (unpark = instant, the engines compiled before t0) and
+    # drains it back once the queue empties at the tail
+    policy = TargetTrackingPolicy(
+        [Target("queue_depth", 3.0)], min_size=1, max_size=replicas,
+        deadband=0.1, scale_in_at=0.3, hysteresis=40, cooldown_s=0.3)
+    router = FleetRouter(build_engine, replicas=1, mode="affinity",
+                         policy=policy, spares=replicas - 1)
+    gids, wall = _drive_router(router, load, arrivals)
+    # idle tail: keep ticking the policy so the empty queue scales the
+    # fleet back in and the drain/retire path runs for real
+    tail_deadline = time.perf_counter() + 3.0
+    while time.perf_counter() < tail_deadline and (
+            router.size > 1
+            or any(r.state == "draining" for r in router.replicas)):
+        router.step()
+    row = _fleet_row("fleet_scale", router, gids, wall)
+    row["scale_out_events"] = sum(
+        1 for d, _ in router.scale_events if d == "out")
+    row["scale_in_events"] = sum(
+        1 for d, _ in router.scale_events if d == "in")
+    row["max_replicas"] = max([1] + [s for d, s in router.scale_events
+                                     if d == "out"])
+    row["final_replicas"] = router.size
+    row["retired_replicas"] = len(router.retired)
+    for i, out in enumerate(outs["fleet_rr"]):
+        if not np.array_equal(out, router.results[gids[i]]):
+            print(f"FLEET SCALE ORACLE MISMATCH on request {i}",
+                  file=sys.stderr)
+            return None
+    rows.append(row)
+    return rows
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--smoke", action="store_true",
@@ -429,8 +580,31 @@ def main():
     ap.add_argument("--shards", type=int, default=None,
                     help="tensor-shard factor of the MULTICHIP leg "
                          "(default 8, smoke 2; 0 skips the leg)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="run ONLY the fleet router legs (rr vs "
+                         "prefix-affinity A/B + SLO scale leg)")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
+
+    if args.fleet:
+        rows = run_fleet_legs(args)
+        if rows is None:
+            return 1
+        for row in rows:
+            print(json.dumps(row))
+        rr, aff, sc = rows[0], rows[1], rows[2]
+        print(
+            f"fleet x{rr['replicas']}: affinity hit rate "
+            f"{aff['prefix_hit_rate']} vs rr {rr['prefix_hit_rate']} "
+            f"({aff['affinity_vs_rr']['hit_rate_x']}x), TTFT p99 "
+            f"{aff['ttft_p99_s']}s vs {rr['ttft_p99_s']}s "
+            f"({aff['affinity_vs_rr']['ttft_p99_x']}x); scale leg "
+            f"peaked at {sc['max_replicas']} replicas "
+            f"({sc['scale_out_events']} out / "
+            f"{sc['scale_in_events']} in), oracle token-identical, "
+            f"all replicas compile-free={aff['compile_free'] and rr['compile_free'] and sc['compile_free']}",
+            file=sys.stderr)
+        return 0
 
     if args.smoke:
         n = args.requests or 40
