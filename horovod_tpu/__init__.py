@@ -92,7 +92,7 @@ from .functions import (
     broadcast_optimizer_state,
     broadcast_parameters,
 )
-from . import callbacks, chaos, checkpoint, data, elastic, metrics
+from . import callbacks, chaos, checkpoint, data, elastic, guard, metrics
 from .compression import Compression
 from .sync_batch_norm import SyncBatchNorm
 from .optim import (

@@ -2,7 +2,7 @@
 
 The subsystem that PROVES the recovery machinery works: named injection
 points throughout the framework evaluate a seed-driven plan and, when a
-rule fires, inject one of six faults::
+rule fires, inject one of eight faults::
 
     drop     the caller discards the unit of work (frame, batch)
     delay    sleep ``delay`` seconds, then continue
@@ -11,6 +11,13 @@ rule fires, inject one of six faults::
     kill     SIGKILL this process (the classic elastic fault)
     hang     sleep forever — a live-but-silent worker, the fault only
              heartbeats (not process-exit watching) can see
+    flipbit  flip ONE high-order bit of a numeric payload (ndarray,
+             float, int; bytes get one mid-buffer bit) — the silent-
+             data-corruption model ("Cores that don't count"): a
+             materially wrong VALUE inside a structurally valid
+             container, visible only to integrity checks (guard.*)
+    scale    multiply a numeric payload by ``factor`` (default 1024) —
+             the runaway-gradient model the guard's loss-spike EMA sees
 
 Configured entirely from the environment so any launcher can inject::
 
@@ -37,7 +44,9 @@ from typing import Any, List, Optional
 
 from ..metrics import instruments as _metrics
 from ..utils.logging import get_logger
-from .spec import ACTION_ENUM, ACTIONS, ChaosSpecError, Rule, parse_spec
+from .spec import (
+    ACTION_ENUM, ACTIONS, NATIVE_ACTIONS, ChaosSpecError, Rule, parse_spec,
+)
 
 __all__ = [
     "ChaosInjected", "DROP", "SITES", "active", "clear", "configure",
@@ -64,6 +73,9 @@ SITES = (
     "elastic.commit",          # elastic state commit (per training step)
     "training.step",           # fit_epoch loop body
     "fleet.preempt",           # preemption-notice poll (fleet/preemption.py)
+    "guard.grad",              # per-step gradient tap (guard.py tap_grads)
+    "guard.param",             # cadence param-fingerprint tap (guard.py)
+    "checkpoint.payload",      # checkpoint bytes about to be published
 )
 
 
@@ -208,6 +220,61 @@ def _corrupt(payload: Any) -> Any:
     return payload
 
 
+def _flipbit(payload: Any) -> Any:
+    """Flip ONE bit of a numeric payload, placed high in the element's
+    representation so the value change is material (for little-endian
+    floats bit 6 of the top byte is an exponent bit): the silent-data-
+    corruption model — wrong VALUE, valid container.  Returns None when
+    the payload type carries no flippable value (caller raises)."""
+    import numpy as np
+
+    if isinstance(payload, np.ndarray):
+        out = np.array(payload, copy=True)
+        if out.size == 0 or out.dtype.hasobject:
+            return None
+        flat = out.reshape(-1).view(np.uint8)
+        # middle element's most-significant byte (little-endian
+        # layout), bit 4: a mid-exponent bit for floats — a 2^±32
+        # value change that stays FINITE (flipping the top exponent
+        # bits of a ~1.0 float would make Inf, which the cheap NaN/Inf
+        # sentinel catches; SDC's interesting case is the wrong value
+        # only a digest can see)
+        i = (out.size // 2) * out.itemsize + (out.itemsize - 1)
+        flat[i] ^= 0x10
+        return out
+    if isinstance(payload, (bytes, bytearray)):
+        buf = bytearray(payload)
+        if not buf:
+            return None
+        buf[len(buf) // 2] ^= 0x10
+        return bytes(buf)
+    if isinstance(payload, bool):
+        return not payload
+    if isinstance(payload, int):
+        return payload ^ (1 << 30)
+    if isinstance(payload, float):
+        bits = np.array([payload], np.float64).view(np.uint64)
+        bits[0] ^= np.uint64(1 << 52)  # exponent LSB: a large change
+        return float(bits.view(np.float64)[0])
+    return None
+
+
+def _scale(payload: Any, factor: float) -> Any:
+    """Multiply a numeric payload by ``factor`` (dtype preserved for
+    ndarrays) — the runaway-value model.  None = not scalable."""
+    import numpy as np
+
+    if isinstance(payload, np.ndarray):
+        if payload.dtype.hasobject or payload.dtype.kind in "SUV":
+            return None
+        return np.asarray(payload * factor).astype(payload.dtype)
+    if isinstance(payload, bool):
+        return None  # a scaled bool is a no-op, not a fault
+    if isinstance(payload, (int, float)):
+        return type(payload)(payload * factor)
+    return None
+
+
 def point(site: str, payload: Any = None) -> Any:
     """Evaluate the chaos plan at ``site``.
 
@@ -264,6 +331,18 @@ def point(site: str, payload: Any = None) -> Any:
                 "failure)"
             )
         return _corrupt(payload)
+    if action in ("flipbit", "scale"):
+        out = None if payload is None else (
+            _flipbit(payload) if action == "flipbit"
+            else _scale(payload, fire.factor))
+        if out is None:
+            # nothing numeric to mangle: same inject-as-failure contract
+            # as payload-less corrupt — a counted fault must be a fault
+            raise ChaosInjected(
+                f"chaos: {action} at {site} (no numeric payload; "
+                "injected as failure)"
+            )
+        return out
     if action == "raise":
         raise ChaosInjected(
             f"chaos: injected failure at {site} (eval {fire.evals - 1})"
@@ -325,6 +404,11 @@ def configure_native_lib(lib, rank: Optional[int] = None) -> int:
                 continue
             for a in armed:
                 r = a.rule
+                if r.action not in NATIVE_ACTIONS:
+                    get_logger().warning(
+                        "chaos: action %r is Python-only; %s rule not "
+                        "exported to the native engine", r.action, site)
+                    continue
                 lib.hvdtpu_chaos_set(
                     site.encode(), ACTION_ENUM[r.action],
                     ctypes.c_double(r.prob),

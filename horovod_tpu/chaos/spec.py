@@ -8,7 +8,12 @@ Grammar (documented for users in docs/FAULT_TOLERANCE.md)::
 
 ``site`` is the dotted name of an injection point (the catalogue lives in
 docs/FAULT_TOLERANCE.md; ``horovod_tpu.chaos.SITES`` mirrors it).
-``action`` is one of ``drop | delay | corrupt | raise | kill | hang``.
+``action`` is one of ``drop | delay | corrupt | raise | kill | hang |
+flipbit | scale``.  ``flipbit`` flips ONE high-order bit of a numeric
+payload (ndarray/float/int; bytes get one mid-buffer bit) — the
+Hochschild-style silent-corruption model: the value changes materially,
+the container stays structurally valid.  ``scale`` multiplies a numeric
+payload by ``factor`` — the runaway-gradient / loss-spike model.
 Params:
 
     prob=F    fire probability per evaluation (default 1.0)
@@ -24,6 +29,7 @@ Params:
               (Python sites only) — the preemption drill:
               ``fleet.preempt:kill,code=-15`` is a SIGTERM notice the
               fleet.preemption guard's grace path handles
+    factor=F  multiplier for action=scale (default 1024.0)
     fuse=PATH fire at most once ACROSS process generations: the first
               fire creates PATH (O_EXCL) and any process that finds it
               existing skips the rule.  This is how a kill/corrupt
@@ -42,10 +48,17 @@ import hashlib
 from dataclasses import dataclass, field
 from typing import List, Optional
 
-ACTIONS = ("drop", "delay", "corrupt", "raise", "kill", "hang")
+ACTIONS = ("drop", "delay", "corrupt", "raise", "kill", "hang",
+           "flipbit", "scale")
 
 #: Action enum values shared with the native side (native/src/chaos.h).
+#: The native core implements only the first six; flipbit/scale are
+#: Python-site actions (chaos.configure_native_lib skips them with a
+#: warning when a transport.* rule names one).
 ACTION_ENUM = {name: i + 1 for i, name in enumerate(ACTIONS)}
+
+#: Actions the native engine (chaos.h Action enum) implements.
+NATIVE_ACTIONS = frozenset(ACTIONS[:6])
 
 
 class ChaosSpecError(ValueError):
@@ -63,6 +76,7 @@ class Rule:
     rank: Optional[int] = None
     delay: float = 0.05
     code: int = 137
+    factor: float = 1024.0
     fuse: Optional[str] = None
     # runtime state (per process boot)
     evals: int = field(default=0, compare=False)
@@ -120,6 +134,8 @@ def _parse_rule(text: str) -> Rule:
                 rule.delay = float(value)
             elif key == "code":
                 rule.code = int(value)
+            elif key == "factor":
+                rule.factor = float(value)
             elif key == "fuse":
                 rule.fuse = value
             else:

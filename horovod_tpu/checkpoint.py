@@ -30,6 +30,18 @@ truncated ``ckpt-N`` that :func:`latest_checkpoint` would then resume
 from.  Stale temp files are swept by the same pruning pass that trims
 old checkpoints.
 
+Every write is also CHECKSUMMED: the published file carries a CRC32 of
+its payload in a small header, verified on every read.  Atomicity
+protects against *torn* files; the checksum protects against *lying*
+ones — a bit-flipped or bad-sector checkpoint that still unpickles (or
+unpickles into garbage) would otherwise brick auto-resume or silently
+poison the restored state (docs/FAULT_TOLERANCE.md, silent corruption).
+A checkpoint failing its checksum is skipped with a loud log and the
+readers fall back to the NEXT-OLDEST ring entry instead of raising
+mid-resume; pre-checksum files (no header) still load unverified.  The
+``checkpoint.payload`` chaos site flips bits in the exact bytes about
+to be published, driving the corrupt-latest-checkpoint drill.
+
 Orbax remains the right tool for sharded multi-host checkpoints of very
 large models; these helpers cover the reference's replicated-weights
 contract without extra dependencies.
@@ -41,7 +53,8 @@ import os
 import pickle
 import re
 import time
-from typing import Any, Optional, Tuple
+import zlib
+from typing import Any, List, Optional, Tuple
 
 import flax.serialization
 import jax
@@ -58,6 +71,16 @@ _TMP_RE = re.compile(r"^ckpt-\d+\.tmp\.\d+$")
 #: latest_checkpoint() serves either family).
 _STATE_MAGIC = b"HVDTPU-STATE1\n"
 
+#: Content-integrity header: ``magic + crc32 as 8 hex chars + \n`` wraps
+#: every published payload (either family).  Files without it are
+#: pre-checksum checkpoints and load unverified.
+_CKSUM_MAGIC = b"HVDTPU-CRC32\n"
+_CKSUM_HEAD = len(_CKSUM_MAGIC) + 9  # 8 hex digits + newline
+
+#: directories whose non-state entries peek_state_checkpoint already
+#: warned about (once per process; see the ring-walk comment there)
+_warned_non_state_dirs: set = set()
+
 
 def _is_root() -> bool:
     return not basics.is_initialized() or basics.rank() == 0
@@ -66,8 +89,23 @@ def _is_root() -> bool:
 def _atomic_publish(directory: str, name: str, payload: bytes) -> str:
     """Write ``payload`` to ``<directory>/<name>`` crash-atomically:
     unique same-directory temp (two savers can't collide), fsync, then
-    ``os.replace`` — readers only ever see absent or complete files."""
+    ``os.replace`` — readers only ever see absent or complete files.
+    The payload is wrapped in a CRC32 header so readers can tell a
+    lying file from a true one (module docstring); the
+    ``checkpoint.payload`` chaos site sees the exact bytes about to hit
+    disk (post-checksum, so an injected flip is DETECTABLE — a ``drop``
+    rule silently loses the write, the lost-checkpoint fault)."""
+    from . import chaos as _chaos
+
+    # the directory must exist even when a DROP rule loses the write:
+    # the caller's pruning pass lists it unconditionally
     os.makedirs(directory, exist_ok=True)
+    payload = (_CKSUM_MAGIC + b"%08x\n" % zlib.crc32(payload) + payload)
+    if _chaos.active:
+        out = _chaos.point("checkpoint.payload", payload)
+        if out is _chaos.DROP:
+            return os.path.join(directory, name)  # write silently lost
+        payload = out
     path = os.path.join(directory, name)
     tmp = f"{path}.tmp.{os.getpid()}"
     try:
@@ -127,15 +165,72 @@ def _prune(directory: str, keep: int) -> None:
             pass  # a concurrent pruner (elastic restart race) got it
 
 
-def latest_checkpoint(directory: str) -> Optional[str]:
+def _ring_newest_first(directory: str) -> List[Tuple[int, str]]:
+    """Every ``ckpt-N`` in the directory as ``(step, path)``, newest
+    first — the fallback order corrupt-file recovery walks."""
     if not os.path.isdir(directory):
-        return None
+        return []
     ckpts = sorted(
-        (int(m.group(1)), name)
-        for name in os.listdir(directory)
-        if (m := _CKPT_RE.match(name))
+        ((int(m.group(1)), name)
+         for name in os.listdir(directory)
+         if (m := _CKPT_RE.match(name))),
+        reverse=True,
     )
-    return os.path.join(directory, ckpts[-1][1]) if ckpts else None
+    return [(step, os.path.join(directory, name)) for step, name in ckpts]
+
+
+def latest_checkpoint(directory: str) -> Optional[str]:
+    ring = _ring_newest_first(directory)
+    return ring[0][1] if ring else None
+
+
+def _read_verified(path: str) -> Optional[bytes]:
+    """Read a checkpoint file and verify its content checksum.  Returns
+    the inner payload, or None (with a LOUD log) when the stored CRC32
+    does not match — a torn/bit-flipped/lying file the caller must skip.
+    Files without the checksum header (pre-checksum format) pass
+    through unverified."""
+    with open(path, "rb") as f:
+        blob = f.read()
+    if not blob.startswith(_CKSUM_MAGIC):
+        return blob  # pre-checksum checkpoint: load unverified
+    from .utils.logging import get_logger
+
+    head = blob[len(_CKSUM_MAGIC):_CKSUM_HEAD]
+    payload = blob[_CKSUM_HEAD:]
+    try:
+        want = int(head[:8], 16)
+    except ValueError:
+        want = -1
+    got = zlib.crc32(payload)
+    if got != want:
+        get_logger().error(
+            "checkpoint: %s FAILED its content checksum (stored %s, "
+            "computed %08x) — corrupt or torn file; SKIPPING it and "
+            "falling back to the next-oldest ring entry",
+            path, head[:8].decode("ascii", "replace"), got,
+        )
+        return None
+    return payload
+
+
+def discard_newer_than(directory: str, step: int) -> List[str]:
+    """Remove every ``ckpt-N`` with ``N > step`` — the guard's rollback
+    primitive: checkpoints written after the last *verified* step are
+    inside the poisoned window and must not win auto-resume
+    (docs/FAULT_TOLERANCE.md, silent corruption).  Concurrent-survivor
+    safe (a peer pruning the same ring is tolerated).  Returns the
+    removed paths."""
+    removed = []
+    for s, path in _ring_newest_first(directory):
+        if s <= step:
+            break
+        try:
+            os.remove(path)
+            removed.append(path)
+        except OSError:
+            pass  # a concurrent survivor's rollback got it first
+    return removed
 
 
 def checkpoint_step(path: str) -> Optional[int]:
@@ -146,50 +241,65 @@ def checkpoint_step(path: str) -> Optional[int]:
 
 def restore_checkpoint(directory: str, state: Any,
                        broadcast: bool = True) -> Any:
-    """Restore the latest checkpoint into ``state``'s structure.
+    """Restore the latest USABLE checkpoint into ``state``'s structure.
 
     With ``broadcast=True`` only rank 0 needs to see the file; the loaded
     state is broadcast to all workers (reference resume flow:
     load-on-root + broadcast_parameters/broadcast_optimizer_state).
-    Returns ``state`` unchanged when no checkpoint exists.
+    Returns ``state`` unchanged when no checkpoint exists.  A newest
+    entry failing its content checksum (or msgpack-undecodable) is
+    skipped with a loud log and the next-oldest ring entry loads
+    instead — a bit-flipped file degrades resume by one save, never
+    bricks it.
     """
-    path = latest_checkpoint(directory)
     multi = basics.is_initialized() and basics.cross_size() > 1
     if not multi:
-        if path is None:
-            return state
-        return _read_pytree(path, state)
+        loaded = _load_latest_pytree(directory, state)
+        return state if loaded is None else loaded
 
     if broadcast:
-        found = functions.broadcast_object(path is not None, root_rank=0)
+        loaded = (_load_latest_pytree(directory, state)
+                  if basics.rank() == 0 else None)
+        found = functions.broadcast_object(loaded is not None, root_rank=0)
         if not found:
             return state
-        if basics.rank() == 0:
-            loaded = _read_pytree(path, state)
-        else:
-            loaded = state
-        host = jax.tree_util.tree_map(np.asarray, loaded)
+        host = jax.tree_util.tree_map(
+            np.asarray, loaded if loaded is not None else state)
         return functions.broadcast_object(host, root_rank=0)
 
-    if path is None:
-        return state
-    return _read_pytree(path, state)
+    loaded = _load_latest_pytree(directory, state)
+    return state if loaded is None else loaded
 
 
-def _read_pytree(path: str, state: Any) -> Any:
-    with open(path, "rb") as f:
-        payload = f.read()
-    if payload.startswith(_STATE_MAGIC):
-        # a pickled elastic-state checkpoint landed in this directory:
-        # say so instead of surfacing a bare msgpack decode error (and
-        # crash-looping a resuming job on it)
-        raise ValueError(
-            f"{path} is an elastic STATE checkpoint "
-            "(save_state_checkpoint format); restore it with "
-            "restore_state_checkpoint / state.enable_auto_resume, or "
-            "keep pytree and state checkpoints in separate directories"
-        )
-    return flax.serialization.from_bytes(state, payload)
+def _load_latest_pytree(directory: str, state: Any) -> Optional[Any]:
+    """Newest-first ring walk: skip checksum-failed and undecodable
+    entries (loudly); None when nothing usable remains."""
+    from .utils.logging import get_logger
+
+    for _step, path in _ring_newest_first(directory):
+        payload = _read_verified(path)
+        if payload is None:
+            continue  # checksum failure already logged loudly
+        if payload.startswith(_STATE_MAGIC):
+            # a pickled elastic-state checkpoint landed in this
+            # directory: say so instead of surfacing a bare msgpack
+            # decode error (and crash-looping a resuming job on it)
+            raise ValueError(
+                f"{path} is an elastic STATE checkpoint "
+                "(save_state_checkpoint format); restore it with "
+                "restore_state_checkpoint / state.enable_auto_resume, or "
+                "keep pytree and state checkpoints in separate "
+                "directories"
+            )
+        try:
+            return flax.serialization.from_bytes(state, payload)
+        except Exception as e:
+            get_logger().error(
+                "checkpoint: %s undecodable (%s: %s); skipping it and "
+                "falling back to the next-oldest ring entry",
+                path, type(e).__name__, e,
+            )
+    return None
 
 
 # -- elastic object-state checkpoints (auto-resume feed) ---------------------
@@ -224,31 +334,51 @@ def save_state_checkpoint(directory: str, state: Any, step: int,
 
 
 def peek_state_checkpoint(directory: str) -> Optional[Tuple[int, Any]]:
-    """Load the latest state checkpoint as ``(step, snapshot)`` without
-    touching any live state; None when the directory holds none (or only
-    pytree-format checkpoints)."""
-    path = latest_checkpoint(directory)
-    if path is None:
-        return None
-    try:
-        with open(path, "rb") as f:
-            head = f.read(len(_STATE_MAGIC))
-            if head != _STATE_MAGIC:
-                return None  # a flax pytree checkpoint, not a state one
-            blob = pickle.loads(f.read())
-        return int(blob["step"]), blob["snapshot"]
-    # a corrupt/alien file can raise nearly anything out of pickle
-    # (UnpicklingError, ValueError, AttributeError for a moved class...)
-    except Exception as e:
-        from .utils.logging import get_logger
+    """Load the newest USABLE state checkpoint as ``(step, snapshot)``
+    without touching any live state; None when the directory holds none
+    (or only pytree-format checkpoints).
 
-        # resumability must not crash-loop a booting worker on one bad
-        # file (version skew, torn disk): warn and resume without it
-        get_logger().error(
-            "checkpoint: %s unusable (%s: %s); ignoring it",
-            path, type(e).__name__, e,
-        )
-        return None
+    Usable means: content checksum verifies (or pre-checksum format)
+    AND the pickle decodes.  A corrupt newest entry — the exact fault
+    the ``checkpoint.payload`` chaos site injects — is skipped with a
+    loud log and the walk falls back to the next-oldest ring entry, so
+    one bit-flipped file costs one save of progress instead of bricking
+    auto-resume."""
+    from .utils.logging import get_logger
+
+    for _step, path in _ring_newest_first(directory):
+        payload = _read_verified(path)
+        if payload is None:
+            continue  # checksum failure already logged loudly
+        if not payload.startswith(_STATE_MAGIC):
+            # either a flax pytree checkpoint (one-family-per-dir means
+            # every entry will look like this and the walk returns
+            # None) or a state file whose HEADER bytes were corrupted
+            # (no checksum magic survived to verify against) — keep
+            # walking so the ring fallback covers header damage too.
+            # Logged ONCE per directory: a legitimate pytree dir would
+            # otherwise warn per entry per resume check
+            if directory not in _warned_non_state_dirs:
+                _warned_non_state_dirs.add(directory)
+                get_logger().warning(
+                    "checkpoint: %s is not a state checkpoint (pytree "
+                    "family, pre-checksum file, or corrupted header); "
+                    "skipping such entries in the ring walk", path)
+            continue
+        try:
+            blob = pickle.loads(payload[len(_STATE_MAGIC):])
+            return int(blob["step"]), blob["snapshot"]
+        # a corrupt/alien file can raise nearly anything out of pickle
+        # (UnpicklingError, ValueError, AttributeError for a moved
+        # class...) — resumability must not crash-loop a booting worker
+        # on one bad file (version skew, torn disk): skip and fall back
+        except Exception as e:
+            get_logger().error(
+                "checkpoint: %s unusable (%s: %s); skipping it and "
+                "falling back to the next-oldest ring entry",
+                path, type(e).__name__, e,
+            )
+    return None
 
 
 def restore_state_checkpoint(directory: str, state: Any) -> Optional[int]:

@@ -345,15 +345,28 @@ class WorkerNotificationManager:
         PollForError before its first post-failure commit)."""
         self._report("failing", reason)
 
-    def _report(self, kind: str, reason: str) -> None:
+    def report_integrity_failure(self, reason: str) -> None:
+        """A ``failing`` report carrying the INTEGRITY flag: this rank
+        was attributed as computing wrong values (guard.py, silent
+        corruption).  Beyond the normal failure epoch, the driver
+        QUARANTINES this worker's whole host — a lying chip taints its
+        machine, and respawning onto it would re-corrupt the fleet
+        (docs/FAULT_TOLERANCE.md)."""
+        self._report("failing", reason, integrity=True)
+
+    def _report(self, kind: str, reason: str,
+                integrity: bool = False) -> None:
         with self._lock:
             sock = self._sock
         if sock is None:
             return
         try:
-            _send_line(sock, {"type": kind,
-                              "worker_id": _worker_id(),
-                              "reason": reason[:512]})
+            msg = {"type": kind,
+                   "worker_id": _worker_id(),
+                   "reason": reason[:512]}
+            if integrity:
+                msg["integrity"] = True
+            _send_line(sock, msg)
         except (OSError, KeyError, ValueError):
             pass  # the report is an optimization, never a requirement
 

@@ -38,6 +38,7 @@ from typing import Callable, Optional, Sequence
 import numpy as np
 
 from ..common.retry import env_float, env_int, retry_call
+from ..metrics import instruments as _instr
 from ..metrics.exposition import (
     register_health_source, unregister_health_source,
 )
@@ -57,6 +58,10 @@ RETIRED = "retired"
 
 ENV_STALL = "HVD_TPU_FLEET_REPLICA_STALL_SECONDS"
 ENV_SPAWN_RETRIES = "HVD_TPU_FLEET_REPLICA_SPAWN_RETRIES"
+#: consecutive submit/step errors (or healthz stall trips) before the
+#: router marks a replica SUSPECT — ejected from placement, in-flight
+#: work re-routed once (docs/FLEET.md)
+ENV_ERRORS = "HVD_TPU_FLEET_REPLICA_ERRORS"
 
 
 class ServingReplica:
@@ -76,6 +81,18 @@ class ServingReplica:
         self._stall_s = env_float(ENV_STALL, 60.0)
         #: peak of :meth:`queue_depth` over this replica's life (bench)
         self.peak_queue_depth = 0
+        #: SUSPECT: ejected from placement after consecutive errors or
+        #: a stall trip (router re-routes its work; docs/FLEET.md)
+        self.suspect = False
+        #: the router's ejection already ran (re-entrancy guard: a
+        #: voluntarily-DRAINING replica that then stalls must still be
+        #: ejectable, so the guard is this flag, not the state)
+        self.ejected = False
+        self._errors = 0
+        self._error_threshold = max(1, env_int(ENV_ERRORS, 3))
+        #: EMA of step wall time — the router's queue-delay estimate
+        #: (deadline-aware placement) multiplies it by queue depth
+        self.avg_step_s: Optional[float] = None
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -148,7 +165,7 @@ class ServingReplica:
 
     @property
     def accepting(self) -> bool:
-        return self.state == READY
+        return self.state == READY and not self.suspect
 
     @property
     def has_work(self) -> bool:
@@ -156,19 +173,53 @@ class ServingReplica:
         return bool(sched.running or sched.pending
                     or sched.staged_depth())
 
+    def note_error(self) -> bool:
+        """Book one submit/step error or stall trip.  Returns True on
+        the transition to SUSPECT (``HVD_TPU_FLEET_REPLICA_ERRORS``
+        consecutive errors) — the router then ejects the replica and
+        re-routes its work."""
+        self._errors += 1
+        if self._errors >= self._error_threshold and not self.suspect:
+            self.suspect = True
+            _instr.FLEET_REPLICA_SUSPECTS.inc()
+            get_logger().error(
+                "fleet: replica %s SUSPECT after %d consecutive "
+                "error(s); ejecting from placement", self.name,
+                self._errors)
+            return True
+        return False
+
+    def note_ok(self) -> None:
+        """A successful operation resets the consecutive-error run."""
+        self._errors = 0
+
     def submit(self, prompt, max_new_tokens: int, *, eos_id=None,
-               arrival: Optional[float] = None) -> int:
+               arrival: Optional[float] = None,
+               deadline_s: Optional[float] = None) -> int:
         if not self.accepting:
             raise RuntimeError(
                 f"replica {self.name} is {self.state}, not accepting")
         return self.engine.submit(prompt, max_new_tokens, eos_id=eos_id,
-                                  arrival=arrival)
+                                  arrival=arrival, deadline_s=deadline_s)
 
     def step(self) -> bool:
-        """One engine step; progress timestamps feed the heartbeat."""
+        """One engine step; progress timestamps feed the heartbeat and
+        the step-time EMA feeds the queue-delay estimate."""
+        t0 = self._clock()
         more = self.engine.step()
-        self._last_progress = self._clock()
+        now = self._clock()
+        dt = max(0.0, now - t0)
+        self.avg_step_s = dt if self.avg_step_s is None else (
+            0.8 * self.avg_step_s + 0.2 * dt)
+        self._last_progress = now
         return more
+
+    def est_queue_delay(self) -> float:
+        """Rough seconds of queue ahead of a new request on this
+        replica (queue depth x step-time EMA) — the router skips
+        replicas whose estimate already exceeds a request's remaining
+        deadline budget."""
+        return (self.avg_step_s or 0.0) * self.queue_depth()
 
     def queue_depth(self) -> int:
         """Requests waiting for admission on this replica (scheduler

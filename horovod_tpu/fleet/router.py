@@ -47,6 +47,7 @@ would put behind RPC.
 from __future__ import annotations
 
 import collections
+import dataclasses
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -58,6 +59,23 @@ from .policy import TargetTrackingPolicy
 from .replica import DRAINING, PARKED, READY, RETIRED, ServingReplica
 
 __all__ = ["FleetRouter"]
+
+
+@dataclasses.dataclass
+class _Placement:
+    """Where one router-global request currently lives — enough to
+    re-submit it verbatim if its replica turns suspect (greedy decode
+    is deterministic, so a re-routed request regenerates identical
+    tokens on the survivor)."""
+
+    replica: ServingReplica
+    rid: int
+    prompt: np.ndarray
+    max_new_tokens: int
+    eos_id: Optional[int]
+    arrival: Optional[float]
+    deadline_s: Optional[float]
+    rerouted: bool = False
 
 _ROUTE_AFFINITY = _instr.FLEET_ROUTED.labels("affinity")
 _ROUTE_LEAST_QUEUE = _instr.FLEET_ROUTED.labels("least_queue")
@@ -96,8 +114,8 @@ class FleetRouter:
         self._rr = 0  # round-robin cursor
         self.replicas: List[ServingReplica] = []
         self.retired: List[ServingReplica] = []
-        #: global id -> (replica, replica-local request id)
-        self._placed: Dict[int, Tuple[ServingReplica, int]] = {}
+        #: global id -> live placement record
+        self._placed: Dict[int, _Placement] = {}
         self._next_gid = 0
         self.results: Dict[int, np.ndarray] = {}
         #: (arrival-ordered) sliding window of recent TTFTs — the
@@ -176,8 +194,11 @@ class FleetRouter:
 
     # -- placement -----------------------------------------------------------
 
-    def _route(self, prompt: np.ndarray) -> ServingReplica:
-        acc = self._accepting()
+    def _route(self, prompt: np.ndarray,
+               remaining_budget: Optional[float] = None,
+               exclude: Tuple[ServingReplica, ...] = ()
+               ) -> ServingReplica:
+        acc = [r for r in self._accepting() if r not in exclude]
         if not acc:
             raise RuntimeError("no accepting replicas")
         if self.mode == "round_robin":
@@ -186,6 +207,16 @@ class FleetRouter:
             _ROUTE_RR.inc()
             self.route_counts["round_robin"] += 1
             return r
+        if remaining_budget is not None:
+            # deadline-aware placement: a replica whose estimated queue
+            # delay already exceeds the request's remaining budget
+            # would only produce a shed — skip it while ANY viable
+            # replica exists (all over budget: route normally and let
+            # the engine's own deadline machinery shed honestly)
+            viable = [r for r in acc
+                      if r.est_queue_delay() <= remaining_budget]
+            if viable:
+                acc = viable
         scores = [(r.cached_prefix_blocks(prompt), r) for r in acc]
         best_score = max(s for s, _ in scores)
         if best_score > 0:
@@ -208,23 +239,56 @@ class FleetRouter:
         return r
 
     def submit(self, prompt, max_new_tokens: int, *, eos_id=None,
-               arrival: Optional[float] = None) -> int:
+               arrival: Optional[float] = None,
+               deadline_s: Optional[float] = None) -> int:
         """Place one request; returns a router-global id (key into
-        :attr:`results`)."""
+        :attr:`results`).  A replica whose ``submit`` raises books an
+        error (SUSPECT + ejection at ``HVD_TPU_FLEET_REPLICA_ERRORS``
+        consecutive) and THIS request retries on the next-best
+        survivor — a raising replica can no longer keep winning
+        affinity for its cached templates."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
-        r = self._route(prompt)
-        rid = r.submit(prompt, max_new_tokens, eos_id=eos_id,
-                       arrival=arrival)
-        gid = self._next_gid
-        self._next_gid += 1
-        self._placed[gid] = (r, rid)
-        return gid
+        remaining = None
+        if deadline_s and deadline_s > 0:
+            now = self._clock()
+            arr = now if arrival is None else arrival
+            remaining = max(0.0, deadline_s - (now - arr))
+        tried: List[ServingReplica] = []
+        for _ in range(len(self.replicas) + 1):
+            r = self._route(prompt, remaining, exclude=tuple(tried))
+            try:
+                rid = r.submit(prompt, max_new_tokens, eos_id=eos_id,
+                               arrival=arrival, deadline_s=deadline_s)
+                r.note_ok()
+            except ValueError:
+                # client-input validation (over-long prompt, zero
+                # max_new_tokens): the CALLER's error, identical on
+                # every replica — booking it as replica health would
+                # let a few bad requests eject the whole fleet
+                raise
+            except Exception as e:
+                get_logger().warning(
+                    "fleet: replica %s submit raised (%s: %s)",
+                    r.name, type(e).__name__, e)
+                if r.note_error():
+                    self._eject(r)
+                tried.append(r)
+                continue
+            gid = self._next_gid
+            self._next_gid += 1
+            self._placed[gid] = _Placement(
+                replica=r, rid=rid, prompt=prompt,
+                max_new_tokens=int(max_new_tokens), eos_id=eos_id,
+                arrival=arrival, deadline_s=deadline_s)
+            return gid
+        raise RuntimeError("no replica accepted the request")
 
     # -- driving -------------------------------------------------------------
 
     def step(self) -> bool:
         """One pass: step every replica that has work, collect
-        completions and TTFT samples, retire drained replicas, tick
+        completions and TTFT samples, eject suspects (consecutive step
+        errors or a healthz stall trip), retire drained replicas, tick
         the scale policy.  Returns True while anything is in flight."""
         busy = False
         for r in list(self.replicas):
@@ -234,7 +298,23 @@ class FleetRouter:
             # in every routing mode, not just where routing reads it
             if r.has_work:
                 busy = True
-                r.step()
+                try:
+                    r.step()
+                    r.note_ok()
+                except Exception as e:
+                    get_logger().warning(
+                        "fleet: replica %s step raised (%s: %s)",
+                        r.name, type(e).__name__, e)
+                    if r.note_error():
+                        self._eject(r)
+                        continue
+            # the healthz stall source (has-work-but-no-progress) feeds
+            # the same consecutive-error counter as submit/step raises
+            if not r.suspect and r.state in (READY, DRAINING) \
+                    and not r.healthy():
+                if r.note_error():
+                    self._eject(r)
+                    continue
             self._collect(r)
             if r.state == DRAINING and r.drained:
                 r.retire()
@@ -244,6 +324,73 @@ class FleetRouter:
         if self.policy is not None:
             self._maybe_scale()
         return busy
+
+    def _eject(self, r: ServingReplica) -> None:
+        """A replica turned SUSPECT: collect what it already finished,
+        re-route its remaining work ONCE to the least-queue survivors
+        (a request whose survivor also fails completes empty rather
+        than ping-ponging), release its scheduler bookkeeping (blocks
+        free through the normal refcount path) and drain-retire it.  A
+        survivor crossing its own error threshold DURING the re-route
+        is ejected afterwards (bounded: each ejection removes a
+        replica).  A replica already DRAINING voluntarily (scale-down)
+        that then stalls still gets the full ejection — the guard is
+        the ``ejected`` flag, not the lifecycle state."""
+        if r.ejected or r.state == RETIRED:
+            return
+        r.ejected = True
+        self._collect(r)
+        survivors = [x for x in self._accepting() if x is not r]
+        moved = dropped = 0
+        for gid, p in list(self._placed.items()):
+            if p.replica is not r:
+                continue
+            placed = None
+            if not p.rerouted:
+                # walk EVERY accepting survivor least-queue-first: one
+                # survivor flaking must not drop a request another
+                # could serve — and its flake books toward its own
+                # suspect counter like any other submit error
+                for tgt in sorted(survivors,
+                                  key=lambda x: x.queue_depth()):
+                    if not tgt.accepting:
+                        continue
+                    try:
+                        nrid = tgt.submit(
+                            p.prompt, p.max_new_tokens,
+                            eos_id=p.eos_id, arrival=p.arrival,
+                            deadline_s=p.deadline_s)
+                        tgt.note_ok()
+                        placed = (tgt, nrid)
+                        break
+                    except Exception as e:
+                        get_logger().warning(
+                            "fleet: re-route to replica %s raised "
+                            "(%s: %s)", tgt.name, type(e).__name__, e)
+                        tgt.note_error()
+            if placed is None:
+                self.results[gid] = np.zeros((0,), np.int32)
+                del self._placed[gid]
+                dropped += 1
+                continue
+            self._placed[gid] = dataclasses.replace(
+                p, replica=placed[0], rid=placed[1], rerouted=True)
+            moved += 1
+        if r.engine is not None:
+            # abort everything the engine still holds (blocks release
+            # through the normal refcount path; partial results publish
+            # so engine-sourced requests — which the router never
+            # placed and cannot re-route — complete empty instead of
+            # leaving their pollers waiting forever)
+            r.engine.cancel_all()
+        get_logger().error(
+            "fleet: ejected suspect replica %s (%d request(s) "
+            "re-routed, %d dropped)", r.name, moved, dropped)
+        r.drain()
+        self._book_replica_gauges()
+        for tgt in survivors:
+            if tgt.suspect:
+                self._eject(tgt)
 
     def run_until_drained(self) -> Dict[int, np.ndarray]:
         while self.step():
@@ -255,9 +402,10 @@ class FleetRouter:
             self._ttfts.append(ttft)
             self._ttft_seen[r] = self._ttft_seen.get(r, 0) + 1
         # map replica-local completions back to router-global ids
-        for gid, (rep, rid) in list(self._placed.items()):
-            if rep is r and rid in r.engine.results:
-                self.results[gid] = r.engine.results[rid]
+        for gid, p in list(self._placed.items()):
+            if p.replica is r and r.engine is not None \
+                    and p.rid in r.engine.results:
+                self.results[gid] = r.engine.results[p.rid]
                 del self._placed[gid]
 
     # -- SLO signals + scaling ----------------------------------------------

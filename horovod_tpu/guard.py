@@ -1,0 +1,840 @@
+"""horovod_tpu.guard — step-level integrity defense against silent
+data corruption (SDC).
+
+Every other robustness layer in this repo defends against processes
+that *die* (heartbeats, chaos kills, exec-restart, preemption drains);
+this one defends against processes that *lie*: a chip computing wrong
+gradients ("Cores that don't count", Hochschild et al.; Meta's SDC
+fleet studies), a rank whose parameters silently desync, a checkpoint
+that unpickles but is garbage.  The transport-level MACs (PR 2) cannot
+see corruption that happens *inside* the math — by the time a wrong
+value is on the wire it is correctly signed.
+
+The closed loop: **detect → attribute → quarantine → roll back →
+converge**, automatically (docs/FAULT_TOLERANCE.md, silent corruption):
+
+* **Cheap always-on detectors** — a NaN/Inf sentinel over loss+grads
+  and a per-step gradient digest, both computed ON DEVICE inside the
+  compiled step (:func:`step_diag`: elementwise folds, zero
+  collectives); plus a host-side EMA loss-spike detector.  The device
+  values stay on device; ONE bounded host sync per
+  ``HVD_TPU_GUARD_CADENCE`` steps pulls the window.
+* **Cross-rank agreement** — post-allreduce gradients (or the ZeRO
+  exchange's post-allgather updates) and a param fingerprint must be
+  BIT-identical across data-parallel ranks.  At cadence each rank
+  publishes its window of per-step u64 digests (a few bytes) through
+  an exchange (the framework allgather, or a shared-directory board
+  for environments without cross-process collectives) and compares.
+* **Attribution** — on disagreement, find the FIRST divergent step in
+  the window.  With >2 ranks the majority digest names the minority
+  rank(s).  On a pairwise tie, each rank redundantly RECOMPUTES the
+  sampled microbatch of the divergent step (caller-provided
+  ``recompute`` hook) and compares with what it published: a transient
+  flip in my own compute shows up as self-inconsistency, so the faulty
+  rank attributes ITSELF; a second exchange round shares the verdicts.
+* **Response** — the attributed rank reports ``failing`` (integrity
+  flag) on the PR-3 notify path — the elastic driver QUARANTINES its
+  whole host (spawn blacklist, the fleet scale-down bookkeeping) — and
+  exits.  Survivors roll back: checkpoints newer than the last
+  *verified* step are discarded (they are inside the poisoned window;
+  :func:`checkpoint.discard_newer_than`), the live state is dropped
+  (an exec-restart with NO snapshot), and post-boot auto-resume
+  restores the newest surviving — checksummed and verified —
+  checkpoint.  ``hvd_tpu_recovery_seconds{phase="rollback"}`` books
+  the wall time across the restart.
+
+Exactness contract (the standing oracle discipline): the guarded step
+is BIT-identical to the unguarded step when no fault fires — the
+digest/sentinel are pure extra outputs over the same dataflow — and
+the disabled path (``HVD_TPU_GUARD=0``) lowers to a program with ZERO
+guard collectives (the in-step detectors add none even when enabled;
+the digest exchange rides the host control plane at cadence).
+tools/guard_bench.py pins both, plus the ≤2% overhead bar.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .common.retry import env_float, env_int
+from .metrics import instruments as _metrics
+from .utils.logging import get_logger
+
+__all__ = [
+    "IntegrityError", "IntegrityGuard", "Verdict", "CollectiveExchange",
+    "FileBoardExchange", "device_allfinite", "device_digest", "host_digest",
+    "step_diag",
+]
+
+ENV_GUARD = "HVD_TPU_GUARD"
+ENV_CADENCE = "HVD_TPU_GUARD_CADENCE"
+ENV_SPIKE = "HVD_TPU_GUARD_SPIKE"
+ENV_EMA = "HVD_TPU_GUARD_EMA"
+ENV_BOARD = "HVD_TPU_GUARD_BOARD"
+ENV_TIMEOUT = "HVD_TPU_GUARD_EXCHANGE_TIMEOUT"
+#: wall-clock rollback start, carried ACROSS the exec-restart boundary
+#: (the PR-3 restart-cost idiom) so recovery_seconds{phase="rollback"}
+#: spans detection to post-boot resume
+ENV_ROLLBACK_T0 = "HVD_TPU_GUARD_ROLLBACK_T0"
+#: board generation, bumped by every rollback and carried across the
+#: exec-restart: the post-rollback re-run REVISITS the poisoned window's
+#: steps, and a pre-rollback board file for the same step must read as
+#: absent (still being re-posted), never as fresh — deleting the files
+#: instead was a race (a slower peer mid-gather loses the entry it was
+#: about to read and blocks out its whole exchange timeout)
+ENV_GEN = "HVD_TPU_GUARD_GEN"
+#: rollback-loop fuse, carried across the exec-restart: consecutive
+#: rollbacks that never get PAST the step that tripped them mean the
+#: fault reproduces deterministically — a real training divergence
+#: (lr blowup, bad batch), not transient corruption — and restarting
+#: forever would burn the fleet while hiding the real error.  The
+#: count resets once a verified check passes the recorded trip step.
+ENV_ROLLBACK_COUNT = "HVD_TPU_GUARD_ROLLBACK_COUNT"
+ENV_ROLLBACK_STEP = "HVD_TPU_GUARD_ROLLBACK_STEP"
+ENV_MAX_ROLLBACKS = "HVD_TPU_GUARD_MAX_ROLLBACKS"
+#: newest verified step, carried across the exec-restart: a SECOND
+#: trip after a rollback restart must discard only past the same
+#: watermark — a fresh guard resetting to 0 would hand
+#: discard_newer_than(0) the whole ring, wiping the very checkpoints
+#: the first rollback verified and resumed from
+ENV_VERIFIED = "HVD_TPU_GUARD_VERIFIED_STEP"
+
+#: exit code of a self-attributed (quarantining) rank — distinct from
+#: generic failures in the driver's logs
+QUARANTINE_EXIT = 86
+
+_MIX = 0x9E3779B1  # odd golden-ratio constant (second digest lane)
+
+
+class IntegrityError(RuntimeError):
+    """Raised by :meth:`IntegrityGuard.respond` in non-elastic contexts
+    when corruption is detected: the caller owns recovery (reload a
+    verified checkpoint).  Elastic workers never see it — the guard
+    exec-restarts them through the rollback path instead."""
+
+
+# -- digests -----------------------------------------------------------------
+#
+# A pair of mod-2^32 multiply-accumulate lanes over the bit patterns of
+# every leaf ("u64 digest": 2 x uint32).  Lane 0 weights word i of leaf
+# k by the ODD multiplier (2*i + 2*k + 1), so a single flipped bit b
+# changes it by ±2^b * odd ≠ 0 (mod 2^32) — any single-bit flip is
+# PROVABLY detected; lane 1 re-weights by an odd golden-ratio mix for
+# cheap extra entropy against multi-bit cancellation.  Both the device
+# (jax) and host (numpy) folds produce identical values (test-pinned),
+# so host-loop trainers and compiled steps share one digest space.
+
+
+def _device_words(x):
+    """A leaf's bit pattern as a flat uint32 vector (device)."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.asarray(x)
+    if x.dtype == jnp.bool_:
+        return x.astype(jnp.uint32).ravel()
+    nbytes = jnp.dtype(x.dtype).itemsize
+    if nbytes == 1:
+        return jax.lax.bitcast_convert_type(x, jnp.uint8).astype(
+            jnp.uint32).ravel()
+    if nbytes == 2:
+        return jax.lax.bitcast_convert_type(x, jnp.uint16).astype(
+            jnp.uint32).ravel()
+    # 4-byte leaves bitcast 1:1; 8-byte leaves split into a trailing
+    # (2,) uint32 axis — raveled, the low/high words interleave in the
+    # same order numpy's little-endian uint32 view produces
+    return jax.lax.bitcast_convert_type(x, jnp.uint32).ravel()
+
+
+def _host_words(a: np.ndarray) -> np.ndarray:
+    a = np.ascontiguousarray(a)
+    if a.dtype == np.bool_:
+        return a.astype(np.uint32).ravel()
+    if a.dtype.itemsize == 8:
+        # mirror jnp.asarray under default (x64-disabled) jax: 64-bit
+        # hosts leaves land on device as their 32-bit counterparts, so
+        # the host fold must digest the same downcast bits
+        import jax
+
+        if not jax.config.jax_enable_x64:
+            kind = a.dtype.kind
+            a = np.ascontiguousarray(a.astype(
+                {"f": np.float32, "i": np.int32, "u": np.uint32}.get(
+                    kind, np.float32)))
+    if a.dtype.itemsize == 1:
+        return a.view(np.uint8).astype(np.uint32).ravel()
+    if a.dtype.itemsize == 2:
+        return a.view(np.uint16).astype(np.uint32).ravel()
+    return a.view(np.uint32).ravel()
+
+
+def device_digest(tree) -> Any:
+    """(2,) uint32 content digest of every leaf's bit pattern, computed
+    on device (pure elementwise+reduce ops, NO collectives — safe to
+    add to any step program without changing its existing dataflow)."""
+    import jax
+    import jax.numpy as jnp
+
+    lane0 = jnp.zeros((), jnp.uint32)
+    lane1 = jnp.zeros((), jnp.uint32)
+    for k, leaf in enumerate(jax.tree_util.tree_leaves(tree)):
+        w = _device_words(leaf)
+        idx = jnp.arange(w.size, dtype=jnp.uint32)
+        m = idx * jnp.uint32(2) + jnp.uint32(2 * k + 1)
+        lane0 = lane0 + jnp.sum(w * m, dtype=jnp.uint32)
+        lane1 = lane1 + jnp.sum(w * (m * jnp.uint32(_MIX)),
+                                dtype=jnp.uint32)
+    return jnp.stack([lane0, lane1])
+
+
+def host_digest(tree) -> np.ndarray:
+    """Numpy mirror of :func:`device_digest` — identical values for
+    identical contents (pinned by tests), so host-loop trainers (the
+    chaos-soak worker, torch-style loops) share the digest space."""
+    import jax
+
+    lane0 = np.uint64(0)
+    lane1 = np.uint64(0)
+    mask = np.uint64(0xFFFFFFFF)
+    for k, leaf in enumerate(jax.tree_util.tree_leaves(tree)):
+        w = _host_words(np.asarray(leaf)).astype(np.uint64)
+        idx = np.arange(w.size, dtype=np.uint64)
+        m = (idx * np.uint64(2) + np.uint64(2 * k + 1)) & mask
+        # products wrap mod 2^64; 2^32 | 2^64 so the final mod-2^32
+        # fold equals the device's per-element uint32 wraparound
+        with np.errstate(over="ignore"):
+            lane0 = (lane0 + np.sum(w * m, dtype=np.uint64)) & mask
+            lane1 = (lane1 + np.sum(w * ((m * np.uint64(_MIX)) & mask),
+                                    dtype=np.uint64)) & mask
+    return np.array([lane0, lane1], np.uint32)
+
+
+def device_allfinite(tree) -> Any:
+    """Scalar bool: every float leaf is NaN/Inf-free (int leaves pass)."""
+    import jax
+    import jax.numpy as jnp
+
+    ok = jnp.asarray(True)
+    for leaf in jax.tree_util.tree_leaves(tree):
+        leaf = jnp.asarray(leaf)
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            ok = ok & jnp.all(jnp.isfinite(leaf))
+    return ok
+
+
+def step_diag(loss, grads) -> Dict[str, Any]:
+    """The guarded step's extra outputs: the always-on detectors,
+    evaluated on device inside the compiled step.  ``digest`` is over
+    the POST-reduction gradients (what must be bit-identical across
+    data-parallel ranks); ``finite`` covers loss and gradients.
+
+    SCOPE (docs/FAULT_TOLERANCE.md): agreement on post-reduction
+    values catches corruption in the exchange, the wire, the optimizer
+    update and state desync.  A wrong LOCAL gradient folded into the
+    allreduce is corrupted IDENTICALLY on every rank (local grads
+    differ by design — different batches — so they cannot be compared
+    directly); catching that class needs a redundant recompute of the
+    sampled microbatch — the host-loop ``tap_grads`` path and the
+    attribution ``recompute`` hook do exactly that, the compiled path
+    does not re-execute."""
+    return {
+        "finite": device_allfinite((loss, grads)),
+        "digest": device_digest(grads),
+    }
+
+
+def _canon(digest) -> bytes:
+    """Any digest form (device array, numpy, bytes, hex str) to the
+    canonical 8-byte wire form."""
+    if isinstance(digest, bytes):
+        return digest
+    if isinstance(digest, str):
+        return bytes.fromhex(digest)
+    return np.asarray(digest, np.uint32).tobytes()
+
+
+# -- exchanges ---------------------------------------------------------------
+
+
+class FileBoardExchange:
+    """Digest exchange over a shared directory ("board"): each rank
+    publishes ``<key>.rank<R>`` atomically (tmp + rename) and polls for
+    its peers under a timeout.  The exchange for environments whose
+    processes share a filesystem but cannot run cross-process
+    collectives (the chaos-soak contract on CPU-host jax; the same
+    HVD_TPU_SOAK_LOCAL_SYNC-style substitution PR 3 established).
+
+    Entries carry a GENERATION header (``HVD_TPU_GUARD_GEN``, bumped by
+    every rollback and inherited across the exec-restart): the
+    post-rollback re-run revisits the poisoned window's step numbers,
+    and a pre-rollback entry for the same key must read as *absent*
+    (the peer will overwrite it), never as fresh — a clean peer's stale
+    digest happens to be value-identical (deterministic re-run), but a
+    quarantined rank's stale entry is poisoned, and rank renumbering
+    after a shrink could hand it to a different worker.  Entries are
+    never deleted mid-job (deleting raced slower peers out of entries
+    they were mid-gather on); the board directory is per-job temp
+    space.  Production fleets use :class:`CollectiveExchange`."""
+
+    def __init__(self, directory: str, *, timeout: float = 30.0,
+                 poll: float = 0.02, generation: Optional[int] = None):
+        self.directory = directory
+        self.timeout = timeout
+        self.poll = poll
+        self.generation = (env_int(ENV_GEN, 0)
+                           if generation is None else int(generation))
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, key: str, rank: int) -> str:
+        return os.path.join(self.directory, f"{key}.rank{rank}")
+
+    def gather(self, key: str, payload: bytes, *, world: int,
+               rank: int) -> List[Optional[bytes]]:
+        gen = b"%08x\n" % self.generation
+        tmp = self._path(key, rank) + f".tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(gen + payload)
+        os.replace(tmp, self._path(key, rank))  # atomic publish
+        out: List[Optional[bytes]] = [None] * world
+        out[rank] = payload
+        deadline = time.monotonic() + self.timeout
+        missing = [r for r in range(world) if r != rank]
+        while missing and time.monotonic() < deadline:
+            for r in list(missing):
+                try:
+                    with open(self._path(key, r), "rb") as f:
+                        blob = f.read()
+                except FileNotFoundError:
+                    continue
+                try:
+                    file_gen = int(blob[:8], 16)
+                except ValueError:
+                    continue  # torn write: re-poll
+                if file_gen < self.generation:
+                    continue  # pre-rollback entry: peer will overwrite
+                out[r] = blob[9:]
+                missing.remove(r)
+            if missing:
+                time.sleep(self.poll)
+        return out
+
+
+class CollectiveExchange:
+    """Digest exchange over the framework's own allgather
+    (:func:`horovod_tpu.functions.allgather_object`) — a few bytes on
+    the negotiated control plane, the production default."""
+
+    def gather(self, key: str, payload: bytes, *, world: int,
+               rank: int) -> List[Optional[bytes]]:
+        from . import functions
+
+        del key  # the collective itself sequences the rounds
+        out = functions.allgather_object(payload)
+        if len(out) != world:
+            return out + [None] * (world - len(out))
+        return out
+
+
+# -- verdicts ----------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Verdict:
+    """Outcome of one cadence check."""
+
+    step: int
+    ok: bool
+    kind: str  # verified | partial | nan | mismatch
+    #: ranks named as corrupt (majority vote or recompute self-report)
+    attributed: List[int] = dataclasses.field(default_factory=list)
+    #: True when THIS rank is in ``attributed`` (quarantine path)
+    self_attributed: bool = False
+    #: first step in the window whose gradient digests diverged (None:
+    #: the divergence predates the window — param-only drift)
+    divergent_step: Optional[int] = None
+    #: advisory loss-spike flag (EMA detector; never fails the verdict
+    #: by itself — spikes have benign causes, digests do not)
+    spike: bool = False
+    detail: str = ""
+
+
+class IntegrityGuard:
+    """The closed loop's driver (module docstring).  One instance per
+    training process; host-side and framework-agnostic — compiled-step
+    trainers feed it device diagnostics (:func:`step_diag`), host-loop
+    trainers feed it :func:`host_digest` values through
+    :meth:`tap_grads`/:meth:`observe_grads`."""
+
+    def __init__(self, *, enabled: bool = True, cadence: int = 16,
+                 spike: float = 10.0, ema_alpha: float = 0.9,
+                 world: int = 1, rank: int = 0, exchange=None,
+                 ckpt_dir: Optional[str] = None,
+                 exit_fn: Callable[[int], None] = os._exit):
+        if cadence < 1:
+            raise ValueError(f"cadence must be >= 1, got {cadence}")
+        self.enabled = bool(enabled)
+        self.cadence = int(cadence)
+        self.spike = float(spike)
+        self.ema_alpha = float(ema_alpha)
+        self.world = int(world)
+        self.rank = int(rank)
+        self.exchange = exchange
+        self.ckpt_dir = ckpt_dir
+        self._exit = exit_fn
+        # inherited across a rollback exec-restart (module env notes):
+        # the re-run's watermark starts where the verified ring ends,
+        # never at 0
+        self.last_verified_step = env_int(ENV_VERIFIED, 0)
+        self.last_rollback_s: Optional[float] = None
+        #: rollback-loop fuse state (module env docstrings): trips of
+        #: the SAME step accumulate until a verified check passes it
+        self.max_rollbacks = max(1, env_int(ENV_MAX_ROLLBACKS, 3))
+        self._rollback_count = env_int(ENV_ROLLBACK_COUNT, 0)
+        self._rollback_barrier = env_int(ENV_ROLLBACK_STEP, -1)
+        self._ema: Optional[float] = None
+        self._ema_n = 0
+        self._window: List[tuple] = []  # (step, digest as given)
+        self._lock = threading.Lock()
+        self._pdigest_fn = None
+        t0 = os.environ.pop(ENV_ROLLBACK_T0, None)
+        if t0 is not None:
+            try:
+                self.last_rollback_s = max(0.0, time.time() - float(t0))
+                _metrics.RECOVERY_SECONDS.labels("rollback").set(
+                    self.last_rollback_s)
+                get_logger().info(
+                    "guard: rollback completed in %.2fs (detection -> "
+                    "post-boot resume)", self.last_rollback_s)
+            except ValueError:
+                pass
+
+    @classmethod
+    def from_env(cls, *, world: Optional[int] = None,
+                 rank: Optional[int] = None,
+                 ckpt_dir: Optional[str] = None,
+                 exchange=None, **overrides) -> "IntegrityGuard":
+        """Build from the ``HVD_TPU_GUARD_*`` knobs (docs/running.md).
+        ``HVD_TPU_GUARD_BOARD`` selects the shared-directory exchange;
+        otherwise multi-process worlds default to the framework
+        allgather (:class:`CollectiveExchange`)."""
+        if world is None or rank is None:
+            from .common import basics
+
+            if basics.is_initialized():
+                world = basics.cross_size() if world is None else world
+                rank = basics.cross_rank() if rank is None else rank
+            else:
+                world = 1 if world is None else world
+                rank = 0 if rank is None else rank
+        if exchange is None and world > 1:
+            board = os.environ.get(ENV_BOARD)
+            timeout = env_float(ENV_TIMEOUT, 30.0)
+            if board:
+                exchange = FileBoardExchange(board, timeout=timeout)
+            else:
+                exchange = CollectiveExchange()
+        kw = dict(
+            enabled=bool(env_int(ENV_GUARD, 0)),
+            cadence=env_int(ENV_CADENCE, 16),
+            spike=env_float(ENV_SPIKE, 10.0),
+            ema_alpha=env_float(ENV_EMA, 0.9),
+        )
+        kw.update(overrides)
+        return cls(world=world, rank=rank, exchange=exchange,
+                   ckpt_dir=ckpt_dir, **kw)
+
+    # -- per-step feeds ------------------------------------------------------
+
+    def due(self, step: int) -> bool:
+        """True on cadence steps (and never on step 0)."""
+        return self.enabled and step > 0 and step % self.cadence == 0
+
+    def tap_grads(self, array):
+        """Host-loop gradient tap: the ``guard.grad`` chaos site — a
+        ``flipbit`` rule here IS the silent-corruption drill (the
+        returned, possibly-corrupted array is what the trainer applies,
+        exactly as a lying chip would hand it over)."""
+        from . import chaos as _chaos
+
+        if _chaos.active:
+            return _chaos.point("guard.grad", array)
+        return array
+
+    def tap_params(self, array):
+        """Host-loop param-fingerprint tap (``guard.param`` site)."""
+        from . import chaos as _chaos
+
+        if _chaos.active:
+            return _chaos.point("guard.param", array)
+        return array
+
+    def observe_grads(self, step: int, digest) -> None:
+        """Append one step's gradient digest to the agreement window.
+        ``digest`` may be a live device array — it is NOT synced here
+        (the cadence check syncs the whole window in one pass)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._window.append((int(step), digest))
+            # bound the window: everything older than one cadence has
+            # either been verified or already rolled back
+            if len(self._window) > 2 * self.cadence:
+                del self._window[:-2 * self.cadence]
+
+    def param_digest(self, params) -> Any:
+        """Compiled param fingerprint (one program, cached)."""
+        import jax
+
+        if self._pdigest_fn is None:
+            self._pdigest_fn = jax.jit(device_digest)
+        return self._pdigest_fn(params)
+
+    # -- the cadence check ---------------------------------------------------
+
+    def _spike_check(self, step: int, loss: float) -> bool:
+        if not np.isfinite(loss) or self.spike <= 0:
+            return False
+        tripped = False
+        if self._ema is not None and self._ema_n >= 3:
+            floor = max(abs(self._ema), 1e-8)
+            if abs(loss) > self.spike * floor:
+                tripped = True
+                _metrics.GUARD_TRIPS.labels("spike").inc()
+                get_logger().warning(
+                    "guard: loss spike at step %d — |%.4g| > %.1fx EMA "
+                    "%.4g (advisory; digests decide corruption)",
+                    step, loss, self.spike, self._ema)
+        a = self.ema_alpha
+        self._ema = loss if self._ema is None else a * self._ema + (
+            1 - a) * loss
+        self._ema_n += 1
+        return tripped
+
+    def check(self, step: int, *, loss: Optional[float] = None,
+              finite: bool = True, param_digest=None,
+              recompute: Optional[Callable[[int], Any]] = None
+              ) -> Verdict:
+        """Run the cadence check: detectors, then cross-rank agreement
+        over the window gathered since the previous check.
+
+        ``recompute(divergent_step)`` re-derives that step's gradient
+        digest (the redundant-recompute vote on the sampled
+        microbatch): deterministic trainers pass an exact recompute; a
+        data-parallel trainer can reproduce only the current step's
+        retained microbatch and passes None otherwise — mismatches then
+        resolve by majority, or stay unattributed (rollback-only)."""
+        step = int(step)
+        _metrics.GUARD_CHECKS.labels("finite").inc()
+        spike = False
+        if loss is not None:
+            _metrics.GUARD_CHECKS.labels("spike").inc()
+            spike = self._spike_check(step, float(loss))
+        nan = (not finite
+               or (loss is not None and not np.isfinite(loss)))
+        if nan:
+            _metrics.GUARD_TRIPS.labels("finite").inc()
+            get_logger().error(
+                "guard: NaN/Inf detected at step %d — rolling back to "
+                "the last verified checkpoint", step)
+
+        with self._lock:
+            entries = list(self._window)
+            self._window.clear()
+        if entries:
+            # THE one bounded host sync per cadence: live device arrays
+            # in the window come down in a single batched device_get,
+            # not one blocking round-trip per stored digest
+            import jax
+
+            vals = jax.device_get([d for _, d in entries])
+        else:
+            vals = []
+        window = [(s, _canon(v).hex())
+                  for (s, _), v in zip(entries, vals)]
+        if self.world <= 1 or self.exchange is None:
+            if nan:
+                return Verdict(step=step, ok=False, kind="nan",
+                               spike=spike,
+                               detail="non-finite loss/gradients")
+            self._mark_verified(step)
+            return Verdict(step=step, ok=True, kind="verified",
+                           spike=spike)
+
+        # a NaN-tripped rank must STILL join the exchange: peers are
+        # already entering this step's gather, and a rank that bails
+        # early leaves them blocked in a collective that never
+        # completes (or stalling a full board timeout) — the nan flag
+        # rides the payload instead, so every rank reaches the same
+        # verdict in the same number of rounds
+        _metrics.GUARD_CHECKS.labels("digest").inc()
+        payload = json.dumps({
+            "step": step,
+            "window": window,
+            "nan": nan,
+            "param": None if param_digest is None
+            else _canon(param_digest).hex(),
+        }).encode()
+        boards = self.exchange.gather(f"chk-{step}", payload,
+                                      world=self.world, rank=self.rank)
+        views: List[Optional[dict]] = []
+        for b in boards:
+            try:
+                views.append(None if b is None else json.loads(b))
+            except ValueError:
+                views.append(None)
+        if any(v is None for v in views):
+            missing = [r for r, v in enumerate(views) if v is None]
+            get_logger().warning(
+                "guard: step-%d agreement check missing rank(s) %s "
+                "(exchange timeout) — window unverified", step, missing)
+            if nan:
+                return Verdict(step=step, ok=False, kind="nan",
+                               spike=spike,
+                               detail="non-finite loss/gradients")
+            return Verdict(step=step, ok=True, kind="partial",
+                           spike=spike,
+                           detail=f"missing ranks {missing}")
+        nan_ranks = [r for r, v in enumerate(views) if v.get("nan")]
+        if nan_ranks:
+            # non-finite values anywhere poison the window for every
+            # rank (the allreduce already mixed them in): rollback-all,
+            # no attribution — a NaN names a value, not its producer
+            return Verdict(step=step, ok=False, kind="nan", spike=spike,
+                           detail=f"non-finite on rank(s) {nan_ranks}")
+        verdict = self._judge(step, views, recompute)
+        verdict.spike = spike
+        if verdict.ok:
+            self._mark_verified(step)
+        return verdict
+
+    def _mark_verified(self, step: int) -> None:
+        self.last_verified_step = step
+        os.environ[ENV_VERIFIED] = str(step)  # survives the execv
+        _metrics.GUARD_LAST_VERIFIED.set(step)
+        if 0 <= self._rollback_barrier < step:
+            # progress got PAST the step that tripped the last
+            # rollback: the fault was transient — disarm the loop fuse
+            self._rollback_count = 0
+            self._rollback_barrier = -1
+            os.environ.pop(ENV_ROLLBACK_COUNT, None)
+            os.environ.pop(ENV_ROLLBACK_STEP, None)
+
+    def _judge(self, step: int, views: Sequence[dict],
+               recompute) -> Verdict:
+        """Compare the gathered windows/param digests; attribute."""
+        params = [v.get("param") for v in views]
+        tables = [dict(v.get("window") or ()) for v in views]
+        all_steps = sorted({s for t in tables for s in t})
+        divergent = None
+        for s in all_steps:
+            vals = {t.get(s) for t in tables if s in t}
+            if len(vals) > 1:
+                divergent = s
+                break
+        # a rank that fingerprinted no params (the hook is optional)
+        # abstains — absence must never read as disagreement
+        params_agree = len({p for p in params if p is not None}) <= 1
+        if divergent is None and params_agree:
+            return Verdict(step=step, ok=True, kind="verified")
+
+        _metrics.GUARD_TRIPS.labels("digest").inc()
+        # -- attribute: majority vote at the first divergent point ----------
+        if divergent is not None:
+            votes = [t.get(divergent) for t in tables]
+        else:
+            votes = list(params)
+        # a rank with NO entry at the divergent step (e.g. it restarted
+        # mid-window) casts no vote: it neither supports nor contradicts
+        # the majority, and must never be attributed by absence
+        cast = [v for v in votes if v is not None]
+        counts: Dict[Any, int] = {}
+        for v in cast:
+            counts[v] = counts.get(v, 0) + 1
+        modal, modal_n = max(counts.items(), key=lambda kv: kv[1])
+        attributed: List[int] = []
+        if modal_n * 2 > len(cast):
+            attributed = [r for r, v in enumerate(votes)
+                          if v is not None and v != modal]
+            outcome = ("self" if self.rank in attributed
+                       else "peer" if attributed else "unattributed")
+        else:
+            # pairwise tie: the redundant-recompute vote — my own
+            # recompute of the divergent step disagreeing with what I
+            # published means the corruption was MINE (a transient flip
+            # in my compute); a second exchange round shares verdicts
+            self_ok = True
+            if divergent is not None and recompute is not None:
+                try:
+                    mine = tables[self.rank].get(divergent)
+                    redone = _canon(recompute(divergent)).hex()
+                    self_ok = (mine is None) or (redone == mine)
+                except Exception as e:  # a failing recompute is no vote
+                    get_logger().warning(
+                        "guard: recompute vote failed (%s: %s)",
+                        type(e).__name__, e)
+            flags = self.exchange.gather(
+                f"vote-{step}", b"1" if self_ok else b"0",
+                world=self.world, rank=self.rank)
+            attributed = [r for r, f in enumerate(flags) if f == b"0"]
+            outcome = ("self" if self.rank in attributed
+                       else "peer" if attributed else "unattributed")
+        _metrics.GUARD_ATTRIBUTIONS.labels(outcome).inc()
+        get_logger().error(
+            "guard: CROSS-RANK DIGEST MISMATCH at step %d (first "
+            "divergent step %s) — attributed rank(s) %s%s",
+            step, divergent, attributed or "none (unattributed)",
+            " [THIS RANK]" if self.rank in attributed else "")
+        return Verdict(
+            step=step, ok=False, kind="mismatch", attributed=attributed,
+            self_attributed=self.rank in attributed,
+            divergent_step=divergent,
+            detail=f"votes={votes}")
+
+    # -- response policy -----------------------------------------------------
+
+    def respond(self, verdict: Verdict, state=None) -> None:
+        """Drive the response: nothing on ok; quarantine when THIS rank
+        was attributed; roll back to the last verified checkpoint
+        otherwise (non-elastic contexts raise :class:`IntegrityError`
+        instead of exec-restarting)."""
+        if verdict.ok:
+            return
+        if verdict.self_attributed:
+            self.quarantine(verdict)
+            return  # only reachable with a test exit_fn
+        self.rollback(state=state, reason=verdict.kind,
+                      step=verdict.step)
+
+    def quarantine(self, verdict: Verdict) -> None:
+        """This rank computed a wrong value: report the integrity
+        failure to the elastic driver (which blacklists this whole
+        HOST — a lying chip taints its machine) and exit with
+        :data:`QUARANTINE_EXIT`."""
+        get_logger().error(
+            "guard: this rank attributed as corrupt at step %d — "
+            "reporting integrity failure and quarantining (exit %d)",
+            verdict.step, QUARANTINE_EXIT)
+        try:
+            from .elastic.worker import (
+                elastic_enabled, notification_manager,
+            )
+
+            if elastic_enabled():
+                notification_manager.report_integrity_failure(
+                    f"silent corruption attributed at step "
+                    f"{verdict.step} (divergent step "
+                    f"{verdict.divergent_step})")
+                time.sleep(0.2)  # let the report drain before exit
+        except Exception:
+            pass  # the exit itself still blacklists the slot
+        self._exit(QUARANTINE_EXIT)
+
+    def rollback(self, state=None, reason: str = "mismatch",
+                 step: Optional[int] = None) -> None:
+        """Survivor response: discard the poisoned window.  Checkpoints
+        newer than the last VERIFIED step are deleted, the board
+        generation is bumped (stale exchange entries read as absent),
+        and in elastic mode the worker exec-restarts with NO live
+        snapshot — post-boot auto-resume then restores the newest
+        surviving (verified, checksummed) checkpoint and the skipped
+        steps re-run.  Non-elastic callers get :class:`IntegrityError`
+        and own their own reload.
+
+        NOTE: the checkpoint ring's ``keep`` must exceed the guard
+        cadence (keep >= cadence + 1; 2x is comfortable) — a shallower
+        ring can have every entry inside the poisoned window, leaving
+        nothing to roll back to (the discard logs loudly and resume
+        then degrades to step 0).
+
+        LOOP FUSE: ``HVD_TPU_GUARD_MAX_ROLLBACKS`` (default 3)
+        consecutive rollbacks without a verified check ever getting
+        PAST the tripping step mean the fault reproduces
+        deterministically — a real training divergence (lr blowup, bad
+        batch), not transient corruption.  The guard then REFUSES to
+        restart and raises :class:`IntegrityError` naming the step, so
+        the real error surfaces instead of an unbounded restart loop
+        burning the fleet."""
+        del state  # the live state is poisoned by definition; never kept
+        if step is not None:
+            self._rollback_barrier = max(self._rollback_barrier,
+                                         int(step))
+        self._rollback_count += 1
+        if self._rollback_count > self.max_rollbacks:
+            get_logger().error(
+                "guard: %d consecutive rollbacks never got past step "
+                "%s — this failure REPRODUCES deterministically "
+                "(likely a real training divergence, not transient "
+                "corruption); refusing to restart again",
+                self._rollback_count - 1, self._rollback_barrier)
+            raise IntegrityError(
+                f"integrity trip at step {self._rollback_barrier} "
+                f"reproduced across {self._rollback_count - 1} "
+                f"rollbacks ({reason}); refusing another restart — "
+                "inspect the training run (HVD_TPU_GUARD_MAX_ROLLBACKS "
+                "raises the fuse)")
+        os.environ[ENV_ROLLBACK_COUNT] = str(self._rollback_count)
+        os.environ[ENV_ROLLBACK_STEP] = str(self._rollback_barrier)
+        _metrics.GUARD_ROLLBACKS.inc()
+        get_logger().error(
+            "guard: rolling back to last verified step %d (%s)",
+            self.last_verified_step, reason)
+        if self.ckpt_dir:
+            from . import checkpoint as _checkpoint
+
+            removed = _checkpoint.discard_newer_than(
+                self.ckpt_dir, self.last_verified_step)
+            if removed:
+                get_logger().warning(
+                    "guard: discarded %d checkpoint(s) inside the "
+                    "poisoned window: %s", len(removed),
+                    [os.path.basename(p) for p in removed])
+        # bump the board generation (inherited across the execv): the
+        # re-run's exchanges must never read this era's entries —
+        # deleting them instead would race peers still mid-gather
+        os.environ[ENV_GEN] = str(env_int(ENV_GEN, 0) + 1)
+        os.environ[ENV_ROLLBACK_T0] = f"{time.time():.4f}"
+        try:
+            from .elastic.worker import (
+                _persist_and_exec, elastic_enabled,
+            )
+
+            if elastic_enabled():
+                _persist_and_exec(None)  # does not return
+        except ImportError:
+            pass
+        raise IntegrityError(
+            f"silent corruption detected ({reason}); rolled the "
+            f"checkpoint ring back to verified step "
+            f"{self.last_verified_step} — reload it to continue")
+
+    # -- compiled-step convenience -------------------------------------------
+
+    def on_train_step(self, step: int, loss, diag: Dict[str, Any],
+                      params=None,
+                      recompute: Optional[Callable[[int], Any]] = None,
+                      state=None) -> Optional[Verdict]:
+        """One call per compiled step from a training loop
+        (:func:`training.fit_epoch` wires this): records the step's
+        device digest without syncing, and at cadence performs the ONE
+        bounded host sync (window + loss + param fingerprint), the
+        agreement check, and the response.  Returns the verdict on
+        cadence steps (None between them)."""
+        if not self.enabled:
+            return None
+        self.observe_grads(step, diag["digest"])
+        if not self.due(step):
+            return None
+        finite = bool(np.asarray(diag["finite"]))
+        pdig = self.param_digest(params) if params is not None else None
+        verdict = self.check(
+            step, loss=float(np.asarray(loss)), finite=finite,
+            param_digest=pdig, recompute=recompute)
+        self.respond(verdict, state=state)
+        return verdict

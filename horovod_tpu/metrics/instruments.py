@@ -293,6 +293,15 @@ SERVE_REQUESTS = counter(
     ["event"],
 )
 
+#: Requests shed (pre-admission) or cancelled (in-flight) because
+#: their deadline budget (``Request.deadline_s`` /
+#: ``HVD_TPU_SERVE_DEADLINE``) was already spent — tokens a client has
+#: stopped waiting for are never computed.
+SERVE_DEADLINE_EXCEEDED = counter(
+    "hvd_tpu_serve_deadline_exceeded_total",
+    "Serving requests shed or cancelled past their deadline budget",
+)
+
 #: Per-chip ICI bytes the tensor-sharded step's row-parallel psums
 #: stream (2 per decoder layer; modeled via
 #: ops.comm_model.modeled_serve_psum_bytes, == the lowered program's
@@ -362,6 +371,60 @@ FLEET_PREEMPTIONS = counter(
     "Preemption notices this worker honored with a planned leave",
 )
 
+#: Replicas the router marked suspect (ejected from placement, work
+#: re-routed) after ``HVD_TPU_FLEET_REPLICA_ERRORS`` consecutive
+#: submit/step errors or a healthz stall trip.
+FLEET_REPLICA_SUSPECTS = counter(
+    "hvd_tpu_fleet_replica_suspects_total",
+    "Serving replicas marked suspect and ejected by the fleet router",
+)
+
+# -- integrity guard (guard.py — docs/FAULT_TOLERANCE.md, silent corruption) -
+
+#: Detector evaluations at cadence, by check kind (finite sentinel /
+#: EMA loss spike / cross-rank digest agreement).
+GUARD_CHECKS = counter(
+    "hvd_tpu_guard_checks_total",
+    "Integrity-guard detector evaluations, by check kind",
+    ["check"],  # finite / spike / digest
+)
+
+#: Detector trips — a check that found something wrong, by kind.
+GUARD_TRIPS = counter(
+    "hvd_tpu_guard_trips_total",
+    "Integrity-guard detector trips (corruption signals), by check kind",
+    ["check"],  # finite / spike / digest
+)
+
+#: Attribution outcomes after a digest mismatch: ``self`` = this rank
+#: was named corrupt (quarantine path), ``peer`` = another rank was,
+#: ``unattributed`` = no majority and no recompute vote (rollback-only).
+GUARD_ATTRIBUTIONS = counter(
+    "hvd_tpu_guard_attributions_total",
+    "Corruption attribution outcomes after a cross-rank digest mismatch",
+    ["outcome"],  # self / peer / unattributed
+)
+
+#: Rollbacks to the last verified checkpoint (poisoned-window discards).
+GUARD_ROLLBACKS = counter(
+    "hvd_tpu_guard_rollbacks_total",
+    "Auto-rollbacks to the last integrity-verified checkpoint",
+)
+
+#: Newest step whose cross-rank agreement check passed — checkpoints at
+#: or before it are trustable rollback targets.
+GUARD_LAST_VERIFIED = gauge(
+    "hvd_tpu_guard_last_verified_step",
+    "Newest training step that passed the cross-rank integrity check",
+)
+
+#: Hosts the elastic driver quarantined after an integrity attribution
+#: (every slot of the attributed worker's host leaves the spawn pool).
+GUARD_QUARANTINES = counter(
+    "hvd_tpu_guard_quarantined_hosts_total",
+    "Hosts quarantined by the elastic driver after integrity attribution",
+)
+
 # -- elastic (runner/elastic_driver.py, elastic/worker.py) -------------------
 
 ELASTIC_WORLD_SIZE = gauge(
@@ -429,7 +492,9 @@ RETRY_ATTEMPTS = histogram(
 RECOVERY_SECONDS = gauge(
     "hvd_tpu_recovery_seconds",
     "Wall time of the most recent failure recovery, by phase",
-    ["phase"],  # restart / auto_resume / planned (preemption leave)
+    # restart / auto_resume / planned (preemption leave) /
+    # rollback (guard: corruption detection -> post-boot verified resume)
+    ["phase"],
 )
 
 # -- adapters (torch/optimizer.py, keras/callbacks.py) -----------------------

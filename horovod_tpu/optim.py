@@ -668,6 +668,17 @@ def ZeroSpmdOptimizer(
     the two program shapes (tests/test_overlap.py, docs/OPTIM.md).
     Error-feedback compression cannot ride that slice (no wire hop);
     the update-shard allgather is unchanged.
+
+    Integrity-guard composition (``horovod_tpu.guard``,
+    docs/FAULT_TOLERANCE.md; ``training.zero_train_setup(guard=True)``
+    wires it): the guard's agreement object is the POST-allgather
+    update deltas this wrapper returns — replicated across the axis,
+    so digests compare cross-rank directly.  Per-chip intermediates
+    (the reduce-scattered shards, local grads) deliberately carry NO
+    detector: they differ across devices by design, so their values
+    cannot ride a replicated diag output, and a non-finite shard
+    reaches the returned deltas through the inner update the same
+    step anyway.
     """
     if op not in (ReduceOp.AVERAGE, ReduceOp.SUM):
         raise ValueError(

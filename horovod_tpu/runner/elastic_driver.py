@@ -186,6 +186,11 @@ class ElasticDriver:
         self._cv = threading.Condition(self._lock)
         self._workers: Dict[int, _Worker] = {}
         self._blacklist: set = set()  # (host, slot) pairs
+        # hosts quarantined after an integrity attribution (guard.py):
+        # a machine whose chip computed wrong values leaves the spawn
+        # pool entirely — EVERY slot it advertises is skipped, not just
+        # the one the attributed worker held (docs/FAULT_TOLERANCE.md)
+        self._host_blacklist: set = set()
         self._next_worker_id = 0
         self._epoch = 0
         # rendezvous state: worker_id -> socket awaiting an assignment
@@ -288,6 +293,8 @@ class ElasticDriver:
                     "elastic: worker %s reports failure: %s",
                     wid, msg.get("reason", ""))
                 with self._cv:
+                    if msg.get("integrity"):
+                        self._quarantine_host(wid)
                     self._failure_reported = True
                     self._cv.notify_all()
             elif msg.get("type") == "leaving":
@@ -313,6 +320,33 @@ class ElasticDriver:
                 # the preemption as job completion
                 try:
                     conn.sendall(_signed_line({"type": "leaving_ack"}))
+                except OSError:
+                    pass
+
+    def _quarantine_host(self, wid: int) -> None:
+        """Integrity attribution (guard.py closed loop): quarantine the
+        attributed worker's WHOLE host — a lying chip taints its
+        machine, and refilling any of its slots would hand the fleet
+        back to it.  SIBLING workers still running there are hard-
+        killed too: leaving them computing would keep re-tripping the
+        guard until the survivors' rollback fuse kills the whole job;
+        their exits book through ``_observe_exits`` as normal failures.
+        Caller must hold ``self._cv``."""
+        w = self._workers.get(wid)
+        if w is None or w.host in self._host_blacklist:
+            return
+        self._host_blacklist.add(w.host)
+        _metrics.GUARD_QUARANTINES.inc()
+        get_logger().error(
+            "elastic: host %s QUARANTINED after integrity attribution "
+            "of worker %s", w.host, wid)
+        for s in self._workers.values():
+            if s.alive and s.host == w.host and s.worker_id != wid:
+                get_logger().error(
+                    "elastic: killing worker %d — sibling slot on "
+                    "quarantined host %s", s.worker_id, s.host)
+                try:
+                    s.proc.kill()
                 except OSError:
                     pass
 
@@ -416,6 +450,8 @@ class ElasticDriver:
             held = set(self._slot_hold)
         slots = []
         for h, n in hosts:
+            if h in self._host_blacklist:
+                continue  # quarantined after integrity attribution
             for s in range(n):
                 if (h, s) not in self._blacklist and (h, s) not in held:
                     slots.append((h, s))

@@ -55,7 +55,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..common.retry import env_int
+from ..common.retry import env_float, env_int
 from ..data.prefetch import DevicePrefetcher
 from ..metrics import instruments as _instr
 from ..models.transformer import Transformer, TransformerConfig
@@ -158,6 +158,12 @@ class ServeConfig:
     decode_tiers: Tuple[int, ...] = (1, 2, 4, 8)
     prefill_chunk: int = 0
     prefix_cache: bool = True
+    #: default per-request latency budget in seconds from arrival
+    #: (``HVD_TPU_SERVE_DEADLINE``; 0 = none): requests past it are
+    #: shed pre-admission and cancelled in flight — compute never goes
+    #: to tokens the client has stopped waiting for.  Per-request
+    #: ``submit(deadline_s=...)`` overrides.
+    deadline_s: float = 0.0
     #: tensor-shard the engine over this many chips of one ICI slice
     #: (kv heads + paged pool head-sharded, Megatron FFN; must divide
     #: num_kv_heads/num_heads/d_model*mlp_ratio — docs/SERVING.md).
@@ -192,6 +198,9 @@ class ServeConfig:
         if "prefix_cache" not in overrides:
             fields["prefix_cache"] = bool(env_int(
                 "HVD_TPU_SERVE_PREFIX_CACHE", int(base.prefix_cache)))
+        if "deadline_s" not in overrides:
+            fields["deadline_s"] = env_float("HVD_TPU_SERVE_DEADLINE",
+                                             base.deadline_s)
         if "shards" not in overrides:
             fields["shards"] = env_int("HVD_TPU_SERVE_SHARDS", base.shards)
         return cls(**fields)
@@ -354,6 +363,9 @@ class ServingEngine:
         self.accepting = True
         self.results: Dict[int, np.ndarray] = {}
         self._ids_seen: set = set()
+        #: True once any request carried a deadline — gates the per-step
+        #: expiry scans off the no-deadline hot path
+        self._any_deadline = serve.deadline_s > 0
         #: set to a list to record (request_id, emit_time, arrival) per
         #: token — the bench's raw latency trace (off by default: the
         #: registry histograms carry production quantiles)
@@ -548,19 +560,29 @@ class ServingEngine:
                 f"{self.cfg.max_seq_len}")
 
     def submit(self, prompt, max_new_tokens: int, *, eos_id=None,
-               arrival: Optional[float] = None) -> int:
-        """Enqueue one request; returns its id (key into ``results``)."""
+               arrival: Optional[float] = None,
+               deadline_s: Optional[float] = None) -> int:
+        """Enqueue one request; returns its id (key into ``results``).
+        ``deadline_s`` overrides the engine's default latency budget
+        (``ServeConfig.deadline_s``); past it the request is shed or
+        cancelled and ``results`` carries whatever was generated."""
         if not self.accepting:
             raise RuntimeError(
                 "engine is draining (accepting=False); submit rejected")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         self._validate_request(len(prompt), max_new_tokens)
+        if deadline_s is None:
+            deadline_s = self.serve_cfg.deadline_s
         req = Request(
             id=self._next_id, prompt=prompt,
             max_new_tokens=int(max_new_tokens), eos_id=eos_id,
-            arrival=self._clock() if arrival is None else arrival)
+            arrival=self._clock() if arrival is None else arrival,
+            deadline_s=deadline_s if deadline_s and deadline_s > 0
+            else None)
         self._next_id += 1
         self._ids_seen.add(req.id)
+        if req.deadline_s:
+            self._any_deadline = True
         self.scheduler.submit(Sequence(req=req, context=prompt))
         _REQ_SUBMITTED.inc()
         return req.id
@@ -612,6 +634,19 @@ class ServingEngine:
                 self.scheduler._book()  # refresh staged-depth gauge
                 return
             req = self._staging_meta.popleft()
+            if req.deadline_s is None and self.serve_cfg.deadline_s > 0:
+                # sourced requests inherit the engine default exactly
+                # like submit()'s do — the open-loop intake is the path
+                # overload shedding exists for
+                req.deadline_s = self.serve_cfg.deadline_s
+            if req.deadline_s and not req.arrival:
+                # a deadline is measured FROM arrival: a request whose
+                # source left arrival at the 0.0 default would read as
+                # hours past budget against a perf_counter clock and
+                # shed instantly — its clock starts when it surfaces
+                req.arrival = self._clock()
+            if req.deadline_s:
+                self._any_deadline = True
             # caller-chosen ids and submit()'s counter share `results`:
             # reject an id already used (it would silently clobber that
             # request's output) and keep the counter strictly above
@@ -771,10 +806,57 @@ class ServingEngine:
             # the emitted stream: tokens folded into context by evictions
             # plus those generated since (an EOS always completes the
             # sequence the step it is emitted, so none hides mid-stream)
-            self.results[seq.req.id] = np.concatenate([
-                seq.context[len(seq.req.prompt):],
-                np.asarray(seq.generated, np.int32)])
+            self.results[seq.req.id] = self._partial_result(seq)
             _REQ_COMPLETED.inc()
+
+    def _partial_result(self, seq: Sequence) -> np.ndarray:
+        """Whatever a sequence generated so far (tokens folded into the
+        context by evictions plus those generated since) — the output
+        an aborted request publishes."""
+        return np.concatenate([
+            seq.context[len(seq.req.prompt):].astype(np.int32),
+            np.asarray(seq.generated, np.int32)])
+
+    def _finalize_shed(self) -> None:
+        """Publish partial outputs for deadline-shed/cancelled
+        sequences — ``results`` carries whatever was generated (often
+        nothing), so callers (and the fleet router's collection pass)
+        never wait on a request the engine already gave up on."""
+        for seq in self.scheduler.shed:
+            self.results[seq.req.id] = self._partial_result(seq)
+            _instr.SERVE_REQUESTS.labels("expired").inc()
+        self.scheduler.shed.clear()
+
+    def cancel_all(self) -> None:
+        """Abort EVERY request this engine still holds — running,
+        pending, deadline-shed, or device-staged — publishing each
+        one's partial result (often empty) so no caller polling
+        ``results`` waits on a request the engine gave up on.  Running
+        sequences release their blocks through the normal refcount
+        path.  The fleet router's ejection hook: a SUSPECT replica's
+        re-routable work was already re-submitted elsewhere; this
+        clears the bookkeeping so the replica reads as drained without
+        ever stepping again."""
+        sched = self.scheduler
+        self._finalize_shed()
+        for seq in list(sched.running):
+            sched.finish(seq)
+            self.results.setdefault(seq.req.id, self._partial_result(seq))
+        for seq in list(sched.pending):
+            self.results.setdefault(seq.req.id, self._partial_result(seq))
+        sched.pending.clear()
+        if self._staging is not None:
+            # stop the staging producer FIRST (close joins its thread):
+            # it appends to _staging_meta concurrently, and snapshotting
+            # before it stops would publish results for a prefix while
+            # the tail keeps arriving — pollers of the tail's ids would
+            # wait forever, and the producer would park on a full queue
+            self._staging.close()
+        for req in list(self._staging_meta):
+            self.results.setdefault(req.id, np.zeros((0,), np.int32))
+        self._staging_meta.clear()
+        self._source_done = True
+        sched._book()
 
     # -- the scheduler loop --------------------------------------------------
 
@@ -786,7 +868,16 @@ class ServingEngine:
         otherwise.  Returns False when there is nothing left to do."""
         idle = not self.scheduler.running and not self.scheduler.pending
         self._drain_staging(block=idle and not self._source_done)
-        self.scheduler.admit()
+        if self._any_deadline:
+            now = self._clock()
+            # cancel expired in-flight sequences (blocks free through
+            # the normal refcount path) and shed expired admits; their
+            # partial results publish so callers never wait forever
+            self.scheduler.cancel_expired(now)
+            self.scheduler.admit(now)
+            self._finalize_shed()
+        else:
+            self.scheduler.admit()
         self.scheduler.grow_running()
         running = list(self.scheduler.running)
         decode_rows = [s for s in running if s.in_decode]

@@ -75,6 +75,11 @@ class Request:
     max_new_tokens: int
     eos_id: Optional[int] = None
     arrival: float = 0.0  # open-loop load injection timestamp (bench)
+    #: latency budget in seconds from ``arrival`` (None/0 = none):
+    #: once spent, the request is shed pre-admission or cancelled
+    #: in flight — tokens the client stopped waiting for are never
+    #: computed (``HVD_TPU_SERVE_DEADLINE`` sets the engine default)
+    deadline_s: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -134,6 +139,11 @@ class Sequence:
         return eos is not None and len(self.generated) > 0 \
             and self.generated[-1] == eos
 
+    def expired(self, now: float) -> bool:
+        """Deadline budget spent (measured from ``arrival``)."""
+        d = self.req.deadline_s
+        return bool(d) and d > 0 and (now - self.req.arrival) > d
+
 
 class ContinuousBatchingScheduler:
     """Admit/evict sequences against a token budget and a block pool."""
@@ -158,6 +168,10 @@ class ContinuousBatchingScheduler:
         self.max_seq_len = int(max_seq_len)
         self.pending: Deque[Sequence] = collections.deque()
         self.running: List[Sequence] = []
+        #: deadline-shed/cancelled sequences awaiting caller
+        #: finalization (the engine publishes their partial results and
+        #: drains this list every step)
+        self.shed: List[Sequence] = []
         self.evictions = 0
         #: prefix-cache admit statistics (bench hit-rate columns)
         self.prefix_hit_blocks = 0
@@ -252,6 +266,32 @@ class ContinuousBatchingScheduler:
                 seq.block_hashes.append(h)
             seq.published += 1
 
+    # -- deadlines ----------------------------------------------------------
+
+    def _shed(self, seq: Sequence) -> None:
+        self.shed.append(seq)
+        _instr.SERVE_DEADLINE_EXCEEDED.inc()
+
+    def cancel_expired(self, now: float) -> List[Sequence]:
+        """Shed pending requests already past their deadline and cancel
+        expired in-flight sequences — blocks release through the normal
+        refcount path (shared prefix blocks survive for their other
+        holders), the batch slot frees immediately.  Returns the newly
+        shed sequences (also queued on :attr:`shed` for the engine's
+        finalization pass)."""
+        out: List[Sequence] = []
+        for seq in [s for s in self.pending if s.expired(now)]:
+            self.pending.remove(seq)
+            self._shed(seq)
+            out.append(seq)
+        for seq in [s for s in self.running if s.expired(now)]:
+            self.finish(seq)  # the one teardown path: slot + blocks
+            self._shed(seq)
+            out.append(seq)
+        if out:
+            self._book()
+        return out
+
     # -- the per-step decision ----------------------------------------------
 
     def grow_running(self) -> None:
@@ -274,7 +314,7 @@ class ContinuousBatchingScheduler:
                     break
         self._book()
 
-    def admit(self) -> List[Sequence]:
+    def admit(self, now: Optional[float] = None) -> List[Sequence]:
         """Admit pending sequences: token budget, decode-batch slots,
         and block watermark all permitting.  Each admitted sequence
         first matches the longest cached block-aligned prefix of its
@@ -285,13 +325,19 @@ class ContinuousBatchingScheduler:
         sequence whose prefix blocks survived is NOT re-booked at full
         length).  Admitted sequences join ``running`` with
         ``prefilled = cached_len``; the engine prefills the tail in
-        chunks.  Returns the admitted batch (empty = nothing admitted).
-        """
+        chunks.  With ``now``, requests already past their deadline are
+        SHED instead of admitted (their prefill would compute tokens
+        nobody is waiting for).  Returns the admitted batch (empty =
+        nothing admitted)."""
         batch: List[Sequence] = []
         tokens = 0
         bs = self.allocator.block_size
         while self.pending:
             seq = self.pending[0]
+            if now is not None and seq.expired(now):
+                self.pending.popleft()
+                self._shed(seq)
+                continue
             ctx = len(seq.context)  # <= max_seq_len: engine validates at
             # submit and caps generation at max_seq_len
             if len(self.running) + len(batch) + 1 > self.max_decode_batch:

@@ -21,9 +21,19 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .common import basics
+from .common.retry import env_int
 from .common.topology import WORLD_AXIS
 from .ops import spmd_ops
 from .ops.reduce_ops import Average, ReduceOp
+
+
+def _resolve_guard(guard: Optional[bool]) -> bool:
+    """``guard=None`` defers to ``HVD_TPU_GUARD`` (docs/running.md) —
+    the env spelling the ``HVD_TPU_GUARD=0`` zero-added-collectives
+    contract is stated against (tools/guard_bench.py pins it)."""
+    if guard is None:
+        return bool(env_int("HVD_TPU_GUARD", 0))
+    return bool(guard)
 
 
 class TrainState(flax.struct.PyTreeNode):
@@ -96,6 +106,7 @@ def data_parallel_train_step(
     overlap: bool = False,
     segmenter: Optional[Callable] = None,
     bucket_bytes: Optional[int] = None,
+    guard: Optional[bool] = None,
 ) -> Callable:
     """Build the compiled data-parallel train step.
 
@@ -118,7 +129,17 @@ def data_parallel_train_step(
     flagship ``Transformer``; pass ``segmenter`` otherwise) and no
     ``batch_stats``; ``bucket_bytes`` overrides
     ``HVD_TPU_OVERLAP_BUCKET_BYTES``.
+
+    ``guard=True`` (``None`` = the ``HVD_TPU_GUARD`` env flag) makes
+    the step ALSO return the silent-corruption diagnostics
+    (:func:`horovod_tpu.guard.step_diag` over the POST-allreduce
+    gradients): ``step(state, x, y) -> (state, loss, diag)``.  The
+    detectors are pure extra outputs over the same dataflow — state
+    and loss stay BIT-identical to the unguarded step, and no
+    collective is added (the digest exchange runs host-side at
+    cadence; see :func:`fit_epoch` and docs/FAULT_TOLERANCE.md).
     """
+    guard = _resolve_guard(guard)
     if mesh is None:
         mesh = basics._require_init().process_set_registry.get(0).mesh
     if overlap:
@@ -150,15 +171,17 @@ def data_parallel_train_step(
                 grads, state.opt_state, state.params
             )
             new_params = optax.apply_updates(state.params, updates)
-            return (
-                TrainState(
-                    step=state.step + 1,
-                    params=new_params,
-                    opt_state=new_opt_state,
-                    batch_stats=new_stats,
-                ),
-                loss,
+            new_state = TrainState(
+                step=state.step + 1,
+                params=new_params,
+                opt_state=new_opt_state,
+                batch_stats=new_stats,
             )
+            if guard:
+                from .guard import step_diag
+
+                return new_state, loss, step_diag(loss, grads)
+            return new_state, loss
 
         def compute_loss(params):
             variables = {"params": params}
@@ -187,21 +210,23 @@ def data_parallel_train_step(
             grads, state.opt_state, state.params
         )
         new_params = optax.apply_updates(state.params, updates)
-        return (
-            TrainState(
-                step=state.step + 1,
-                params=new_params,
-                opt_state=new_opt_state,
-                batch_stats=new_stats,
-            ),
-            loss,
+        new_state = TrainState(
+            step=state.step + 1,
+            params=new_params,
+            opt_state=new_opt_state,
+            batch_stats=new_stats,
         )
+        if guard:
+            from .guard import step_diag
+
+            return new_state, loss, step_diag(loss, grads)
+        return new_state, loss
 
     sharded = jax.shard_map(
         _step,
         mesh=mesh,
         in_specs=(P(), P(axis), P(axis)),
-        out_specs=(P(), P()),
+        out_specs=(P(), P(), P()) if guard else (P(), P()),
         check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=(0,))
@@ -221,6 +246,7 @@ def zero_train_setup(
     overlap: bool = False,
     segmenter: Optional[Callable] = None,
     bucket_bytes: Optional[int] = None,
+    guard: Optional[bool] = None,
 ):
     """Build a ZeRO-sharded data-parallel trainer over the world mesh.
 
@@ -259,9 +285,22 @@ def zero_train_setup(
     caveat.  Error-feedback DCN compression needs the reduce-scatter
     hop the overlapped exchange folds into the buckets, so it does not
     compose (stateless wire compression does).
+
+    ``guard=True`` (``None`` = ``HVD_TPU_GUARD``) adds the silent-
+    corruption diagnostics as a third step output, composing with
+    every mode above.  Both detectors read only REPLICATED values —
+    the mean loss and the POST-allgather update deltas (the cross-rank
+    agreement object): per-chip intermediates (local grads, the
+    reduce-scattered shards) differ across devices by design and
+    cannot ride the diag's ``P()`` output spec; a non-finite shard is
+    still caught the SAME cadence because the inner update propagates
+    it into the allgathered deltas.  State and loss stay bit-identical
+    to the unguarded step; zero collectives are added.
     """
     from .common.topology import DCN_AXIS, ICI_AXIS
     from .optim import ZeroSpmdOptimizer, zero_opt_state_specs
+
+    guard = _resolve_guard(guard)
 
     if overlap and dcn_compression is not None and getattr(
         dcn_compression, "error_feedback", False
@@ -368,6 +407,21 @@ def zero_train_setup(
             red = jax.lax.all_gather(shard, axis, tiled=True)
         return red[: buf.size] if pad else red
 
+    def _zero_diag(loss, updates):
+        """Guard diagnostics for the ZeRO step, from REPLICATED values
+        only: digest + finite sentinel over the POST-exchange update
+        deltas (identical on every chip after the allgather — the
+        cross-rank agreement object) and the mean loss.  Per-chip
+        intermediates (local grads, reduce-scattered shards) differ
+        across devices by design: feeding them to a ``P()``-spec'd
+        output would surface ONE device's flag and silently drop the
+        rest (check_vma=False) — and a non-finite shard reaches these
+        deltas through the inner update the same step anyway."""
+        from .guard import device_allfinite, device_digest
+
+        return {"finite": device_allfinite((loss, updates)),
+                "digest": device_digest(updates)}
+
     def _step(state: TrainState, images, labels):
         if overlap:
             from .ops.overlap import overlapped_value_and_grad
@@ -388,15 +442,15 @@ def zero_train_setup(
                 grads, state.opt_state, state.params
             )
             new_params = optax.apply_updates(state.params, updates)
-            return (
-                TrainState(
-                    step=state.step + 1,
-                    params=new_params,
-                    opt_state=new_opt_state,
-                    batch_stats=new_stats,
-                ),
-                loss,
+            new_state = TrainState(
+                step=state.step + 1,
+                params=new_params,
+                opt_state=new_opt_state,
+                batch_stats=new_stats,
             )
+            if guard:
+                return new_state, loss, _zero_diag(loss, updates)
+            return new_state, loss
 
         def compute_loss(params):
             variables = {"params": params}
@@ -421,22 +475,22 @@ def zero_train_setup(
             grads, state.opt_state, state.params
         )
         new_params = optax.apply_updates(state.params, updates)
-        return (
-            TrainState(
-                step=state.step + 1,
-                params=new_params,
-                opt_state=new_opt_state,
-                batch_stats=new_stats,
-            ),
-            loss,
+        new_state = TrainState(
+            step=state.step + 1,
+            params=new_params,
+            opt_state=new_opt_state,
+            batch_stats=new_stats,
         )
+        if guard:
+            return new_state, loss, _zero_diag(loss, updates)
+        return new_state, loss
 
     data_spec = P(axis)
     sharded = jax.shard_map(
         _step,
         mesh=mesh,
         in_specs=(state_specs, data_spec, data_spec),
-        out_specs=(state_specs, P()),
+        out_specs=(state_specs, P(), P()) if guard else (state_specs, P()),
         check_vma=False,
     )
     return state, jax.jit(sharded, donate_argnums=(0,)), ospecs
@@ -445,7 +499,9 @@ def zero_train_setup(
 def fit_epoch(step: Callable, state: TrainState, loader,
               epoch: Optional[int] = None, *,
               checkpoint_dir: Optional[str] = None,
-              checkpoint_every: int = 0):
+              checkpoint_every: int = 0,
+              checkpoint_keep: Optional[int] = None,
+              guard=None):
     """Drive one epoch of a compiled train step from a
     :class:`horovod_tpu.data.DataLoader` (or any iterable of
     ``(inputs, labels)`` batches).
@@ -466,6 +522,20 @@ def fit_epoch(step: Callable, state: TrainState, loader,
     (docs/FAULT_TOLERANCE.md).  The ``int(state.step)`` read is the only
     device sync this adds, and only on checkpoint batches.
 
+    ``guard`` takes an armed :class:`horovod_tpu.guard.IntegrityGuard`
+    when ``step`` was built with ``guard=True``: each step's on-device
+    diagnostics feed the guard without a host sync, and on cadence
+    steps the guard performs its ONE bounded sync (window + loss +
+    param fingerprint), the cross-rank agreement check, and the
+    response — :class:`~horovod_tpu.guard.IntegrityError` on detected
+    corruption in non-elastic runs (reload a verified checkpoint), the
+    quarantine/rollback restart path under the elastic driver
+    (docs/FAULT_TOLERANCE.md, silent corruption).  ``checkpoint_keep``
+    sizes the ring (default 3; with a guard armed it defaults to
+    ``2 * guard.cadence`` — rollback discards every checkpoint newer
+    than the last verified step, so a ring shallower than the cadence
+    could be emptied entirely, degrading resume to step 0).
+
     Returns ``(state, last_loss)`` with the loss fetched to host — the
     end-of-epoch sync point.  ``last_loss`` is None for an empty shard.
     """
@@ -474,17 +544,37 @@ def fit_epoch(step: Callable, state: TrainState, loader,
 
     if epoch is not None and hasattr(loader, "set_epoch"):
         loader.set_epoch(epoch)
+    if checkpoint_keep is None:
+        checkpoint_keep = (max(3, 2 * guard.cadence)
+                           if guard is not None
+                           and getattr(guard, "enabled", False) else 3)
     loss = None
     batches = 0
+    guard_base = None
     for inputs, labels in loader:
         if _chaos.active:
             _chaos.raise_point("training.step")
-        state, loss = step(state, inputs, labels)
+        out = step(state, inputs, labels)
+        if len(out) == 3:
+            state, loss, diag = out
+            if guard is not None:
+                if guard_base is None:
+                    # the guard numbers steps GLOBALLY (state.step):
+                    # checkpoints are keyed by it, so rollback's
+                    # discard_newer_than and the exchange keys must
+                    # share the numbering across epochs and resumes.
+                    # One host sync per fit_epoch call, not per step.
+                    guard_base = int(state.step) - batches - 1
+                guard.on_train_step(guard_base + batches + 1, loss,
+                                    diag, params=state.params)
+        else:
+            state, loss = out
         batches += 1
         if (checkpoint_dir and checkpoint_every
                 and batches % checkpoint_every == 0):
             _checkpoint.save_checkpoint(
-                checkpoint_dir, state, int(state.step)
+                checkpoint_dir, state, int(state.step),
+                keep=checkpoint_keep,
             )
     if loss is not None:
         loss = float(loss)  # the only sync some remote backends honor

@@ -17,6 +17,15 @@ Env:
       negotiation, heartbeats, exec-restart recovery) is fully exercised,
       only the cross-worker state broadcast is skipped.  On real TPU
       fleets leave it unset.
+  HVD_TPU_GUARD=1             arm the silent-corruption guard (guard.py):
+      every step's increment rides guard.tap_grads (the guard.grad chaos
+      site — a flipbit rule here IS the SDC drill) and its digest joins
+      the agreement window; at HVD_TPU_GUARD_CADENCE the ranks exchange
+      windows over the HVD_TPU_GUARD_BOARD directory, attribute any
+      mismatch (recompute vote: the increment is deterministic), and the
+      attributed rank quarantines while survivors roll back to the last
+      verified checkpoint.  The 'guard' / 'rollback_done' log events
+      carry what the sdc soak scenario asserts.
 
 Every batch "trains" by incrementing ``weight`` by exactly 1, so after
 any fault/recovery dance the final weight must equal the batch count —
@@ -60,6 +69,17 @@ def main():
     state = cls(step=0, weight=np.zeros(()))
     state.enable_auto_resume(ckpt_dir, step_attr="step")
 
+    # silent-corruption guard (docs/FAULT_TOLERANCE.md): armed by
+    # HVD_TPU_GUARD=1 — constructed AFTER init so world/rank are live,
+    # and before training so a rollback restart books its wall time
+    from horovod_tpu import guard as hvd_guard
+
+    iguard = hvd_guard.IntegrityGuard.from_env(
+        world=hvd.cross_size(), rank=hvd.cross_rank(), ckpt_dir=ckpt_dir)
+    if iguard.last_rollback_s is not None:
+        log(logdir, event="rollback_done",
+            rollback_s=iguard.last_rollback_s, rank=hvd.cross_rank())
+
     # preemption guard (docs/FLEET.md): SIGTERM (or a fleet.preempt
     # chaos drill) -> planned snapshot -> clean leave; the logged
     # "leave" event carries the planned_s the soak bounds
@@ -90,16 +110,51 @@ def main():
         log(logdir, event="boot", step=int(state.step),
             rank=hvd.cross_rank(), world=hvd.cross_size(),
             restart_total_s=(stats["total_s"] if stats else None))
+        clean_inc = np.ones((), np.float32)
         while state.step < batches:
-            state.weight = np.asarray(state.weight) + 1.0
+            inc = clean_inc
+            if iguard.enabled:
+                # the guard.grad chaos site: a flipbit rule here IS the
+                # silent-corruption drill — the (possibly lying) value
+                # is what this "chip" hands the training step
+                inc = iguard.tap_grads(clean_inc)
+            state.weight = np.asarray(state.weight) + inc
             state.step = int(state.step) + 1
             state.commit()
             if hvd.cross_rank() == 0:
+                # with the guard armed the ring must outlive a full
+                # agreement window: a rollback discards every
+                # checkpoint newer than the last VERIFIED step, and a
+                # ring shallower than the cadence would then be empty
+                # (guard.py rollback docstring)
+                keep = max(3, 2 * iguard.cadence) if iguard.enabled else 3
                 hvd_checkpoint.save_state_checkpoint(
-                    ckpt_dir, state, state.step)
+                    ckpt_dir, state, state.step, keep=keep)
             log(logdir, event="batch", step=state.step,
                 weight=float(state.weight), rank=hvd.cross_rank(),
                 world=hvd.cross_size())
+            if iguard.enabled:
+                iguard.observe_grads(
+                    state.step, hvd_guard.host_digest([inc]))
+                if iguard.due(state.step):
+                    verdict = iguard.check(
+                        state.step, loss=float(state.weight),
+                        param_digest=hvd_guard.host_digest(
+                            [iguard.tap_params(np.asarray(state.weight))]),
+                        # the "sampled microbatch" recompute: the step's
+                        # gradient is deterministic, so any window step
+                        # re-derives exactly — the redundant-recompute
+                        # attribution vote
+                        recompute=lambda s: hvd_guard.host_digest(
+                            [clean_inc]))
+                    log(logdir, event="guard", step=state.step,
+                        kind=verdict.kind, ok=verdict.ok,
+                        attributed=verdict.attributed,
+                        self_attributed=verdict.self_attributed,
+                        divergent_step=verdict.divergent_step,
+                        spike=verdict.spike, rank=hvd.cross_rank(),
+                        verified=iguard.last_verified_step)
+                    iguard.respond(verdict, state=state)
             time.sleep(0.05)
         return float(state.weight)
 

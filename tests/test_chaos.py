@@ -50,10 +50,23 @@ def test_parse_spec_full_grammar():
 @pytest.mark.parametrize("bad", [
     "noseparator", "site:explode", "site:kill,prob=2.0",
     "site:kill,unknown=1", "site:delay,delay=abc", ":kill",
+    "site:scale,factor=abc",
 ])
 def test_parse_spec_rejects(bad):
     with pytest.raises(ChaosSpecError):
         parse_spec(bad)
+
+
+def test_parse_spec_flipbit_and_scale_grammar():
+    rules = parse_spec(
+        "guard.grad:flipbit,at=9,rank=1,fuse=/tmp/f;"
+        "guard.grad:scale,factor=64,prob=0.5,after=3")
+    flip, scale = rules
+    assert flip.action == "flipbit" and flip.at == 9 and flip.times == 1
+    assert flip.rank == 1 and flip.fuse == "/tmp/f"
+    assert scale.action == "scale" and scale.factor == 64.0
+    assert scale.prob == 0.5 and scale.after == 3
+    assert parse_spec("s:scale")[0].factor == 1024.0  # the default
 
 
 # -- evaluation semantics ----------------------------------------------------
@@ -96,6 +109,70 @@ def test_drop_returns_sentinel_and_delay_sleeps():
     t0 = time.perf_counter()
     assert chaos.point("t", "payload") == "payload"
     assert time.perf_counter() - t0 >= 0.04
+
+
+def test_flipbit_ndarray_flips_exactly_one_material_bit():
+    chaos.configure("f:flipbit", seed=0, rank=0)
+    a = np.ones((9,), np.float32)
+    out = chaos.point("f", a)
+    assert out.shape == a.shape and out.dtype == a.dtype
+    assert out is not a and (a == 1.0).all()  # input untouched (copy)
+    diff = out.view(np.uint32) ^ a.view(np.uint32)
+    changed = diff[diff != 0]
+    assert changed.size == 1
+    assert bin(int(changed[0])).count("1") == 1
+    # the flip is MATERIAL (an exponent-region bit) yet stays finite —
+    # the value only a digest, not the NaN/Inf sentinel, can see
+    assert np.isfinite(out).all()
+    assert (out != a).sum() == 1 and not np.allclose(out, a)
+
+
+def test_flipbit_scalars_and_bytes():
+    chaos.configure("f:flipbit,times=10", seed=0, rank=0)
+    out = chaos.point("f", b"\x00\x00\x00")
+    assert sum(bin(b).count("1") for b in out) == 1
+    assert chaos.point("f", 7) != 7
+    assert chaos.point("f", 1.0) not in (1.0, float("inf"))
+    with pytest.raises(chaos.ChaosInjected):
+        chaos.point("f")  # no payload: injected as failure, not a no-op
+
+
+def test_scale_multiplies_and_preserves_dtype():
+    chaos.configure("s:scale,factor=100,times=10", seed=0, rank=0)
+    out = chaos.point("s", np.full((3,), 2.0, np.float32))
+    np.testing.assert_allclose(out, 200.0)
+    assert out.dtype == np.float32
+    assert chaos.point("s", 3.0) == 300.0
+    with pytest.raises(chaos.ChaosInjected):
+        chaos.point("s", "not numeric")
+
+
+def test_flipbit_composes_with_at_and_fuse(tmp_path):
+    fuse = str(tmp_path / "flip.fuse")
+    chaos.configure(f"g:flipbit,at=2,fuse={fuse}", seed=0, rank=0)
+    a = np.ones((4,), np.float32)
+    assert chaos.point("g", a) is a        # eval 0
+    assert chaos.point("g", a) is a        # eval 1
+    out = chaos.point("g", a)              # eval 2: fires + burns fuse
+    assert (out != a).any() and os.path.exists(fuse)
+    assert chaos.point("g", a) is a        # at= implies times=1
+    # a fresh install (the post-restart process) finds the fuse burnt
+    chaos.configure(f"g:flipbit,at=2,fuse={fuse}", seed=0, rank=0)
+    for _ in range(5):
+        assert chaos.point("g", a) is a
+
+
+def test_flipbit_prob_replays_exactly_under_fixed_seed():
+    def trace(seed):
+        chaos.configure("p:flipbit,prob=0.3", seed=seed, rank=0)
+        a = np.ones((4,), np.float32)
+        for _ in range(100):
+            chaos.point("p", a)
+        return [e["eval"] for e in chaos.injection_trace()]
+
+    a, b, c = trace(7), trace(7), trace(8)
+    assert a and a == b
+    assert a != c
 
 
 def test_same_seed_same_trace_different_seed_differs():
@@ -171,6 +248,53 @@ def test_retry_call_single_attempt_by_default():
     with pytest.raises(OSError):
         retry_call(once, site="t.once")
     assert len(calls) == 1
+
+
+def test_retry_deadline_shorter_than_first_backoff_reraises_promptly():
+    """A deadline tighter than even the first backoff cap must clip
+    the sleep to the remaining budget and re-raise at expiry — not
+    serve the full backoff first."""
+    calls = []
+
+    def always():
+        calls.append(time.monotonic())
+        raise OSError("x")
+
+    t0 = time.monotonic()
+    with pytest.raises(OSError):
+        retry_call(always, site="t.tight", timeout=0.1,
+                   base_delay=30.0, max_delay=30.0)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 1.0, f"slept a full backoff past the deadline: " \
+        f"{elapsed:.2f}s"
+    assert len(calls) >= 1
+
+
+def test_retry_deadline_expiring_mid_sleep_returns_promptly():
+    """The jittered sleep is clipped to the deadline: with base_delay
+    far beyond the budget, total wall time tracks the TIMEOUT, not the
+    backoff schedule."""
+    t0 = time.monotonic()
+    with pytest.raises(OSError):
+        retry_call(lambda: (_ for _ in ()).throw(OSError("x")),
+                   site="t.midsleep", timeout=0.3, base_delay=10.0,
+                   max_delay=10.0)
+    elapsed = time.monotonic() - t0
+    assert 0.0 <= elapsed < 1.5, elapsed
+
+
+def test_retry_attempts_and_deadline_compose():
+    """attempts exhausts first when the deadline is generous."""
+    calls = []
+
+    def always():
+        calls.append(1)
+        raise OSError("x")
+
+    with pytest.raises(OSError):
+        retry_call(always, site="t.compose", attempts=2, timeout=30.0,
+                   base_delay=0.001)
+    assert len(calls) == 2
 
 
 # -- crash-atomic checkpoints ------------------------------------------------
@@ -259,6 +383,106 @@ def test_peek_tolerates_garbage_checkpoint(tmp_path):
     assert hvd_checkpoint.peek_state_checkpoint(str(tmp_path)) is None
 
 
+# -- checkpoint content checksums (silent-corruption defense) ----------------
+
+
+def _flip_file_bit(path, offset=None):
+    blob = bytearray(open(path, "rb").read())
+    blob[len(blob) // 2 if offset is None else offset] ^= 0x10
+    open(path, "wb").write(bytes(blob))
+
+
+def test_corrupt_latest_state_checkpoint_falls_back_to_ring(tmp_path):
+    """The corrupt-latest-checkpoint drill (ISSUE 14 acceptance): a
+    bit-flipped newest snapshot is SKIPPED with a loud log and resume
+    succeeds from the previous ring entry instead of raising (or
+    silently restoring garbage that happens to unpickle)."""
+    import logging
+
+    from horovod_tpu.utils.logging import get_logger
+
+    state = ObjectState(step=0, weight=np.zeros((2,)))
+    for step in (1, 2):
+        state.step = step
+        state.weight = np.full((2,), float(step))
+        hvd_checkpoint.save_state_checkpoint(str(tmp_path), state, step)
+    _flip_file_bit(tmp_path / "ckpt-2")
+    records = []
+
+    class _Grab(logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    handler = _Grab(level=logging.ERROR)
+    get_logger().addHandler(handler)
+    try:
+        found = hvd_checkpoint.peek_state_checkpoint(str(tmp_path))
+    finally:
+        get_logger().removeHandler(handler)
+    assert found is not None and found[0] == 1
+    assert any("FAILED its content checksum" in r.getMessage()
+               for r in records)
+    other = ObjectState(step=0, weight=np.zeros((2,)))
+    assert hvd_checkpoint.restore_state_checkpoint(str(tmp_path),
+                                                   other) == 1
+    np.testing.assert_array_equal(other.weight, [1.0, 1.0])
+
+
+def test_corrupt_latest_pytree_checkpoint_falls_back(tmp_path):
+    tree = {"w": np.arange(4, dtype=np.float32)}
+    hvd_checkpoint.save_checkpoint(str(tmp_path), tree, 1)
+    hvd_checkpoint.save_checkpoint(
+        str(tmp_path), {"w": np.arange(4, dtype=np.float32) * 3}, 2)
+    _flip_file_bit(tmp_path / "ckpt-2")
+    restored = hvd_checkpoint.restore_checkpoint(
+        str(tmp_path), tree, broadcast=False)
+    np.testing.assert_array_equal(restored["w"], np.arange(4))
+
+
+def test_every_ring_entry_corrupt_degrades_to_none(tmp_path):
+    state = ObjectState(step=0)
+    for step in (1, 2):
+        hvd_checkpoint.save_state_checkpoint(str(tmp_path), state, step)
+    _flip_file_bit(tmp_path / "ckpt-1")
+    _flip_file_bit(tmp_path / "ckpt-2")
+    assert hvd_checkpoint.peek_state_checkpoint(str(tmp_path)) is None
+
+
+def test_pre_checksum_checkpoints_still_load(tmp_path):
+    """Files without the CRC header (written before this PR) load
+    unverified — no flag day for existing checkpoint directories."""
+    import pickle
+
+    payload = (b"HVDTPU-STATE1\n" + pickle.dumps(
+        {"step": 9, "snapshot": {"step": ("__value__", 9)}}))
+    with open(tmp_path / "ckpt-9", "wb") as f:
+        f.write(payload)
+    found = hvd_checkpoint.peek_state_checkpoint(str(tmp_path))
+    assert found is not None and found[0] == 9
+
+
+def test_chaos_checkpoint_payload_drill(tmp_path):
+    """checkpoint.payload chaos site: a flipbit on the bytes about to
+    publish writes a checksum-failing file — the exact corrupt-on-write
+    fault the readers' ring fallback recovers from."""
+    state = ObjectState(step=0)
+    hvd_checkpoint.save_state_checkpoint(str(tmp_path), state, 1)
+    chaos.configure("checkpoint.payload:flipbit,at=0", seed=0, rank=0)
+    try:
+        hvd_checkpoint.save_state_checkpoint(str(tmp_path), state, 2)
+    finally:
+        chaos.clear()
+    found = hvd_checkpoint.peek_state_checkpoint(str(tmp_path))
+    assert found is not None and found[0] == 1
+    # a DROP rule silently loses the write (the lost-checkpoint fault)
+    chaos.configure("checkpoint.payload:drop,at=0", seed=0, rank=0)
+    try:
+        hvd_checkpoint.save_state_checkpoint(str(tmp_path), state, 3)
+    finally:
+        chaos.clear()
+    assert not os.path.exists(tmp_path / "ckpt-3")
+
+
 # -- elastic auto-resume -----------------------------------------------------
 
 def test_auto_resume_lifts_stale_state_only(tmp_path):
@@ -299,3 +523,17 @@ def test_chaos_soak_end_to_end():
     )
     assert proc.returncode == 0, (
         f"chaos soak failed:\n{proc.stdout[-3000:]}\n{proc.stderr[-3000:]}")
+
+
+def test_header_corruption_still_falls_back_through_the_ring(tmp_path):
+    """Corruption in the checksum HEADER itself (the magic bytes) makes
+    the file unverifiable rather than checksum-failed — the ring walk
+    must keep going to the next-oldest entry, not abort (review
+    finding: `return None` there silently restarted from step 0)."""
+    state = ObjectState(step=0, weight=np.zeros((2,)))
+    for step in (1, 2):
+        state.step = step
+        hvd_checkpoint.save_state_checkpoint(str(tmp_path), state, step)
+    _flip_file_bit(tmp_path / "ckpt-2", offset=2)  # inside the magic
+    found = hvd_checkpoint.peek_state_checkpoint(str(tmp_path))
+    assert found is not None and found[0] == 1

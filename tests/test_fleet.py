@@ -416,7 +416,7 @@ def test_router_affinity_routes_to_cached_replica(fleet_pieces):
     # first sight of the template: least-queue fallback places it
     g0 = router.submit(np.concatenate([template, [3, 4]]), 2,
                        arrival=time.perf_counter())
-    first = router._placed[g0][0]
+    first = router._placed[g0].replica
     router.run_until_drained()
     assert router.route_counts["least_queue"] == 1
     assert first.cached_prefix_blocks(template) > 0, \
@@ -427,7 +427,7 @@ def test_router_affinity_routes_to_cached_replica(fleet_pieces):
     # second request with the same template must stick to `first`
     g1 = router.submit(np.concatenate([template, [9]]), 2,
                        arrival=time.perf_counter())
-    assert router._placed[g1][0] is first
+    assert router._placed[g1].replica is first
     assert router.route_counts["affinity"] == 1
     router.run_until_drained()
     assert router.all_compile_free()
@@ -603,3 +603,203 @@ def test_preemption_guard_sigterm_snapshot_leave(tmp_path):
 
     peeked = ckpt_mod.peek_state_checkpoint(str(ckpt))
     assert peeked is not None and peeked[0] >= 5
+
+
+# -- replica resilience: suspect ejection + re-route (ISSUE 14) --------------
+
+
+def test_router_ejects_raising_replica_and_reroutes(fleet_pieces,
+                                                    monkeypatch):
+    """A replica whose submit() raises is marked SUSPECT after
+    HVD_TPU_FLEET_REPLICA_ERRORS consecutive errors and ejected from
+    placement; its in-flight requests re-route ONCE to the least-queue
+    survivor and every request still completes — a raising replica can
+    no longer keep winning affinity for its cached templates."""
+    from horovod_tpu.fleet.router import FleetRouter
+
+    monkeypatch.setenv("HVD_TPU_FLEET_REPLICA_ERRORS", "2")
+    _cfg, _params, build = fleet_pieces
+    router = FleetRouter(build, replicas=2, mode="round_robin")
+    rs = np.random.RandomState(3)
+    gids = [router.submit(rs.randint(1, 90, 10).astype(np.int32), 4)
+            for _ in range(4)]
+    victim = router.replicas[0]
+    placed_on_victim = [g for g, p in router._placed.items()
+                        if p.replica is victim]
+    assert placed_on_victim, "round robin should have placed on both"
+
+    def boom(*a, **k):
+        raise RuntimeError("chip on fire")
+
+    victim.engine.submit = boom
+    gids += [router.submit(rs.randint(1, 90, 10).astype(np.int32), 4)
+             for _ in range(4)]
+    assert victim.suspect and not victim.accepting
+    # the victim's in-flight requests were re-routed exactly once
+    for g in placed_on_victim:
+        assert router._placed[g].rerouted
+        assert router._placed[g].replica is not victim
+    res = router.run_until_drained()
+    assert len(res) == 8 and all(res[g].size == 4 for g in gids)
+    # the suspect drained empty and retired; the survivor serves alone
+    assert victim.state == "retired"
+    assert router.size == 1
+    assert router.all_compile_free()
+
+
+def test_router_step_errors_count_toward_suspect(fleet_pieces,
+                                                 monkeypatch):
+    from horovod_tpu.fleet.router import FleetRouter
+
+    monkeypatch.setenv("HVD_TPU_FLEET_REPLICA_ERRORS", "2")
+    _cfg, _params, build = fleet_pieces
+    router = FleetRouter(build, replicas=2, mode="round_robin")
+    rs = np.random.RandomState(4)
+    gids = [router.submit(rs.randint(1, 90, 10).astype(np.int32), 3)
+            for _ in range(2)]
+    victim = next(p.replica for p in router._placed.values())
+
+    def boom():
+        raise RuntimeError("wedged step")
+
+    victim.engine.step = boom
+    res = router.run_until_drained()
+    assert victim.suspect and victim.state == "retired"
+    assert len(res) == 2 and all(res[g].size == 3 for g in gids)
+
+
+def test_replica_stall_trip_feeds_the_error_counter(fleet_pieces,
+                                                    monkeypatch):
+    """The healthz stall source (has-work-but-no-progress) drives the
+    same consecutive-error counter as raises do."""
+    from horovod_tpu.fleet.replica import ServingReplica
+
+    monkeypatch.setenv("HVD_TPU_FLEET_REPLICA_STALL_SECONDS", "0.5")
+    _cfg, _params, build = fleet_pieces
+    t = [0.0]
+    r = ServingReplica("stall", build, clock=lambda: t[0])
+    r.spawn()
+    r.submit(np.arange(1, 9, dtype=np.int32), 2)
+    assert r.healthy()
+    t[0] = 10.0  # work pending, no progress for 10s > 0.5s stall bound
+    assert not r.healthy()
+    assert not r.note_error() and not r.note_error()
+    assert r.note_error()  # default threshold 3 -> suspect transition
+    assert r.suspect
+    r.engine.scheduler.pending.clear()
+    r.drain()
+    r.retire()
+
+
+def test_note_ok_resets_consecutive_errors(fleet_pieces):
+    from horovod_tpu.fleet.replica import ServingReplica
+
+    _cfg, _params, build = fleet_pieces
+    r = ServingReplica("flappy", build)
+    r.spawn()
+    assert not r.note_error() and not r.note_error()
+    r.note_ok()  # a success breaks the run
+    assert not r.note_error() and not r.note_error()
+    assert not r.suspect
+    assert r.note_error()
+    r.engine.scheduler.pending.clear()
+    r.drain()
+    r.retire()
+
+
+def test_router_deadline_aware_placement_skips_slow_replica(fleet_pieces):
+    """A replica whose estimated queue delay exceeds the request's
+    remaining deadline budget is skipped — placement onto it could
+    only produce a shed."""
+    from horovod_tpu.fleet.router import FleetRouter
+
+    _cfg, _params, build = fleet_pieces
+    router = FleetRouter(build, replicas=2, mode="affinity")
+    slow, fast = router.replicas
+    slow.avg_step_s = 10.0
+    for _ in range(3):  # queue depth makes slow's estimate ~30s
+        slow.engine.submit(np.arange(1, 9, dtype=np.int32), 2)
+    g = router.submit(np.arange(1, 9, dtype=np.int32), 2,
+                      deadline_s=1.0)
+    assert router._placed[g].replica is fast
+    # without a deadline the same queue state is NOT skipped on a
+    # cache hit: run the template through `slow` first
+    router.run_until_drained()
+
+
+def test_quarantine_host_blacklists_and_kills_siblings():
+    """Integrity attribution quarantines the WHOLE host: its slots
+    leave the spawn pool AND its sibling workers are hard-killed —
+    leaving them computing would keep re-tripping the guard until the
+    survivors' rollback fuse kills the job (review finding)."""
+    drv, hosts = _stub_driver(slots=2)
+    hosts = [("hostA", 2), ("hostB", 1)]
+    with drv._cv:
+        for h, n in hosts:
+            for s in range(n):
+                drv._spawn(h, s, "addr")
+        killed = []
+        for w in drv._workers.values():
+            w.proc.kill = (lambda wid=w.worker_id:
+                           killed.append(wid))
+        liar = next(w for w in drv._workers.values()
+                    if (w.host, w.slot) == ("hostA", 0))
+        drv._quarantine_host(liar.worker_id)
+        assert "hostA" in drv._host_blacklist
+        sibling = next(w for w in drv._workers.values()
+                       if (w.host, w.slot) == ("hostA", 1))
+        assert killed == [sibling.worker_id]  # hostB untouched, liar
+        # exits itself
+        # quarantined slots never refill; hostB's survive
+        assert set(drv._desired_slots(hosts)) == {("hostB", 0)}
+        # idempotent: a re-report doesn't double-kill
+        drv._quarantine_host(liar.worker_id)
+        assert killed == [sibling.worker_id]
+
+
+def test_validation_errors_never_suspect_replicas(fleet_pieces,
+                                                  monkeypatch):
+    """Client-input errors (over-long prompt) re-raise to the caller
+    instead of booking replica health — a few bad requests must not
+    eject the whole fleet (review finding)."""
+    from horovod_tpu.fleet.router import FleetRouter
+
+    monkeypatch.setenv("HVD_TPU_FLEET_REPLICA_ERRORS", "2")
+    _cfg, _params, build = fleet_pieces
+    router = FleetRouter(build, replicas=2, mode="round_robin")
+    too_long = np.arange(1, 200, dtype=np.int32)  # > max_seq_len 48
+    for _ in range(4):
+        with pytest.raises(ValueError):
+            router.submit(too_long, 4)
+    assert not any(r.suspect for r in router.replicas)
+    assert router.size == 2
+    g = router.submit(np.arange(1, 9, dtype=np.int32), 3)
+    assert router.run_until_drained()[g].size == 3
+
+
+def test_stalled_draining_replica_still_ejects(fleet_pieces,
+                                               monkeypatch):
+    """A replica already DRAINING voluntarily (scale-down) that then
+    wedges must STILL get the full ejection — the old state-based
+    guard made the stall response a no-op and run_until_drained spun
+    forever (review finding)."""
+    from horovod_tpu.fleet.router import FleetRouter
+
+    monkeypatch.setenv("HVD_TPU_FLEET_REPLICA_ERRORS", "2")
+    _cfg, _params, build = fleet_pieces
+    router = FleetRouter(build, replicas=2, mode="round_robin")
+    rs = np.random.RandomState(5)
+    gids = [router.submit(rs.randint(1, 90, 10).astype(np.int32), 3)
+            for _ in range(2)]
+    victim = next(p.replica for p in router._placed.values())
+    victim.drain()  # voluntary scale-down with work still in flight
+    assert victim.state == "draining"
+
+    def wedged():
+        raise RuntimeError("wedged mid-drain")
+
+    victim.engine.step = wedged
+    res = router.run_until_drained()
+    assert victim.suspect and victim.ejected
+    assert victim.state == "retired"
+    assert len(res) == 2 and all(res[g].size == 3 for g in gids)

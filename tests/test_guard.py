@@ -1,0 +1,502 @@
+"""Silent-corruption guard (horovod_tpu.guard): digests, detectors,
+cross-rank agreement + attribution, rollback, and the training-step
+threading contracts (docs/FAULT_TOLERANCE.md, silent corruption).
+
+The two standing oracles this file pins:
+
+* the guarded step is BIT-identical to the unguarded step when no
+  fault fires (state and loss; the diagnostics are pure extra outputs);
+* the guard adds ZERO collectives to the compiled step — enabled or
+  not (the digest exchange rides the host control plane at cadence),
+  so ``HVD_TPU_GUARD=0`` trivially lowers to the baseline program.
+
+The end-to-end closed loop (detect -> attribute -> quarantine -> roll
+back -> exact convergence) is proved by ``tools/chaos_soak.py``'s
+``sdc`` scenario over real elastic worker processes.
+"""
+
+import os
+import re
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from horovod_tpu import checkpoint as hvd_checkpoint
+from horovod_tpu import guard, training
+from horovod_tpu.elastic import ObjectState
+from horovod_tpu.models.simple import MLP
+
+
+# -- digests -----------------------------------------------------------------
+
+
+@pytest.mark.parametrize("value", [
+    np.arange(12, dtype=np.float32).reshape(3, 4),
+    np.arange(5, dtype=np.int32),
+    np.ones((3,), np.float16),
+    np.array([True, False, True]),
+    np.arange(4, dtype=np.float64),  # downcast like jnp.asarray (no x64)
+], ids=["f32", "i32", "f16", "bool", "f64"])
+def test_host_digest_equals_device_digest(value):
+    np.testing.assert_array_equal(
+        guard.host_digest([value]), np.asarray(guard.device_digest([value])))
+
+
+def test_digest_bf16_and_nested_tree():
+    import ml_dtypes
+
+    np.testing.assert_array_equal(
+        guard.host_digest(np.ones((7,), ml_dtypes.bfloat16)),
+        np.asarray(guard.device_digest(jnp.ones((7,), jnp.bfloat16))))
+    tree = {"a": np.ones((4, 4), np.float32),
+            "b": {"c": np.arange(3, dtype=np.int32)}}
+    np.testing.assert_array_equal(
+        guard.host_digest(tree), np.asarray(guard.device_digest(tree)))
+
+
+def test_digest_catches_any_single_bit_flip():
+    """Lane 0's odd multipliers make a single flipped bit PROVABLY
+    visible — sweep a few positions across words and bit indices."""
+    base = np.ones((64,), np.float32)
+    d0 = guard.host_digest([base])
+    for word, bit in [(0, 0), (17, 3), (31, 22), (63, 31), (40, 15)]:
+        mutant = base.copy()
+        mutant.view(np.uint32)[word] ^= np.uint32(1 << bit)
+        assert (guard.host_digest([mutant]) != d0).any(), (word, bit)
+
+
+def test_digest_is_content_deterministic_and_order_sensitive():
+    a = np.arange(8, dtype=np.float32)
+    np.testing.assert_array_equal(guard.host_digest([a]),
+                                  guard.host_digest([a.copy()]))
+    # leaf order participates (the fold salts by leaf index)
+    assert (guard.host_digest([a, a * 2]) !=
+            guard.host_digest([a * 2, a])).any()
+
+
+def test_allfinite_sentinel():
+    assert bool(guard.device_allfinite(
+        {"a": np.ones(3), "b": np.arange(3)}))
+    assert not bool(guard.device_allfinite({"a": np.array([1.0, np.nan])}))
+    assert not bool(guard.device_allfinite([np.array([np.inf])]))
+    # int-only trees are vacuously finite
+    assert bool(guard.device_allfinite([np.arange(4)]))
+
+
+# -- exchange + agreement ----------------------------------------------------
+
+
+def _run_ranks(board, world, fn):
+    """Drive one guard per rank on threads (the soak does it with real
+    processes); returns {rank: fn's result}."""
+    results = {}
+
+    def _one(rank):
+        ex = guard.FileBoardExchange(str(board), timeout=20)
+        g = guard.IntegrityGuard(
+            cadence=4, world=world, rank=rank, exchange=ex,
+            exit_fn=lambda code: results.setdefault(("exit", rank), code))
+        results[rank] = fn(g, rank)
+
+    ts = [threading.Thread(target=_one, args=(r,)) for r in range(world)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    return results
+
+
+CLEAN = guard.host_digest([np.ones((), np.float32)])
+
+
+@pytest.fixture(autouse=True)
+def _clean_guard_env():
+    """Rollback arms cross-execv env markers; tests must not leak them
+    into each other (the fuse counter would accumulate)."""
+    markers = (guard.ENV_ROLLBACK_T0, guard.ENV_GEN,
+               guard.ENV_ROLLBACK_COUNT, guard.ENV_ROLLBACK_STEP,
+               guard.ENV_VERIFIED)
+    for k in markers:
+        os.environ.pop(k, None)
+    yield
+    for k in markers:
+        os.environ.pop(k, None)
+
+
+def _bad_digest():
+    x = np.ones((), np.float32)
+    x.reshape(-1).view(np.uint32)[0] ^= np.uint32(1 << 22)
+    return guard.host_digest([x])
+
+
+def test_agreement_verified_advances_watermark(tmp_path):
+    def fn(g, rank):
+        g.observe_grads(3, CLEAN)
+        g.observe_grads(4, CLEAN)
+        v = g.check(4, loss=1.0)
+        return v, g.last_verified_step
+
+    out = _run_ranks(tmp_path, 2, fn)
+    for rank in (0, 1):
+        v, watermark = out[rank]
+        assert v.ok and v.kind == "verified"
+        assert watermark == 4
+
+
+def test_pairwise_mismatch_recompute_vote_attributes_the_liar(tmp_path):
+    """Two ranks disagree — no majority.  The redundant-recompute vote:
+    the corrupt rank's own recompute contradicts what it published, so
+    it attributes ITSELF, and the verdict round tells the survivor."""
+    def fn(g, rank):
+        for s in (1, 2, 3, 4):
+            g.observe_grads(
+                s, _bad_digest() if (rank == 1 and s == 2) else CLEAN)
+        return g.check(4, loss=4.0, recompute=lambda s: CLEAN)
+
+    out = _run_ranks(tmp_path, 2, fn)
+    for rank in (0, 1):
+        v = out[rank]
+        assert not v.ok and v.kind == "mismatch"
+        assert v.attributed == [1]
+        assert v.divergent_step == 2
+        assert v.self_attributed == (rank == 1)
+
+
+def test_majority_vote_attributes_without_recompute(tmp_path):
+    def fn(g, rank):
+        for s in (1, 2, 3, 4):
+            g.observe_grads(
+                s, _bad_digest() if (rank == 2 and s == 3) else CLEAN)
+        return g.check(4, loss=4.0)  # no recompute hook at all
+
+    out = _run_ranks(tmp_path, 3, fn)
+    for rank in range(3):
+        assert out[rank].attributed == [2], out[rank]
+        assert out[rank].self_attributed == (rank == 2)
+
+
+def test_param_only_divergence_without_recompute_is_unattributed(tmp_path):
+    """Identical windows but diverged param fingerprints (the drift
+    predates the window) and no majority: nobody is named — the
+    response degrades to rollback-for-everyone."""
+    def fn(g, rank):
+        g.observe_grads(4, CLEAN)
+        pd = _bad_digest() if rank == 1 else CLEAN
+        return g.check(4, loss=4.0, param_digest=pd)
+
+    out = _run_ranks(tmp_path, 2, fn)
+    for rank in (0, 1):
+        v = out[rank]
+        assert not v.ok and v.kind == "mismatch"
+        assert v.attributed == [] and not v.self_attributed
+        assert v.divergent_step is None
+
+
+def test_board_generation_hides_stale_entries(tmp_path):
+    """Entries from before a rollback must read as ABSENT, not fresh:
+    a gen-0 file for the same key is ignored by a gen-1 gather (and the
+    poll then times out on the missing peer)."""
+    ex0 = guard.FileBoardExchange(str(tmp_path), timeout=5, generation=0)
+    ex0.gather("chk-4", b"stale", world=1, rank=0)  # publishes rank0 file
+    ex1 = guard.FileBoardExchange(str(tmp_path), timeout=0.3, generation=1)
+    out = ex1.gather("chk-4", b"fresh", world=2, rank=1)
+    assert out[1] == b"fresh"
+    assert out[0] is None  # gen-0 entry treated as not-yet-posted
+    # same generation DOES read
+    ex1b = guard.FileBoardExchange(str(tmp_path), timeout=5, generation=1)
+    out = ex1b.gather("chk-4", b"peer", world=2, rank=0)
+    assert out[1] == b"fresh"
+
+
+def test_missing_peer_times_out_to_partial_not_failure(tmp_path):
+    ex = guard.FileBoardExchange(str(tmp_path), timeout=0.3)
+    g = guard.IntegrityGuard(cadence=4, world=2, rank=0, exchange=ex)
+    g.observe_grads(4, CLEAN)
+    v = g.check(4, loss=1.0)
+    assert v.ok and v.kind == "partial"
+    # an unverified window must NOT advance the rollback watermark
+    assert g.last_verified_step == 0
+
+
+# -- local detectors ---------------------------------------------------------
+
+
+def test_nan_verdict_and_respond_raises_integrity_error(tmp_path):
+    g = guard.IntegrityGuard(cadence=1, world=1,
+                             ckpt_dir=str(tmp_path / "ck"))
+    v = g.check(1, loss=float("nan"))
+    assert not v.ok and v.kind == "nan"
+    with pytest.raises(guard.IntegrityError):
+        g.respond(v)
+
+
+def test_finite_flag_false_trips_without_loss(tmp_path):
+    g = guard.IntegrityGuard(cadence=1, world=1)
+    v = g.check(1, finite=False)
+    assert not v.ok and v.kind == "nan"
+
+
+def test_loss_spike_is_advisory():
+    g = guard.IntegrityGuard(cadence=1, world=1, spike=5.0)
+    for i in range(1, 5):
+        v = g.check(i, loss=1.0)
+        assert v.ok and not v.spike
+    v = g.check(5, loss=100.0)
+    assert v.ok and v.spike  # flagged, never failing by itself
+    # spike=0 disables the detector
+    g2 = guard.IntegrityGuard(cadence=1, world=1, spike=0.0)
+    for i in range(1, 6):
+        assert not g2.check(i, loss=10.0 ** i).spike
+
+
+# -- rollback ----------------------------------------------------------------
+
+
+def test_rollback_discards_poisoned_window_and_raises(tmp_path):
+    ckpt = str(tmp_path / "ring")
+    state = ObjectState(step=0, weight=np.zeros(()))
+    for step in range(1, 7):
+        state.step = step
+        hvd_checkpoint.save_state_checkpoint(ckpt, state, step, keep=10)
+    g = guard.IntegrityGuard(cadence=4, world=1, ckpt_dir=ckpt)
+    g.last_verified_step = 4
+    with pytest.raises(guard.IntegrityError):
+        g.rollback(reason="test", step=6)
+    step, _snap = hvd_checkpoint.peek_state_checkpoint(ckpt)
+    assert step == 4  # 5 and 6 were inside the poisoned window
+    # the restart markers were armed for the (not-taken) exec path
+    assert os.environ.pop(guard.ENV_GEN) == "1"
+    t0 = os.environ.pop(guard.ENV_ROLLBACK_T0)
+    assert float(t0) > 0
+    # a fresh guard books the rollback wall time from the marker
+    os.environ[guard.ENV_ROLLBACK_T0] = t0
+    g2 = guard.IntegrityGuard(cadence=4, world=1)
+    assert g2.last_rollback_s is not None and g2.last_rollback_s >= 0
+    assert guard.ENV_ROLLBACK_T0 not in os.environ
+
+
+def test_rollback_loop_fuse_refuses_deterministic_reproduction():
+    """The same step tripping repeatedly (a deterministic divergence,
+    not transient SDC) must NOT restart forever: past
+    HVD_TPU_GUARD_MAX_ROLLBACKS the guard refuses with a clear error —
+    and a verified check PAST the tripping step disarms the fuse."""
+    g = guard.IntegrityGuard(cadence=4, world=1)
+    g.max_rollbacks = 2
+    for _ in range(2):
+        with pytest.raises(guard.IntegrityError, match="rolled the"):
+            g.rollback(reason="nan", step=8)  # the normal rollback
+    with pytest.raises(guard.IntegrityError,
+                       match="refusing another restart"):
+        g.rollback(reason="nan", step=8)  # fuse blown
+    # the env markers survive an execv: a fresh guard inherits the fuse
+    g2 = guard.IntegrityGuard(cadence=4, world=1)
+    assert g2._rollback_count == 2 and g2._rollback_barrier == 8
+    # a verified check at a step BEYOND the barrier disarms it
+    g2.check(12, loss=1.0)
+    assert g2._rollback_count == 0 and g2._rollback_barrier == -1
+    assert guard.ENV_ROLLBACK_COUNT not in os.environ
+    # ...and rolling back again afterwards starts a fresh count
+    with pytest.raises(guard.IntegrityError, match="rolled the"):
+        g2.rollback(reason="nan", step=16)
+
+
+def test_respond_quarantines_self_attributed(tmp_path):
+    codes = []
+    g = guard.IntegrityGuard(cadence=1, world=1,
+                             exit_fn=lambda c: codes.append(c))
+    v = guard.Verdict(step=4, ok=False, kind="mismatch", attributed=[0],
+                      self_attributed=True)
+    g.respond(v)
+    assert codes == [guard.QUARANTINE_EXIT]
+
+
+def test_discard_newer_than_is_concurrency_tolerant(tmp_path):
+    ckpt = str(tmp_path)
+    state = ObjectState(step=0)
+    for step in (1, 2, 3):
+        hvd_checkpoint.save_state_checkpoint(ckpt, state, step, keep=10)
+    removed = hvd_checkpoint.discard_newer_than(ckpt, 1)
+    assert sorted(os.path.basename(p) for p in removed) == \
+        ["ckpt-2", "ckpt-3"]
+    assert hvd_checkpoint.discard_newer_than(ckpt, 1) == []
+
+
+# -- training-step threading -------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def mlp_setup():
+    model = MLP(features=(16, 10))
+    opt = optax.adam(1e-3)
+    rng = jax.random.PRNGKey(0)
+    x = np.random.default_rng(0).random((16, 8)).astype(np.float32)
+    y = np.random.default_rng(1).integers(0, 10, (16,))
+    state = training.replicate_state(
+        training.create_train_state(model, opt, rng, x[:2]))
+    return model, opt, state, x, y
+
+
+def _copy(state):
+    return jax.tree_util.tree_map(jnp.copy, state)
+
+
+def test_guarded_step_bit_identical_and_diag_shape(mlp_setup):
+    model, opt, state, x, y = mlp_setup
+    plain = training.data_parallel_train_step(model, opt, guard=False)
+    guarded = training.data_parallel_train_step(model, opt, guard=True)
+    sa, la = plain(_copy(state), x, y)
+    sb, lb, diag = guarded(_copy(state), x, y)
+    assert float(la) == float(lb)
+    for a, b in zip(jax.tree_util.tree_leaves(sa),
+                    jax.tree_util.tree_leaves(sb)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert bool(np.asarray(diag["finite"]))
+    assert np.asarray(diag["digest"]).shape == (2,)
+    # deterministic: same inputs, same digest; advanced state differs
+    _, _, diag2 = guarded(_copy(state), x, y)
+    np.testing.assert_array_equal(np.asarray(diag["digest"]),
+                                  np.asarray(diag2["digest"]))
+    _, _, diag3 = guarded(sb, x, y)
+    assert (np.asarray(diag3["digest"]) !=
+            np.asarray(diag["digest"])).any()
+
+
+def test_guard_adds_zero_collectives(mlp_setup):
+    """The zero-guard-collectives contract: the guarded program's
+    collective inventory equals the baseline's, so HVD_TPU_GUARD=0
+    trivially lowers to a program with zero guard collectives."""
+    model, opt, state, x, y = mlp_setup
+    colls = re.compile(
+        r"stablehlo\.(all_reduce|all_gather|reduce_scatter|"
+        r"collective_permute|all_to_all)")
+
+    def inventory(step):
+        return len(colls.findall(step.lower(_copy(state), x, y).as_text()))
+
+    plain = training.data_parallel_train_step(model, opt, guard=False)
+    guarded = training.data_parallel_train_step(model, opt, guard=True)
+    assert inventory(plain) == inventory(guarded) > 0
+
+
+def test_guard_env_default(mlp_setup, monkeypatch):
+    model, opt, state, x, y = mlp_setup
+    monkeypatch.setenv("HVD_TPU_GUARD", "1")
+    step = training.data_parallel_train_step(model, opt)  # guard=None
+    out = step(_copy(state), x, y)
+    assert len(out) == 3
+    monkeypatch.setenv("HVD_TPU_GUARD", "0")
+    step = training.data_parallel_train_step(model, opt)
+    assert len(step(_copy(state), x, y)) == 2
+
+
+def test_zero_guard_bit_identical_with_shard_tap(mlp_setup):
+    model, opt, _state, x, y = mlp_setup
+    rng = jax.random.PRNGKey(0)
+    st_g, step_g, _ = training.zero_train_setup(
+        model, optax.sgd(1e-2), rng, x[:2], guard=True)
+    st_p, step_p, _ = training.zero_train_setup(
+        model, optax.sgd(1e-2), rng, x[:2], guard=False)
+    sa, la, diag = step_g(st_g, x, y)
+    sb, lb = step_p(st_p, x, y)
+    assert float(la) == float(lb)
+    for a, b in zip(jax.tree_util.tree_leaves(sa.params),
+                    jax.tree_util.tree_leaves(sb.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert bool(np.asarray(diag["finite"]))
+    assert np.asarray(diag["digest"]).shape == (2,)
+
+
+def test_fit_epoch_drives_the_guard(mlp_setup):
+    """fit_epoch feeds per-step diagnostics and runs the cadence check
+    (world=1: the local detectors + watermark advance)."""
+    model, opt, state, x, y = mlp_setup
+    step = training.data_parallel_train_step(model, opt, guard=True)
+    g = guard.IntegrityGuard(cadence=2, world=1)
+    loader = [(x, y)] * 4
+    out_state, loss = training.fit_epoch(step, _copy(state), loader,
+                                         guard=g)
+    assert loss is not None and np.isfinite(loss)
+    assert g.last_verified_step == 4  # checks ran at steps 2 and 4
+    assert int(out_state.step) == 4
+
+
+def test_step_diag_composes_manually():
+    loss = jnp.asarray(1.5)
+    grads = {"w": jnp.ones((4,), jnp.float32)}
+    diag = jax.jit(guard.step_diag)(loss, grads)
+    assert bool(diag["finite"])
+    np.testing.assert_array_equal(
+        np.asarray(diag["digest"]), guard.host_digest(grads))
+
+
+def test_nan_rank_still_joins_the_exchange(tmp_path):
+    """A NaN-tripped rank must NOT bail before the exchange: its peers
+    are already entering this step's gather and would block on a
+    collective (or a board timeout) that never completes.  The nan flag
+    rides the payload instead — both ranks verdict 'nan' in the same
+    number of rounds, nobody hangs (review finding)."""
+    def fn(g, rank):
+        g.observe_grads(4, CLEAN)
+        return g.check(4, loss=float("nan") if rank == 1 else 1.0)
+
+    out = _run_ranks(tmp_path, 2, fn)
+    for rank in (0, 1):
+        v = out[rank]
+        assert not v.ok and v.kind == "nan", (rank, v)
+        assert not v.self_attributed  # nan names a value, not a rank
+    # the clean rank's verdict carries the origin for the logs
+    assert "rank(s) [1]" in out[0].detail
+
+
+def test_verified_watermark_survives_the_rollback_restart():
+    """last_verified_step rides the env across the exec-restart: a
+    SECOND trip after a rollback must discard only past the inherited
+    watermark — a fresh guard resetting to 0 would hand
+    discard_newer_than(0) the entire ring (review finding)."""
+    g = guard.IntegrityGuard(cadence=4, world=1)
+    g.check(32, loss=1.0)  # verified: watermark 32, env armed
+    assert os.environ[guard.ENV_VERIFIED] == "32"
+    # the post-execv guard inherits it instead of restarting at 0
+    g2 = guard.IntegrityGuard(cadence=4, world=1)
+    assert g2.last_verified_step == 32
+
+
+def test_majority_vote_never_attributes_an_absent_vote(tmp_path):
+    """A rank whose window lacks the divergent step (restarted
+    mid-window) casts NO vote — it must not be quarantined by absence
+    (review finding)."""
+    def fn(g, rank):
+        for s in (1, 2, 3, 4):
+            if rank == 3 and s <= 2:
+                continue  # rank 3 joined mid-window: no entry at s=2
+            g.observe_grads(
+                s, _bad_digest() if (rank == 2 and s == 2) else CLEAN)
+        return g.check(4, loss=4.0)
+
+    out = _run_ranks(tmp_path, 4, fn)
+    for rank in range(4):
+        assert out[rank].attributed == [2], (rank, out[rank])
+        assert out[rank].self_attributed == (rank == 2)
+
+
+def test_absent_param_fingerprint_is_abstention_not_mismatch(tmp_path):
+    """param_digest is optional per rank: one rank fingerprinting and
+    the other not must VERIFY when the windows agree — absence read as
+    disagreement falsely tripped every cadence check until the
+    rollback fuse killed the job (review finding)."""
+    def fn(g, rank):
+        g.observe_grads(4, CLEAN)
+        pd = CLEAN if rank == 0 else None
+        v = g.check(4, loss=1.0, param_digest=pd)
+        return v, g.last_verified_step
+
+    out = _run_ranks(tmp_path, 2, fn)
+    for rank in (0, 1):
+        v, watermark = out[rank]
+        assert v.ok and v.kind == "verified", (rank, v)
+        assert watermark == 4

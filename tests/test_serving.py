@@ -766,3 +766,171 @@ def test_engine_drain_gate_rejects_new_intake(model_and_params):
     out = eng.run()
     np.testing.assert_array_equal(out[rid],
                                   ref_decode(model, params, prompt, 4))
+
+
+# -- request deadlines (ISSUE 14 satellite) ----------------------------------
+
+
+def _deadline_engine(cfg, params, clock, **kw):
+    serve = ServeConfig(block_size=8, num_blocks=0, token_budget=128,
+                        watermark=2, decode_tiers=(1, 2, 4), **kw)
+    return ServingEngine(cfg, params, serve=serve, clock=clock)
+
+
+def test_deadline_sheds_before_admission(model_and_params):
+    """A request whose budget is spent while queued is shed by admit():
+    its prefill would compute tokens nobody is waiting for.  The result
+    entry publishes (empty) so callers never wait forever."""
+    cfg, model, params = model_and_params
+    t = [0.0]
+    eng = _deadline_engine(cfg, params, lambda: t[0])
+    before = _instr.SERVE_DEADLINE_EXCEEDED.get()
+    rid = eng.submit(np.arange(1, 6), max_new_tokens=5, deadline_s=0.5)
+    t[0] = 1.0
+    eng.step()
+    assert rid in eng.results and eng.results[rid].size == 0
+    assert _instr.SERVE_DEADLINE_EXCEEDED.get() == before + 1
+
+
+def test_deadline_cancels_in_flight_and_frees_blocks(model_and_params):
+    """step() cancels an expired running sequence; its blocks release
+    through the normal refcount path and the partial output publishes."""
+    cfg, model, params = model_and_params
+    t = [0.0]
+    eng = _deadline_engine(cfg, params, lambda: t[0])
+    free0 = eng.allocator.free_blocks
+    rid = eng.submit(np.arange(1, 6), max_new_tokens=50, deadline_s=5.0)
+    for _ in range(4):
+        t[0] += 0.1
+        eng.step()
+    assert rid not in eng.results  # still generating inside budget
+    t[0] = 10.0
+    eng.step()
+    assert rid in eng.results
+    partial = eng.results[rid]
+    assert 0 < partial.size < 50
+    # the cancelled tokens match the reference stream prefix (greedy
+    # decode: a cancellation truncates, never corrupts)
+    ref = ref_decode(model, params, np.arange(1, 6), partial.size)
+    np.testing.assert_array_equal(partial, ref)
+    assert eng.allocator.free_blocks == free0
+
+
+def test_engine_default_deadline_from_config(model_and_params):
+    cfg, model, params = model_and_params
+    t = [0.0]
+    eng = _deadline_engine(cfg, params, lambda: t[0], deadline_s=0.25)
+    rid = eng.submit(np.arange(1, 6), max_new_tokens=5)  # inherits 0.25
+    t[0] = 1.0
+    eng.step()
+    assert rid in eng.results and eng.results[rid].size == 0
+    # per-request override beats the engine default
+    rid2 = eng.submit(np.arange(1, 6), max_new_tokens=5,
+                      deadline_s=100.0, arrival=t[0])
+    out = eng.run()
+    assert out[rid2].size == 5
+
+
+def test_no_deadline_requests_never_scan(model_and_params):
+    """Without any deadline in play the expiry machinery stays off the
+    hot path entirely (and outputs are oracle-exact, as ever)."""
+    cfg, model, params = model_and_params
+    eng = ServingEngine(cfg, params, serve=ServeConfig(
+        block_size=8, num_blocks=0, token_budget=128, watermark=2,
+        decode_tiers=(1, 2, 4)))
+    assert not eng._any_deadline
+    prompt = np.arange(1, 9, dtype=np.int32)
+    rid = eng.submit(prompt, max_new_tokens=6)
+    out = eng.run()
+    assert not eng._any_deadline
+    np.testing.assert_array_equal(out[rid],
+                                  ref_decode(model, params, prompt, 6))
+
+
+def test_deadline_expiry_mixed_with_live_requests(model_and_params):
+    """Expired and live requests interleave: sheds must not disturb
+    the survivors' token streams (the standing exactness oracle)."""
+    cfg, model, params = model_and_params
+    t = [0.0]
+    eng = _deadline_engine(cfg, params, lambda: t[0])
+    rs = np.random.RandomState(7)
+    live_p = rs.randint(1, 97, size=9).astype(np.int32)
+    dead_p = rs.randint(1, 97, size=9).astype(np.int32)
+    rid_live = eng.submit(live_p, max_new_tokens=8, deadline_s=1e9)
+    rid_dead = eng.submit(dead_p, max_new_tokens=8, deadline_s=0.2)
+    t[0] = 0.5  # the second request expires before admission completes
+    out = eng.run()
+    assert out[rid_dead].size < 8
+    np.testing.assert_array_equal(
+        out[rid_live], ref_decode(model, params, live_p, 8))
+
+
+def test_cancel_all_publishes_every_partial(model_and_params):
+    """cancel_all (the fleet ejection hook) aborts running, pending AND
+    device-staged requests, freeing blocks through the refcount path
+    and publishing partials so no poller waits forever."""
+    cfg, model, params = model_and_params
+    eng = ServingEngine(cfg, params, serve=ServeConfig(
+        block_size=8, num_blocks=0, token_budget=64, watermark=2,
+        decode_tiers=(1, 2)))
+    free0 = eng.allocator.free_blocks
+    rid_run = eng.submit(np.arange(1, 9), max_new_tokens=20)
+    for _ in range(3):
+        eng.step()  # rid_run is mid-decode
+    rid_pend = eng.submit(np.arange(2, 10), max_new_tokens=5)
+    # an attached SOURCE request the router never placed: staged rows
+    # must complete (empty), not hang their poller (review finding)
+    eng.attach_source(iter([Request(id=500, prompt=np.arange(3, 11),
+                                    max_new_tokens=4)]))
+    eng._drain_staging(block=True)
+    eng.cancel_all()
+    assert 0 < eng.results[rid_run].size < 20
+    assert rid_pend in eng.results
+    assert 500 in eng.results
+    assert eng.allocator.free_blocks == free0
+    assert not eng.scheduler.running and not eng.scheduler.pending
+    assert not eng.step()  # drained: nothing left to do
+
+
+def test_sourced_requests_inherit_engine_default_deadline(model_and_params):
+    """attach_source'd requests get ServeConfig.deadline_s exactly like
+    submit()'s do — the open-loop intake is the path overload shedding
+    exists for — and an UNSET arrival starts its clock when the request
+    surfaces (a 0.0 default against a perf_counter clock would read as
+    hours past budget and shed 100% of sourced traffic)."""
+    cfg, model, params = model_and_params
+    t = [100.0]  # a perf_counter-style clock: far from the 0.0 default
+    eng = _deadline_engine(cfg, params, lambda: t[0], deadline_s=0.25)
+    eng.attach_source(iter([Request(id=0, prompt=np.arange(1, 9),
+                                    max_new_tokens=30)]))
+    eng.step()  # drains + admits: arrival stamped 100.0, NOT shed
+    assert eng._any_deadline
+    assert 0 not in eng.results or eng.results[0].size > 0
+    t[0] = 101.0  # now the inherited 0.25s budget is spent
+    out = eng.run()
+    assert out[0].size < 30  # cancelled mid-flight by the default
+
+
+def test_cancel_all_stops_a_live_staging_producer(model_and_params):
+    """cancel_all must CLOSE the staging prefetcher before publishing:
+    a still-running producer would append more staged requests after
+    the snapshot — ids that then never resolve (review finding)."""
+    import itertools
+
+    cfg, model, params = model_and_params
+    eng = ServingEngine(cfg, params, serve=ServeConfig(
+        block_size=8, num_blocks=0, token_budget=64, watermark=2,
+        decode_tiers=(1, 2)))
+    n = 12
+    reqs = [Request(id=i, prompt=np.arange(1, 9), max_new_tokens=3)
+            for i in range(n)]
+    eng.attach_source(iter(reqs), depth=2)
+    eng.step()  # let the producer spin up and stage a few
+    eng.cancel_all()
+    assert eng._staging.closed
+    # EVERY id the staging pipeline ever surfaced has a results entry,
+    # and nothing new arrives afterwards
+    surfaced = set(eng.results)
+    assert not eng.step()
+    assert set(eng.results) == surfaced
+    assert not eng._staging_meta

@@ -29,6 +29,18 @@ workers' weight bookkeeping.  Scenarios:
                   'leaving' and exits 0; the driver books a scale-down
                   (not a failure), the survivor converges exactly, and
                   recovery_seconds{phase="planned"} stays bounded.
+  sdc             silent-data-corruption closed loop (guard.py): chaos
+                  flips one bit of rank 1's gradient at the guard.grad
+                  site (a finite, materially wrong value no crash or
+                  MAC can see).  Within one HVD_TPU_GUARD_CADENCE the
+                  cross-rank digest exchange detects the mismatch, the
+                  redundant-recompute vote attributes RANK 1 (not rank
+                  0), rank 1 reports the integrity failure and
+                  quarantines (its HOST leaves the driver's spawn
+                  pool), and the survivor rolls back to the last
+                  VERIFIED checkpoint — discarding the poisoned-window
+                  checkpoints — then re-runs to the exact final count
+                  with bounded recovery_seconds{phase="rollback"}.
   replay          the same HVD_TPU_CHAOS_SEED must reproduce the same
                   injection trace, event for event.
   overhead        chaos OFF must cost one module-bool per injection point
@@ -75,10 +87,13 @@ def _env(extra=None):
     return env
 
 
-def _discovery(tmp, slots):
+def _discovery(tmp, slots, hosts_lines=None):
     hosts = os.path.join(tmp, "hosts.txt")
     with open(hosts, "w") as f:
-        f.write(f"localhost:{slots}\n")
+        if hosts_lines is not None:
+            f.write("".join(line + "\n" for line in hosts_lines))
+        else:
+            f.write(f"localhost:{slots}\n")
     script = os.path.join(tmp, "discover.sh")
     with open(script, "w") as f:
         f.write(f"#!/bin/sh\ncat {hosts}\n")
@@ -98,12 +113,12 @@ def _read_events(logdir):
 
 
 def _run_job(tmp, *, np_, min_np, max_np, slots, batches, chaos, seed,
-             timeout=420, extra_env=None):
+             timeout=420, extra_env=None, hosts_lines=None):
     logdir = os.path.join(tmp, "logs")
     ckpt = os.path.join(tmp, "ckpt")
     os.makedirs(logdir)
     os.makedirs(ckpt)
-    script = _discovery(tmp, slots)
+    script = _discovery(tmp, slots, hosts_lines)
     cmd = [sys.executable, "-m", "horovod_tpu.runner",
            "--host-discovery-script", script,
            "--min-np", str(min_np), "-np", str(np_)]
@@ -266,6 +281,76 @@ def scenario_preempt(batches, seed):
                 "snapshot": leave["snapshot"]}
 
 
+def scenario_sdc(batches, seed, cadence=4):
+    """The guard.py closed loop (docs/FAULT_TOLERANCE.md, silent
+    corruption): detect -> attribute -> quarantine -> roll back ->
+    converge, end to end on a real 2-worker elastic job.  The two
+    workers sit on DISTINCT host names (localhost / 127.0.0.1 — both
+    spawn locally) so the integrity quarantine blacklists only the
+    lying rank's host."""
+    flip_step = 3 * cadence - 2   # mid-window: detection must wait for
+    # the NEXT cadence check, pinning the <= 1 cadence detection bound
+    batches = max(batches, flip_step + 4 * cadence)
+    with tempfile.TemporaryDirectory(prefix="chaos_soak_") as tmp:
+        fuse = os.path.join(tmp, "sdc.fuse")
+        board = os.path.join(tmp, "board")
+        proc, events = _run_job(
+            tmp, np_=2, min_np=1, max_np=2, slots=2, batches=batches,
+            hosts_lines=["localhost:1", "127.0.0.1:1"],
+            # eval N of guard.grad is the step that becomes N+1
+            chaos=(f"guard.grad:flipbit,at={flip_step - 1},rank=1,"
+                   f"fuse={fuse}"),
+            seed=seed,
+            extra_env={"HVD_TPU_GUARD": "1",
+                       "HVD_TPU_GUARD_CADENCE": str(cadence),
+                       "HVD_TPU_GUARD_BOARD": board},
+        )
+        assert proc.returncode == 0, (
+            f"job failed rc={proc.returncode}\n{proc.stderr[-4000:]}")
+        assert os.path.exists(fuse), "chaos flipbit never fired"
+        # detection: the first bad verdict, within one cadence of the flip
+        bad = [e for e in events if e["event"] == "guard" and not e["ok"]]
+        assert bad, f"corruption never detected: {events}"
+        detect_step = min(e["step"] for e in bad)
+        assert flip_step <= detect_step < flip_step + cadence, (
+            f"detected at {detect_step}, flipped at {flip_step}, "
+            f"cadence {cadence}")
+        # attribution: rank 1 (not rank 0), on BOTH ranks' verdicts
+        for e in bad:
+            assert e["kind"] == "mismatch" and e["attributed"] == [1], e
+            assert e["divergent_step"] == flip_step, e
+            assert e["self_attributed"] == (e["rank"] == 1), e
+        assert {e["rank"] for e in bad} == {0, 1}, bad
+        # quarantine: the driver blacklisted rank 1's HOST, and no
+        # replacement was ever spawned into it (2 workers total)
+        assert "QUARANTINED" in proc.stderr, proc.stderr[-2000:]
+        inits = [e for e in events if e["event"] == "init"]
+        assert len({e["worker"] for e in inits}) == 2, inits
+        # rollback: the survivor restarted WITHOUT its live state and
+        # auto-resumed from the last VERIFIED checkpoint (the poisoned
+        # window's checkpoints were discarded)
+        verified = max(e["verified"] for e in bad if e["rank"] == 0)
+        assert verified == ((flip_step - 1) // cadence) * cadence, bad
+        done_rollbacks = [e for e in events
+                          if e["event"] == "rollback_done"]
+        assert done_rollbacks, f"no rollback accounting: {events}"
+        assert all(0 <= e["rollback_s"] < 60 for e in done_rollbacks), \
+            done_rollbacks
+        boots = [e for e in events if e["event"] == "boot"
+                 and 0 < e["step"] <= verified]
+        assert boots, f"survivor did not resume from the verified " \
+            f"checkpoint: {events}"
+        # convergence: exactly the surviving world of 1, EXACT count
+        dones = [e for e in events if e["event"] == "done"]
+        assert len(dones) == 1, f"expected 1 finisher: {dones}"
+        assert abs(dones[0]["weight"] - batches) < 1e-6, dones
+        assert dones[0]["world"] == 1, dones
+        return {"flip_step": flip_step, "detect_step": detect_step,
+                "verified_step": verified,
+                "rollback_s": round(max(e["rollback_s"]
+                                        for e in done_rollbacks), 2)}
+
+
 def _replay_trace(tmp, tag, seed):
     trace = os.path.join(tmp, f"trace_{tag}.jsonl")
     code = (
@@ -320,7 +405,8 @@ def main(argv=None):
     ap.add_argument("--seed", type=int, default=42)
     ap.add_argument("--scenario", default="all",
                     choices=["all", "kill-resume", "corrupt-recover",
-                             "autoscale", "preempt", "replay", "overhead"])
+                             "autoscale", "preempt", "sdc", "replay",
+                             "overhead"])
     ap.add_argument("--peak", type=int, default=4,
                     help="autoscale scenario's peak world (CI smoke: 3)")
     args = ap.parse_args(argv)
@@ -332,6 +418,7 @@ def main(argv=None):
         "autoscale": lambda: scenario_autoscale(args.batches, args.seed,
                                                 peak=args.peak),
         "preempt": lambda: scenario_preempt(args.batches, args.seed),
+        "sdc": lambda: scenario_sdc(args.batches, args.seed),
         "replay": lambda: scenario_replay(args.seed),
         "overhead": scenario_overhead,
     }
