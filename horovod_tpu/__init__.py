@@ -93,6 +93,7 @@ from .functions import (
     broadcast_parameters,
 )
 from . import callbacks, chaos, checkpoint, data, elastic, guard, metrics
+from . import trace
 from .compression import Compression
 from .sync_batch_norm import SyncBatchNorm
 from .optim import (

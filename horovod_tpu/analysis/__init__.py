@@ -8,7 +8,7 @@ registries.  Drift between them is a *silent-crash* class: a wrong
 ``argtypes`` corrupts the native stack at call time, an uncatalogued
 chaos site is a fault rule that never fires, an undocumented knob is a
 knob nobody finds.  This package checks all of it in milliseconds with
-four stdlib-only passes:
+five stdlib-only passes:
 
 ====== =====================================================
 pass   contract
@@ -17,6 +17,7 @@ c-api  c_api.cc declarations == every ctypes restype/argtypes
 env    HVD_TPU_* reads == docs/running.md rows; no raw parses
 metrics code-built names ⊆ instruments.py ⊆ docs/METRICS.md
 chaos  point() sites == native Decide sites == doc site table
+trace  span/event sites == trace SITES == docs/TRACING.md
 ====== =====================================================
 
 Run it::
@@ -36,7 +37,7 @@ import sys
 import time
 from typing import Callable, Dict, List, Optional, Sequence
 
-from . import c_api, chaos_sites, envvars, metrics_catalogue
+from . import c_api, chaos_sites, envvars, metrics_catalogue, trace_sites
 from ._common import Finding, Suppressions
 
 __all__ = ["Finding", "PASSES", "run_all", "main"]
@@ -46,6 +47,7 @@ PASSES: Dict[str, Callable[[str], List[Finding]]] = {
     "env": envvars.run,
     "metrics": metrics_catalogue.run,
     "chaos": chaos_sites.run,
+    "trace": trace_sites.run,
 }
 
 

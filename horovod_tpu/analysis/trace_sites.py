@@ -1,0 +1,132 @@
+"""Trace-site parity lint (pass #5).
+
+The span recorder names its instrumentation points twice — the
+``SITES`` catalogue in ``trace/__init__.py`` and the site table in
+``docs/TRACING.md`` — and the package's ``trace.span("...")`` /
+``trace.event("...")`` / ``trace.add_span("...")`` literals must agree
+with both.  A span site present in one layer but not the others is
+either a timeline name no dashboard can look up, or a documented
+signal that never records — the same silent-drift class the chaos and
+metrics passes exist for.
+
+Checked equivalences:
+
+* every ``span``/``event``/``add_span`` literal in the package names a
+  catalogued site;
+* every catalogued site has at least one call site in the package (a
+  catalogue entry nothing records is dead);
+* the docs/TRACING.md site table is exactly the catalogue (both
+  directions).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Set, Tuple
+
+from ._common import Finding, iter_py_files, read_text
+
+CHECK = "trace"
+
+TRACE_INIT_PY = "horovod_tpu/trace/__init__.py"
+TRACING_MD = "docs/TRACING.md"
+
+_SITES_RE = re.compile(r"^SITES\s*=\s*\(", re.MULTILINE)
+_STR_RE = re.compile(r"\"([a-z0-9_.]+)\"")
+# matches trace.span("x") / _trace.event("x") / trace.add_span("x") —
+# any alias ending in `trace.`; the method set keeps collective_ops'
+# unrelated _span(name, ...) helper out
+_CALL_RE = re.compile(
+    r"\w*trace\.(?:span|event|add_span)\(\s*[\"']([a-z0-9_.]+)[\"']")
+_DOC_ROW_RE = re.compile(
+    r"^\|\s*`([a-z0-9_]+(?:\.[a-z0-9_]+)+)`\s*\|", re.MULTILINE)
+
+
+def catalogue(root: str) -> Dict[str, int]:
+    """site -> line of the SITES tuple in trace/__init__.py."""
+    text = read_text(os.path.join(root, TRACE_INIT_PY))
+    if text is None:
+        return {}
+    m = _SITES_RE.search(text)
+    if not m:
+        return {}
+    i = text.index("(", m.start())
+    depth, j = 0, i
+    while j < len(text):
+        if text[j] == "(":
+            depth += 1
+        elif text[j] == ")":
+            depth -= 1
+            if depth == 0:
+                break
+        j += 1
+    out: Dict[str, int] = {}
+    for sm in _STR_RE.finditer(text, i, j):
+        out[sm.group(1)] = text.count("\n", 0, sm.start()) + 1
+    return out
+
+
+def run(root: str) -> List[Finding]:
+    findings: List[Finding] = []
+    sites = catalogue(root)
+    if not sites:
+        findings.append(Finding(
+            CHECK, TRACE_INIT_PY, 0, "missing",
+            "trace/__init__.py SITES catalogue not found/empty — the "
+            "span-site registry is gone"))
+        return findings
+
+    # -- call sites ----------------------------------------------------------
+    used: Set[str] = set()
+    for rel in iter_py_files(root,
+                             exclude_dirs=("analysis", "trace",
+                                           "__pycache__")):
+        text = read_text(os.path.join(root, rel))
+        if text is None:
+            continue
+        for m in _CALL_RE.finditer(text):
+            site = m.group(1)
+            used.add(site)
+            if site not in sites:
+                lineno = text.count("\n", 0, m.start()) + 1
+                findings.append(Finding(
+                    CHECK, rel, lineno, site,
+                    f"trace site {site!r} is recorded here but not in "
+                    "the trace SITES catalogue — the timeline carries a "
+                    "name no site table explains",
+                ))
+
+    for site, lineno in sorted(sites.items()):
+        if site not in used:
+            findings.append(Finding(
+                CHECK, TRACE_INIT_PY, lineno, site,
+                f"catalogued trace site {site!r} has no span()/event()/"
+                "add_span() call site in the package (dead catalogue "
+                "entry)",
+            ))
+
+    # -- documented table ----------------------------------------------------
+    doc_text = read_text(os.path.join(root, TRACING_MD))
+    if doc_text is None:
+        findings.append(Finding(CHECK, TRACING_MD, 0, "missing",
+                                "docs/TRACING.md not found"))
+        return findings
+    doc_sites: Dict[str, int] = {}
+    for m in _DOC_ROW_RE.finditer(doc_text):
+        doc_sites[m.group(1)] = doc_text.count("\n", 0, m.start()) + 1
+    for site, lineno in sorted(sites.items()):
+        if site not in doc_sites:
+            findings.append(Finding(
+                CHECK, TRACE_INIT_PY, lineno, site,
+                f"trace site {site!r} is catalogued but missing from "
+                "the docs/TRACING.md site table",
+            ))
+    for site, lineno in sorted(doc_sites.items()):
+        if site not in sites:
+            findings.append(Finding(
+                CHECK, TRACING_MD, lineno, site,
+                f"docs/TRACING.md documents trace site {site!r} but the "
+                "SITES catalogue does not contain it",
+            ))
+    return findings

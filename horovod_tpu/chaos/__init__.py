@@ -197,6 +197,13 @@ def _record_fire(site: str, action: str, eval_idx: int) -> None:
     event = {"site": site, "action": action, "eval": eval_idx,
              "rank": _rank}
     _trace.append(event)
+    # chaos fires are first-class timeline events: a crash bundle or a
+    # /trace export shows the injection in sequence with the spans it
+    # broke (docs/TRACING.md)
+    from .. import trace as _span_trace
+
+    _span_trace.event("chaos.inject", site=site, action=action,
+                      eval=eval_idx)
     get_logger().warning("chaos: injecting %s at %s (eval %d)",
                          action, site, eval_idx)
     if _log_path:
@@ -358,6 +365,15 @@ def point(site: str, payload: Any = None) -> Any:
             os.kill(os.getpid(), -fire.code)
             return payload
         get_logger().error("chaos: self-kill at %s", site)
+        try:
+            # the black box goes out BEFORE the lights: the bundle
+            # carries this process's final spans incl. the kill event
+            # (HVD_TPU_TRACE_BUNDLE_DIR opts in; never raises)
+            from ..trace import flight as _flight
+
+            _flight.maybe_dump("chaos_kill", extra={"site": site})
+        except Exception:
+            pass
         os._exit(fire.code)
     if action == "hang":
         get_logger().error("chaos: self-hang at %s", site)

@@ -96,6 +96,7 @@ def _atomic_publish(directory: str, name: str, payload: bytes) -> str:
     disk (post-checksum, so an injected flip is DETECTABLE — a ``drop``
     rule silently loses the write, the lost-checkpoint fault)."""
     from . import chaos as _chaos
+    from . import trace
 
     # the directory must exist even when a DROP rule loses the write:
     # the caller's pruning pass lists it unconditionally
@@ -108,20 +109,22 @@ def _atomic_publish(directory: str, name: str, payload: bytes) -> str:
         payload = out
     path = os.path.join(directory, name)
     tmp = f"{path}.tmp.{os.getpid()}"
-    try:
-        with open(tmp, "wb") as f:
-            f.write(payload)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)  # atomic publish
-    except BaseException:
-        # a failed/interrupted save must not leave the temp behind when
-        # we still control the process (a SIGKILL leaves it for _prune)
+    with trace.span("checkpoint.publish", name=name, bytes=len(payload)):
         try:
-            os.remove(tmp)
-        except OSError:
-            pass
-        raise
+            with open(tmp, "wb") as f:
+                f.write(payload)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)  # atomic publish
+        except BaseException:
+            # a failed/interrupted save must not leave the temp behind
+            # when we still control the process (a SIGKILL leaves it
+            # for _prune)
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise
     return path
 
 

@@ -225,6 +225,16 @@ def init(devices: Optional[Sequence] = None) -> None:
         ).set(1)
         _metrics_exposition.maybe_start_from_env(local_rank=local_rank())
 
+        # span recorder + flight recorder (docs/TRACING.md): stamp this
+        # rank on exports/bundles, mount /trace on the endpoint above,
+        # and baseline the metric-delta snapshot.  Recording itself is
+        # on by default (HVD_TPU_TRACE=0 disables) and device-free.
+        from .. import trace as _trace
+        from ..utils.logging import set_log_context
+
+        _trace.install_from_env(rank=_state.topology.rank)
+        set_log_context(rank=_state.topology.rank)
+
         _state.initialized = True
         get_logger().info(
             "initialized: size=%d local_size=%d rank=%d processes=%d backend=%s",

@@ -31,6 +31,7 @@ from typing import Callable, Iterable, Iterator, Optional
 import numpy as np
 
 from .. import chaos as _chaos
+from .. import trace
 from ..metrics import instruments as _instr
 
 __all__ = ["DevicePrefetcher", "prefetch_to_device", "default_prefetch_depth"]
@@ -157,6 +158,7 @@ class DevicePrefetcher:
         dt = time.perf_counter() - t0
         self._put_s += dt
         _instr.DATA_DEVICE_PUT.observe(dt)
+        trace.add_span("data.device_put", t0, t0 + dt)
         return batch
 
     def _producer(self):
@@ -175,7 +177,9 @@ class DevicePrefetcher:
                 except StopIteration:
                     q.put(_SENTINEL)
                     return
-                self._produce_s += time.perf_counter() - t0
+                dt = time.perf_counter() - t0
+                self._produce_s += dt
+                trace.add_span("data.produce", t0, t0 + dt)
                 q.put(self._stage(item))
         except BaseException as e:  # re-raise on the consumer side
             q.put(e)
@@ -251,6 +255,10 @@ class DevicePrefetcher:
         self._batches += 1
         self._wait_s += waited
         if waited > 0.001:
+            # span the INPUT WAIT (host starvation) only when it is
+            # real — a hot queue would otherwise spam ~0-width spans
+            end = time.perf_counter()
+            trace.add_span("data.wait", end - waited, end)
             self._starved += 1
         _instr.DATA_HOST_WAIT.observe(waited)
         _instr.DATA_BATCHES.labels(source=self.source_kind).inc()

@@ -741,6 +741,18 @@ def _persist_and_exec(snap) -> None:
     os.environ[ENV_RESTARTED] = "1"
     count = env_int(ENV_RESTART_COUNT, 0)
     os.environ[ENV_RESTART_COUNT] = str(count + 1)
+    try:
+        # flight recorder: execv replaces the image and the span rings
+        # with it — the last N seconds leave as a crash bundle first
+        # (HVD_TPU_TRACE_BUNDLE_DIR opts in; a rollback/preempt dump
+        # moments earlier suppresses the duplicate)
+        from .. import trace as _trace
+        from ..trace import flight as _flight
+
+        _trace.event("elastic.restart", restarts=count + 1)
+        _flight.maybe_dump("restart", extra={"restarts": count + 1})
+    except Exception:
+        pass
     for k in _ASSIGNMENT_ENV:
         os.environ.pop(k, None)
     sys.stdout.flush()

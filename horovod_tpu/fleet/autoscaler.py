@@ -97,6 +97,20 @@ class Autoscaler:
                     self.kind, d.direction).inc()
                 self._applied_desired = d.desired
                 self.policy.note_applied(now)
+                from .. import trace as _trace
+
+                _trace.event("fleet.scale", kind=self.kind,
+                             direction=d.direction, current=current,
+                             desired=d.desired, reason=d.reason)
+                if d.direction == "out":
+                    # a scale-out IS an SLO breach being answered: the
+                    # signals and spans of the 30 s leading up to it
+                    # are exactly what the post-mortem wants
+                    from ..trace import flight as _flight
+
+                    _flight.maybe_dump("slo_breach", extra={
+                        "kind": self.kind, "desired": d.desired,
+                        "reason": d.reason})
         return d
 
     # -- thread form (the driver/router run it; tests use tick()) -----------

@@ -173,6 +173,16 @@ class PreemptionGuard:
                         "commit/auto-resume path still applies", e)
         planned_s = self._clock() - t0
         _instr.RECOVERY_SECONDS.labels("planned").set(planned_s)
+        try:
+            from .. import trace as _trace
+            from ..trace import flight as _flight
+
+            _trace.event("fleet.preempt", step=step, planned_s=planned_s,
+                         snapshot=kind)
+            _flight.maybe_dump("preempt", extra={"step": step,
+                                                 "snapshot": kind})
+        except Exception:
+            pass
         get_logger().warning(
             "fleet: planned leave complete in %.2fs (snapshot=%s, "
             "step=%d); exiting 0", planned_s, kind, step)

@@ -75,6 +75,9 @@ class _Placement:
     eos_id: Optional[int]
     arrival: Optional[float]
     deadline_s: Optional[float]
+    #: trace context born at submit — a re-route must carry it so the
+    #: survivor's spans still correlate with the fleet.route event
+    trace_id: Optional[str] = None
     rerouted: bool = False
 
 _ROUTE_AFFINITY = _instr.FLEET_ROUTED.labels("affinity")
@@ -253,12 +256,19 @@ class FleetRouter:
             now = self._clock()
             arr = now if arrival is None else arrival
             remaining = max(0.0, deadline_s - (now - arr))
+        # trace context is born HERE and propagates router -> replica
+        # -> engine -> scheduler: every span the request touches
+        # downstream carries this id (docs/TRACING.md)
+        from .. import trace as _trace
+
+        tid = _trace.new_trace_id() if _trace.enabled() else None
         tried: List[ServingReplica] = []
         for _ in range(len(self.replicas) + 1):
             r = self._route(prompt, remaining, exclude=tuple(tried))
             try:
                 rid = r.submit(prompt, max_new_tokens, eos_id=eos_id,
-                               arrival=arrival, deadline_s=deadline_s)
+                               arrival=arrival, deadline_s=deadline_s,
+                               trace_id=tid)
                 r.note_ok()
             except ValueError:
                 # client-input validation (over-long prompt, zero
@@ -279,7 +289,9 @@ class FleetRouter:
             self._placed[gid] = _Placement(
                 replica=r, rid=rid, prompt=prompt,
                 max_new_tokens=int(max_new_tokens), eos_id=eos_id,
-                arrival=arrival, deadline_s=deadline_s)
+                arrival=arrival, deadline_s=deadline_s, trace_id=tid)
+            _trace.event("fleet.route", gid=gid, rid=rid,
+                         replica=r.name, mode=self.mode, trace=tid)
             return gid
         raise RuntimeError("no replica accepted the request")
 
@@ -359,7 +371,8 @@ class FleetRouter:
                         nrid = tgt.submit(
                             p.prompt, p.max_new_tokens,
                             eos_id=p.eos_id, arrival=p.arrival,
-                            deadline_s=p.deadline_s)
+                            deadline_s=p.deadline_s,
+                            trace_id=p.trace_id)
                         tgt.note_ok()
                         placed = (tgt, nrid)
                         break

@@ -581,8 +581,11 @@ class IntegrityGuard:
             "param": None if param_digest is None
             else _canon(param_digest).hex(),
         }).encode()
-        boards = self.exchange.gather(f"chk-{step}", payload,
-                                      world=self.world, rank=self.rank)
+        from . import trace
+
+        with trace.span("guard.exchange", step=step, round="digest"):
+            boards = self.exchange.gather(f"chk-{step}", payload,
+                                          world=self.world, rank=self.rank)
         views: List[Optional[dict]] = []
         for b in boards:
             try:
@@ -679,9 +682,12 @@ class IntegrityGuard:
                     get_logger().warning(
                         "guard: recompute vote failed (%s: %s)",
                         type(e).__name__, e)
-            flags = self.exchange.gather(
-                f"vote-{step}", b"1" if self_ok else b"0",
-                world=self.world, rank=self.rank)
+            from . import trace
+
+            with trace.span("guard.exchange", step=step, round="vote"):
+                flags = self.exchange.gather(
+                    f"vote-{step}", b"1" if self_ok else b"0",
+                    world=self.world, rank=self.rank)
             attributed = [r for r, f in enumerate(flags) if f == b"0"]
             outcome = ("self" if self.rank in attributed
                        else "peer" if attributed else "unattributed")
@@ -721,6 +727,17 @@ class IntegrityGuard:
             "guard: this rank attributed as corrupt at step %d — "
             "reporting integrity failure and quarantining (exit %d)",
             verdict.step, QUARANTINE_EXIT)
+        try:
+            # flight recorder: the quarantined rank's final spans —
+            # including the chaos.inject event that framed it — leave
+            # with the bundle, not with the process image
+            from .trace import flight as _flight
+
+            _flight.maybe_dump("quarantine", extra={
+                "step": verdict.step,
+                "divergent_step": verdict.divergent_step})
+        except Exception:
+            pass
         try:
             from .elastic.worker import (
                 elastic_enabled, notification_manager,
@@ -800,6 +817,14 @@ class IntegrityGuard:
         # deleting them instead would race peers still mid-gather
         os.environ[ENV_GEN] = str(env_int(ENV_GEN, 0) + 1)
         os.environ[ENV_ROLLBACK_T0] = f"{time.time():.4f}"
+        try:
+            from .trace import flight as _flight
+
+            _flight.maybe_dump("rollback", extra={
+                "reason": reason,
+                "verified_step": self.last_verified_step})
+        except Exception:
+            pass
         try:
             from .elastic.worker import (
                 _persist_and_exec, elastic_enabled,

@@ -184,6 +184,17 @@ def unregister_control_handler(name: str) -> None:
 # -- HTTP endpoint -----------------------------------------------------------
 
 
+def _deny_remote(client_ip: str) -> bool:
+    """The PR-13 control-surface rule: the scrape surface (/metrics,
+    /healthz) is read-only and serves anyone, but mutating or verbose
+    surfaces (/control/*, /trace) answer loopback peers only unless
+    ``HVD_TPU_CONTROL_REMOTE=1`` opts remote callers in (put a real
+    proxy in front then).  Factored out so the gate is unit-testable
+    with arbitrary client addresses."""
+    return (not client_ip.startswith("127.") and client_ip != "::1"
+            and os.environ.get("HVD_TPU_CONTROL_REMOTE", "") != "1")
+
+
 class _Handler(BaseHTTPRequestHandler):
     registry: MetricsRegistry = REGISTRY
 
@@ -200,19 +211,18 @@ class _Handler(BaseHTTPRequestHandler):
                 sort_keys=True,
             ).encode()
             self._reply(200 if healthy else 503, "application/json", body)
-        elif path.startswith("/control/"):
-            # the scrape surface is read-only and binds all interfaces
-            # by default; the control surface MUTATES (SLO targets) —
-            # loopback peers only, unless the operator opts remote
-            # callers in explicitly (put a real proxy in front then)
-            if not self.client_address[0].startswith("127.") and \
-                    self.client_address[0] != "::1" and \
-                    os.environ.get("HVD_TPU_CONTROL_REMOTE", "") != "1":
+        elif path.startswith("/control/") or path in ("/trace", "/trace/"):
+            if _deny_remote(self.client_address[0]):
                 self._reply(403, "text/plain",
                             b"control surface is loopback-only "
                             b"(HVD_TPU_CONTROL_REMOTE=1 opts in)\n")
                 return
-            name = path[len("/control/"):].rstrip("/")
+            if path.startswith("/control/"):
+                name = path[len("/control/"):].rstrip("/")
+            else:
+                # /trace is the span-recorder export (docs/TRACING.md),
+                # mounted through the same control-handler registry
+                name = "trace"
             with _control_lock:
                 fn = _control_handlers.get(name)
             if fn is None:
@@ -231,8 +241,15 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(code, "application/json",
                         json.dumps(payload, sort_keys=True).encode())
         elif path == "/":
+            # advertise /trace only where its handler is mounted (a
+            # process that never ran trace install would 404 the link)
+            with _control_lock:
+                has_trace = "trace" in _control_handlers
             body = (b'<html><body><a href="/metrics">/metrics</a> '
-                    b'<a href="/healthz">/healthz</a></body></html>')
+                    b'<a href="/healthz">/healthz</a>'
+                    + (b' <a href="/trace">/trace</a>' if has_trace
+                       else b'')
+                    + b'</body></html>')
             self._reply(200, "text/html", body)
         else:
             self._reply(404, "text/plain", b"not found\n")

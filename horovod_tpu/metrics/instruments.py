@@ -520,6 +520,17 @@ KERAS_EPOCH_METRIC = gauge(
     ["metric"],
 )
 
+# -- distributed tracing (trace/) --------------------------------------------
+
+#: Flight-recorder crash bundles written, by trigger reason
+#: (chaos_kill / quarantine / rollback / preempt / restart /
+#: slo_breach — docs/TRACING.md).
+TRACE_BUNDLES = counter(
+    "hvd_tpu_trace_bundles_total",
+    "Flight-recorder crash bundles written, by trigger reason",
+    ["reason"],
+)
+
 # -- process identity --------------------------------------------------------
 
 PROCESS_INFO = gauge(

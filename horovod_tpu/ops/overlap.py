@@ -407,8 +407,14 @@ class BucketAutotuner:
         # first step of a trial pays the new schedule's compile
         scored = self._times[1:] if len(self._times) > 1 else self._times
         score = float(np.median(scored))
-        self._scores.append((self.candidates[self._trial], score))
+        cand = self.candidates[self._trial]
+        self._scores.append((cand, score))
         _metrics.OVERLAP_AUTOTUNE_TRIALS.inc()
+        from .. import trace as _trace
+
+        _trace.event("overlap.autotune", trial=self._trial,
+                     bucket_bytes=cand.bucket_bytes,
+                     wire_dtype=cand.wire_dtype, score_s=score)
         self._times = []
         self._trial += 1
         if (

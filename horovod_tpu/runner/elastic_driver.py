@@ -684,7 +684,20 @@ class ElasticDriver:
         # a host with worker 0, so it must not claim the workers' base
         # port): HVD_TPU_DRIVER_METRICS_PORT, same off-by-default rules
         from ..metrics import exposition as _exposition
+        from ..utils.logging import set_log_context
 
+        # the driver shares the workers' log formatter: its records
+        # carry rank="driver" so a collated multi-process log separates
+        # cleanly (HVD_TPU_LOG_JSON gives the machine-ingestable form)
+        set_log_context(rank="driver")
+        # ... and the workers' /trace surface: the driver records real
+        # spans of its own (fleet.scale decisions), so the recorder
+        # installs FULLY here — rank -1 keeps its exports/bundles off
+        # every worker's pid lane in a merge, and the flight baseline
+        # makes driver bundles carry true metric DELTAS
+        from .. import trace as _trace
+
+        _trace.install_from_env(rank=-1)
         _exposition.maybe_start_from_env(
             env_var="HVD_TPU_DRIVER_METRICS_PORT")
         host, port = self._start_server()

@@ -55,6 +55,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import trace
 from ..common.retry import env_float, env_int
 from ..data.prefetch import DevicePrefetcher
 from ..metrics import instruments as _instr
@@ -371,6 +372,9 @@ class ServingEngine:
         #: registry histograms carry production quantiles)
         self.token_log: Optional[list] = None
         self._next_id = 0
+        #: (kind, t0, t1) of the newest step program run — the extent
+        #: first-token emission anchors its serve.first_decode span to
+        self._last_step: Optional[tuple] = None
         self._progs: Dict[tuple, bool] = {}
         self._staging: Optional[DevicePrefetcher] = None
         self._staging_meta: collections.deque = collections.deque()
@@ -561,11 +565,15 @@ class ServingEngine:
 
     def submit(self, prompt, max_new_tokens: int, *, eos_id=None,
                arrival: Optional[float] = None,
-               deadline_s: Optional[float] = None) -> int:
+               deadline_s: Optional[float] = None,
+               trace_id: Optional[str] = None) -> int:
         """Enqueue one request; returns its id (key into ``results``).
         ``deadline_s`` overrides the engine's default latency budget
         (``ServeConfig.deadline_s``); past it the request is shed or
-        cancelled and ``results`` carries whatever was generated."""
+        cancelled and ``results`` carries whatever was generated.
+        ``trace_id`` is the caller's trace context (the fleet router
+        propagates its id here so the request's spans correlate across
+        router, engine and scheduler — docs/TRACING.md)."""
         if not self.accepting:
             raise RuntimeError(
                 "engine is draining (accepting=False); submit rejected")
@@ -578,7 +586,8 @@ class ServingEngine:
             max_new_tokens=int(max_new_tokens), eos_id=eos_id,
             arrival=self._clock() if arrival is None else arrival,
             deadline_s=deadline_s if deadline_s and deadline_s > 0
-            else None)
+            else None,
+            trace_id=trace_id)
         self._next_id += 1
         self._ids_seen.add(req.id)
         if req.deadline_s:
@@ -748,13 +757,27 @@ class ServingEngine:
             decode_rows + [s for s, _ in chunk_sel], bt, lens_list)
         self._book_program("mixed", bt, width)
         self._book_psum_bytes(bt, width)
+        tracing = trace.enabled()  # arg/list packing off the hot path
+        t0 = trace.now() if tracing else 0.0
         next_tok, self.k_pool, self.v_pool = self._mixed_fn(
             self.params, self.k_pool, self.v_pool, tables, lens,
             jnp.asarray(chunk_lens), tokens)
+        out = np.asarray(next_tok)  # device sync: the step's true extent
+        if tracing:
+            t1 = trace.now()
+            self._last_step = ("mixed", t0, t1)
+            trace.add_span("serve.step", t0, t1, kind="mixed", batch=n,
+                           chunks=len(chunk_sel),
+                           rids=[s.req.id for s in decode_rows])
+            for s, c in chunk_sel:
+                trace.add_span("serve.prefill_chunk", t0, t1,
+                               rid=s.req.id, chunk=int(c),
+                               offset=int(s.prefilled),
+                               trace=s.req.trace_id)
         _STEP_MIXED.inc()
         _instr.SERVE_PREFILL_CHUNKS.inc(len(chunk_sel))
         self.prefill_tokens_computed += sum(c for _, c in chunk_sel)
-        return np.asarray(next_tok), self._clock()
+        return out, self._clock()
 
     def _decode_once(self, seqs: List[Sequence]):
         """One decode step over ``seqs`` — tokens in cache = length - 1
@@ -775,11 +798,20 @@ class ServingEngine:
         last[:len(seqs)] = [s.generated[-1] for s in seqs]
         self._book_program("decode", bt, pages)
         self._book_psum_bytes(bt, 1)
+        tracing = trace.enabled()
+        t0 = trace.now() if tracing else 0.0
         next_tok, self.k_pool, self.v_pool = self._decode_fn(
             self.params, self.k_pool, self.v_pool, tables, lens,
             jnp.asarray(last), pages=pages)
+        out = np.asarray(next_tok)  # device sync: the step's true extent
+        if tracing:
+            t1 = trace.now()
+            self._last_step = ("decode", t0, t1)
+            trace.add_span("serve.step", t0, t1, kind="decode",
+                           batch=len(seqs),
+                           rids=[s.req.id for s in seqs])
         _STEP_DECODE.inc()
-        return np.asarray(next_tok), self._clock()
+        return out, self._clock()
 
     # -- token emission ------------------------------------------------------
 
@@ -793,6 +825,18 @@ class ServingEngine:
         if seq.first_token_at is None:
             seq.first_token_at = now
             _LAT_FIRST.observe(now - seq.req.arrival)
+            trace.event("serve.first_token", rid=seq.req.id,
+                        ttft=now - seq.req.arrival,
+                        trace=seq.req.trace_id)
+            if self._last_step is not None and \
+                    self._last_step[0] == "decode":
+                # the decode step that produced the first token — the
+                # last term of the TTFT decomposition (a first token
+                # emitted by the final prefill chunk is already covered
+                # by that chunk's span)
+                trace.add_span("serve.first_decode", self._last_step[1],
+                               self._last_step[2], rid=seq.req.id,
+                               trace=seq.req.trace_id)
         elif seq.last_token_at is not None:
             # honest inter-token gap: after an eviction it includes the
             # requeue wait + re-prefill — that IS the user-visible stall
@@ -802,6 +846,9 @@ class ServingEngine:
     def _emit(self, seq: Sequence, token: int, now: float) -> None:
         self._observe_token(seq, token, now)
         if seq.done:
+            trace.event("serve.finish", rid=seq.req.id,
+                        tokens=len(seq.generated),
+                        trace=seq.req.trace_id)
             self.scheduler.finish(seq)
             # the emitted stream: tokens folded into context by evictions
             # plus those generated since (an EOS always completes the

@@ -62,6 +62,7 @@ from typing import Deque, List, Optional, Tuple
 
 import numpy as np
 
+from .. import trace
 from ..metrics import instruments as _instr
 from .kv_cache import PREFIX_HASH_ROOT, BlockAllocator, blocks_for
 
@@ -80,6 +81,10 @@ class Request:
     #: in flight — tokens the client stopped waiting for are never
     #: computed (``HVD_TPU_SERVE_DEADLINE`` sets the engine default)
     deadline_s: Optional[float] = None
+    #: propagated trace context (fleet router -> replica -> engine ->
+    #: scheduler): rides every span this request touches so one id
+    #: follows it across components (docs/TRACING.md)
+    trace_id: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -383,6 +388,19 @@ class ContinuousBatchingScheduler:
             seq.prefilled = cached
             seq.published = len(matched)
             seq.block_hashes[:len(hashes)] = hashes
+            if seq.req.arrival > 0 and trace.enabled():
+                # the queue phase of the request's TTFT decomposition:
+                # arrival -> this admission.  The arrival rides the
+                # engine clock (perf_counter in production), so the
+                # duration is computed on that clock and anchored to
+                # the trace clock's "now"; a bare-Sequence caller with
+                # no arrival stamp records nothing
+                t1 = trace.now()
+                waited = max(0.0, (now if now is not None else t1)
+                             - seq.req.arrival)
+                trace.add_span("serve.queued", t1 - waited, t1,
+                               rid=seq.req.id, cached_blocks=len(matched),
+                               trace=seq.req.trace_id)
             batch.append(self.pending.popleft())
             tokens += tail
         self.running.extend(batch)

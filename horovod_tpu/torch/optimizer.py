@@ -218,10 +218,14 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                 tensors.append(compressed)
                 names.append(name)
                 ctxs.append(ctx)
-            handles = mpi_ops.allreduce_multi_async(
-                tensors, names, op=self._op,
-                process_set=self._process_set,
-            )
+            from .. import trace as _trace
+
+            with _trace.span("overlap.bucket", bucket=slot,
+                             params=len(members)):
+                handles = mpi_ops.allreduce_multi_async(
+                    tensors, names, op=self._op,
+                    process_set=self._process_set,
+                )
             # launch lead: params still awaiting gradients when this
             # bucket's collective was submitted (0 = it trailed backward)
             _metrics.OVERLAP_LAUNCH_LEAD.observe(max(pending_total, 0))

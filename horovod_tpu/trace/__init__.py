@@ -1,0 +1,344 @@
+"""Distributed tracing + black-box flight recorder (docs/TRACING.md).
+
+The span-level companion to the PR-1 aggregate metrics: a host-side,
+always-on recorder that answers "what happened, in order, to THIS
+request / THIS step / THIS rank" — the question counters and histograms
+structurally cannot (Sigelman et al., *Dapper*; the MegaScale flight
+recorder).  Three properties are load-bearing:
+
+* **zero device code** — every span is host-side bookkeeping around
+  dispatch points, so a traced program is BIT-IDENTICAL to the untraced
+  one: same StableHLO, zero added collectives, zero extra compiles
+  (tools/trace_bench.py pins all three);
+* **bounded memory, lock-cheap** — each thread records into its own
+  fixed-size ring (``HVD_TPU_TRACE_RING`` records; old records are
+  overwritten, never grown), so the recorder can stay on for the life
+  of a production job.  The hot path is two ``perf_counter`` reads and
+  one list store under the GIL — no lock, no allocation beyond the
+  record tuple;
+* **~ns when disabled** — ``HVD_TPU_TRACE=0`` turns :func:`span` /
+  :func:`event` into a single module-bool check returning a shared
+  null context (the chaos ``point()`` discipline).
+
+Sites are catalogued in :data:`SITES` (the analysis ``trace`` pass
+holds code ≡ catalogue ≡ docs/TRACING.md in both directions).  Spans
+bridge into any active ``jax.profiler`` XPlane capture through the same
+instrumentation point (``TraceAnnotation``; utils/profiler.py is now a
+thin alias), so the Chrome-trace export and the profiler see ONE set of
+span names.
+
+Export: :mod:`.export` renders per-rank Chrome trace-event JSON
+(perfetto-loadable; ``GET /trace`` on the PR-1 exposition endpoint,
+loopback-only) and merges per-rank dumps with step-boundary clock
+alignment.  :mod:`.flight` dumps the last N seconds of spans + metric
+deltas as a crash bundle on kill / quarantine / rollback / preemption /
+SLO breach.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = [
+    "SITES", "add_span", "configure", "enabled", "event",
+    "install_from_env", "new_trace_id", "now", "snapshot", "span",
+]
+
+#: Span/event site catalogue — every ``trace.span("...")`` /
+#: ``trace.event("...")`` / ``trace.add_span("...")`` literal in the
+#: package must name an entry here, every entry must have a live call
+#: site, and docs/TRACING.md's table mirrors this tuple exactly (the
+#: analysis ``trace`` pass checks all directions).
+SITES = (
+    "train.step",          # fit_epoch loop body: dispatch + host work
+    "data.wait",           # consumer wait on the prefetch queue
+    "data.produce",        # host batch production (producer thread)
+    "data.device_put",     # host->device staging copy
+    "checkpoint.publish",  # crash-atomic checkpoint write (_atomic_publish)
+    "collective.enqueue",  # negotiated-collective submission (controller)
+    "collective.exec",     # fused collective dispatch->data-ready
+    "overlap.bucket",      # torch bridge: one bucket's drained submission
+    "overlap.autotune",    # overlap autotuner: one trial scored
+    "serve.queued",        # request arrival -> admission (per request)
+    "serve.prefill_chunk", # one prefill chunk computed (per request)
+    "serve.step",          # one mixed/decode engine step (batch-wide)
+    "serve.first_decode",  # the decode step that emitted a first token
+    "serve.first_token",   # first-token emission (instant; TTFT arg)
+    "serve.finish",        # request completion (instant)
+    "fleet.route",         # router placement decision (instant)
+    "fleet.scale",         # autoscaler applied a scale decision (instant)
+    "fleet.preempt",       # preemption notice handled (instant)
+    "guard.exchange",      # cross-rank digest/vote exchange (cadence)
+    "chaos.inject",        # a chaos rule fired (instant, first-class)
+    "elastic.restart",     # exec-restart about to replace the image
+)
+
+ENV_TRACE = "HVD_TPU_TRACE"
+ENV_RING = "HVD_TPU_TRACE_RING"
+
+# wall-clock anchor: records carry perf_counter() times (monotonic);
+# the export maps them to epoch microseconds via this pair so per-rank
+# dumps land on one comparable axis before step alignment refines it
+_WALL0 = time.time()
+_PERF0 = time.perf_counter()
+
+now = time.perf_counter
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        return int(raw)
+    except ValueError:  # contract-ok: env -- validated with warn-and-default here; common.retry.env_int imports metrics and trace must stay import-light
+        return default
+
+
+#: module fast-path flag (the chaos ``active`` discipline): False means
+#: span()/event() are a bool check returning a shared null context
+_enabled = os.environ.get(ENV_TRACE, "1") != "0"
+_ring_cap = max(256, _env_int(ENV_RING, 16384))
+
+#: rank stamped on exports/bundles (set by install_from_env at init)
+_rank = 0
+_host = ""
+
+# jax.profiler.TraceAnnotation, resolved lazily and only when jax is
+# ALREADY loaded (the elastic driver records spans without ever paying
+# a jax import); None = no XPlane bridge
+_ann_cls: Optional[type] = None
+_ann_tried = False
+
+
+def _annotation_cls():
+    global _ann_cls, _ann_tried
+    if not _ann_tried and "jax" in sys.modules:
+        _ann_tried = True
+        try:
+            from jax.profiler import TraceAnnotation
+
+            _ann_cls = TraceAnnotation
+        except Exception:
+            _ann_cls = None
+    return _ann_cls
+
+
+class _Ring:
+    """One thread's fixed-size record ring.  Single writer (the owning
+    thread); readers snapshot under the registry lock — a torn read of
+    the newest slot is acceptable by design (the exporter sorts and
+    drops malformed slots)."""
+
+    __slots__ = ("buf", "idx", "cap", "tid", "owner")
+
+    def __init__(self, cap: int, tid: str):
+        # grown lazily to cap (a thread that records a handful of spans
+        # must not pay the full ring's preallocation)
+        self.buf: List[tuple] = []
+        self.idx = 0
+        self.cap = cap
+        self.tid = tid
+        self.owner: Optional[Any] = None  # weakref to the owning thread
+
+    def append(self, rec: tuple) -> None:
+        if len(self.buf) < self.cap:
+            self.buf.append(rec)
+        else:
+            self.buf[self.idx % self.cap] = rec
+        self.idx += 1
+
+    def records(self) -> List[tuple]:
+        if self.idx <= self.cap:
+            return list(self.buf)
+        start = self.idx % self.cap
+        return self.buf[start:] + self.buf[:start]
+
+
+_rings_lock = threading.Lock()
+_rings: List[_Ring] = []
+_local = threading.local()
+
+
+def _ring() -> _Ring:
+    r = getattr(_local, "ring", None)
+    if r is None:
+        import weakref
+
+        t = threading.current_thread()
+        r = _Ring(_ring_cap, f"{t.name}-{t.ident}")
+        r.owner = weakref.ref(t)
+        _local.ring = r
+        with _rings_lock:
+            _rings.append(r)
+            # a thread-churny host (one ring per short-lived thread)
+            # must not grow without bound — but ONLY dead threads'
+            # rings may retire: evicting by age alone was measured to
+            # drop the long-lived MAIN thread's ring after 64 worker
+            # threads churned past it, silently losing every later
+            # training span.  Live-thread count bounds the rest.
+            if len(_rings) > 64:
+                # _rings[:-64] is disjoint from the protected newest-64
+                # tail by construction, so liveness is the only test
+                for old in _rings[:-64]:
+                    owner = old.owner() if old.owner is not None else None
+                    if owner is None or not owner.is_alive():
+                        _rings.remove(old)
+    return r
+
+
+# records: (site, t0, dur, args) — dur None = instant event.  args is a
+# small dict or None; values must be JSON-serializable (export contract).
+
+
+class _Span:
+    __slots__ = ("site", "xname", "args", "t0", "ann")
+
+    def __init__(self, site: str, xname: Optional[str], args):
+        self.site = site
+        self.xname = xname
+        self.args = args
+        self.ann = None
+
+    def __enter__(self):
+        if self.xname is not None:
+            cls = _annotation_cls()
+            if cls is not None:
+                self.ann = cls(self.xname)
+                self.ann.__enter__()
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter()
+        if _enabled:
+            _ring().append((self.site, self.t0, t1 - self.t0, self.args))
+        if self.ann is not None:
+            self.ann.__exit__(*exc)
+        return False
+
+
+_NULL = contextlib.nullcontext()
+
+
+def span(site: str, /, _xname: Optional[str] = None, **args):
+    """Context manager recording one host-side span at ``site``.
+
+    ``args`` ride into the Chrome export's ``args`` field (keep them
+    small and JSON-serializable; ``rid``/``step``/``trace`` are the
+    anchoring conventions).  ``_xname`` overrides the name the span
+    carries into an active jax.profiler capture (default
+    ``hvd_tpu::<site>``); ``_xname=False`` suppresses the bridge for
+    this span.  One module-bool check when tracing is off."""
+    if not _enabled:
+        # HVD_TPU_TRACE=0 drops the ring record, but a caller that
+        # asked for a specific XPlane name (the profiler bridge) still
+        # gets its annotation — the two switches stay independent
+        if _xname:
+            cls = _annotation_cls()
+            if cls is not None:
+                return cls(_xname)
+        return _NULL
+    xname = (None if _xname is False
+             else (_xname or f"hvd_tpu::{site}"))
+    return _Span(site, xname, args or None)
+
+
+def event(site: str, /, **args) -> None:
+    """Record one instant event at ``site`` (no duration, no XPlane
+    bridge — annotations need extents)."""
+    if not _enabled:
+        return
+    _ring().append((site, time.perf_counter(), None, args or None))
+
+
+def add_span(site: str, t0: float, t1: float, /, **args) -> None:
+    """Record a span with explicit extents (``now()``-clock seconds) —
+    for retroactive spans whose boundaries were observed elsewhere
+    (e.g. a request's queued time, known only at admission)."""
+    if not _enabled:
+        return
+    _ring().append((site, t0, max(0.0, t1 - t0), args or None))
+
+
+def snapshot(since: float = 0.0) -> List[tuple]:
+    """Every live record with ``t0 >= since`` across all thread rings,
+    time-ordered: ``(site, t0, dur_or_None, args_or_None, tid)``."""
+    with _rings_lock:
+        rings = list(_rings)
+    out = []
+    for r in rings:
+        for rec in r.records():
+            if rec[1] >= since:
+                out.append(rec + (r.tid,))
+    out.sort(key=lambda r: r[1])
+    return out
+
+
+def epoch_us(t: float) -> float:
+    """Map a ``now()``-clock time to epoch microseconds (export axis)."""
+    return (_WALL0 + (t - _PERF0)) * 1e6
+
+
+_id_lock = threading.Lock()
+_id_counter = 0
+
+
+def new_trace_id() -> str:
+    """A process-unique trace-context id (router -> replica -> engine ->
+    scheduler propagation; docs/TRACING.md)."""
+    global _id_counter
+    with _id_lock:
+        _id_counter += 1
+        n = _id_counter
+    return f"t{_rank}-{os.getpid():x}-{n:x}"
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def configure(enabled: Optional[bool] = None,
+              ring: Optional[int] = None) -> None:
+    """Programmatic switch (benches/tests).  ``ring`` applies to rings
+    created AFTER the call (existing threads keep their buffers)."""
+    global _enabled, _ring_cap
+    if enabled is not None:
+        _enabled = bool(enabled)
+    if ring is not None:
+        _ring_cap = max(256, int(ring))
+
+
+def install_from_env(rank: int = 0, host: Optional[str] = None) -> bool:
+    """Init-time hook (``hvd.init()``): resolve the env switches, stamp
+    the rank/host the export and flight bundles carry, mount the
+    ``/trace`` control endpoint, and baseline the flight recorder's
+    metric snapshot.  Returns whether recording is enabled."""
+    global _enabled, _ring_cap, _rank, _host
+    _enabled = os.environ.get(ENV_TRACE, "1") != "0"
+    _ring_cap = max(256, _env_int(ENV_RING, 16384))
+    _rank = int(rank)
+    if host is None:
+        import socket
+
+        host = socket.gethostname()
+    _host = host
+    from . import export as _export
+    from . import flight as _flight
+
+    _export.register_trace_endpoint()
+    _flight.note_metrics_baseline()
+    return _enabled
+
+
+def rank() -> int:
+    return _rank
+
+
+def host() -> str:
+    return _host

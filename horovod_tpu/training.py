@@ -541,6 +541,8 @@ def fit_epoch(step: Callable, state: TrainState, loader,
     """
     from . import chaos as _chaos
     from . import checkpoint as _checkpoint
+    from . import trace
+    from .utils.logging import set_log_context
 
     if epoch is not None and hasattr(loader, "set_epoch"):
         loader.set_epoch(epoch)
@@ -551,10 +553,25 @@ def fit_epoch(step: Callable, state: TrainState, loader,
     loss = None
     batches = 0
     guard_base = None
+    # the trace anchors steps GLOBALLY (cross-rank merge aligns on the
+    # step number): one int(state.step) host sync per fit_epoch call,
+    # and only while recording — the untraced loop stays sync-free.
+    # The structured-log step field is stamped from the same base, so
+    # it is only stamped while recording too (an epoch-relative number
+    # would MISLABEL records against ckpt-N/guard step numbers).
+    tracing = trace.enabled()
+    trace_base = int(state.step) if tracing else 0
     for inputs, labels in loader:
         if _chaos.active:
             _chaos.raise_point("training.step")
-        out = step(state, inputs, labels)
+        if tracing:
+            step_no = trace_base + batches + 1
+            set_log_context(step=step_no)
+            with trace.span("train.step", step=step_no,
+                            epoch=-1 if epoch is None else epoch):
+                out = step(state, inputs, labels)
+        else:
+            out = step(state, inputs, labels)
         if len(out) == 3:
             state, loss, diag = out
             if guard is not None:

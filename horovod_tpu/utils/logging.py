@@ -5,12 +5,25 @@ HOROVOD_LOG_LEVEL) — here a thin shim over :mod:`logging` with the same
 level names, shared by the Python layer and surfaced to the native core.
 Env lookup goes through utils.env_parser so HVD_TPU_*/HOROVOD_* fallback
 and bool grammar stay consistent framework-wide.
+
+Structured context: every record carries ``rank`` / ``host`` / ``step``
+fields, stamped from one process-wide context (:func:`set_log_context`
+— ``hvd.init`` sets the rank, the elastic driver marks itself
+``driver``, the training loop keeps ``step`` current), so the driver,
+worker and fleet loggers share ONE formatter and a multi-process log
+collates by rank instead of by guesswork.  ``HVD_TPU_LOG_JSON=1`` opts
+into one-JSON-object-per-line output (machine-ingestable; the same
+fields), the default stays the human text format.
 """
 
 from __future__ import annotations
 
+import json
 import logging
+import socket
 import sys
+import time
+from typing import Optional
 
 from .env_parser import _get, _get_bool
 
@@ -26,16 +39,67 @@ _LEVELS = {
 _LOGGER = logging.getLogger("horovod_tpu")
 _configured = False
 
+#: JSON-lines opt-in (read below through the env_parser `_get_bool`
+#: grammar, so `HOROVOD_LOG_JSON` falls back like every other knob)
+ENV_LOG_JSON = "HVD_TPU_LOG_JSON"
+
+#: process-wide structured-log context (one dict, mutated in place so
+#: the installed filter sees updates without re-registration)
+_context = {"rank": "-", "host": socket.gethostname(), "step": "-"}
+
+
+def set_log_context(rank=None, host=None, step=None) -> None:
+    """Update the fields every subsequent record carries.  ``rank`` may
+    be an int or a role string ("driver"); ``step`` is kept current by
+    the training loop (one dict store per step)."""
+    if rank is not None:
+        _context["rank"] = rank
+    if host is not None:
+        _context["host"] = host
+    if step is not None:
+        _context["step"] = step
+
+
+class _ContextFilter(logging.Filter):
+    def filter(self, record: logging.LogRecord) -> bool:
+        record.rank = _context["rank"]
+        record.host = _context["host"]
+        record.step = _context["step"]
+        return True
+
+
+class _JsonFormatter(logging.Formatter):
+    """One JSON object per line: level, message, logger and the shared
+    rank/host/step context (HVD_TPU_LOG_JSON=1; docs/running.md)."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        out = {
+            "t": round(time.time(), 3),
+            "level": record.levelname,
+            "logger": record.name,
+            "msg": record.getMessage(),
+            "rank": getattr(record, "rank", "-"),
+            "host": getattr(record, "host", "-"),
+            "step": getattr(record, "step", "-"),
+        }
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        return json.dumps(out)
+
 
 def get_logger() -> logging.Logger:
     global _configured
     if not _configured:
         level_name = (_get("LOG_LEVEL", "warning") or "warning").lower()
         handler = logging.StreamHandler(sys.stderr)
-        hide_time = _get_bool("LOG_HIDE_TIME", False)
-        fmt = "[%(levelname)s] hvd_tpu: %(message)s" if hide_time else \
-            "%(asctime)s [%(levelname)s] hvd_tpu: %(message)s"
-        handler.setFormatter(logging.Formatter(fmt))
+        if _get_bool("LOG_JSON", False):
+            handler.setFormatter(_JsonFormatter())
+        else:
+            hide_time = _get_bool("LOG_HIDE_TIME", False)
+            fmt = "[%(levelname)s] hvd_tpu: %(message)s" if hide_time else \
+                "%(asctime)s [%(levelname)s] hvd_tpu: %(message)s"
+            handler.setFormatter(logging.Formatter(fmt))
+        _LOGGER.addFilter(_ContextFilter())
         _LOGGER.addHandler(handler)
         _LOGGER.setLevel(_LEVELS.get(level_name, logging.WARNING))
         _LOGGER.propagate = False

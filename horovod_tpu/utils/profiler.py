@@ -3,22 +3,26 @@
 Reference analog: SURVEY.md §5.1 — the reference's timeline is its own
 Chrome-trace writer; its NVTX hooks put the same spans into the vendor
 profiler so one capture shows framework activity next to kernel
-activity.  The TPU-native equivalent: every negotiated collective emits
-``TraceMe`` spans (via :class:`jax.profiler.TraceAnnotation`) with the
-SAME activity names the Chrome timeline uses (ENQUEUE / XLA_COMM), so a
-single ``jax.profiler.trace`` XPlane capture shows where negotiation
-and collective execution sit relative to XLA's own ops.
+activity.
 
-Span semantics (TraceMe spans are thread-local, so each side of the
-handoff gets its own span — the negotiation wait is the *gap*):
+Since the ``horovod_tpu.trace`` recorder landed, this module is a thin
+alias over it: ONE instrumentation point (the controller's
+enqueue/exec call sites) now produces BOTH views —
 
-  * ``hvd_tpu::<name>::ENQUEUE``   — training thread, inside enqueue();
-  * ``hvd_tpu::<op>::XLA_COMM``    — background exec thread, dispatch →
-    data-ready of the fused collective program.
+  * the XPlane capture span, named ``hvd_tpu::<name>::<activity>``
+    exactly as before (``jax.profiler.TraceAnnotation``; existing
+    ``tools/profile_capture.py`` recipes and the committed example
+    trace keep their names), and
+  * a ring-buffer record at the catalogued ``collective.enqueue`` /
+    ``collective.exec`` site, which the ``/trace`` Chrome export and
+    the flight recorder serve (docs/TRACING.md).
+
+There is no second span-naming scheme to drift: the activity string is
+derived from the trace site at ONE place below.
 
 Overhead when no capture is active is one atomic load per span (TraceMe
-fast path), so the bridge is always on; set ``HVD_TPU_PROFILER_BRIDGE=0``
-to compile it out at import.
+fast path) plus the ring store; ``HVD_TPU_PROFILER_BRIDGE=0`` drops the
+XPlane half, ``HVD_TPU_TRACE=0`` the ring half (both = a null context).
 
 Capture recipe (works on the 8-device CPU mesh and on TPU)::
 
@@ -36,22 +40,19 @@ committed example trace (docs/example_trace.json.gz).
 
 from __future__ import annotations
 
-import contextlib
 import os
 
-_ENABLED = os.environ.get("HVD_TPU_PROFILER_BRIDGE", "1") != "0"
+from .. import trace as _trace
 
-if _ENABLED:
-    try:
-        from jax.profiler import TraceAnnotation
-    except Exception:  # pragma: no cover - ancient jax
-        _ENABLED = False
-
-_NULL = contextlib.nullcontext()
+_BRIDGE = os.environ.get("HVD_TPU_PROFILER_BRIDGE", "1") != "0"
 
 
 def span(name: str, activity: str):
-    """Context manager for one framework span in the XPlane capture."""
-    if not _ENABLED:
-        return _NULL
-    return TraceAnnotation(f"hvd_tpu::{name}::{activity}")
+    """Context manager for one framework span: the XPlane capture gets
+    ``hvd_tpu::<name>::<activity>``, the trace ring gets the catalogued
+    site for the activity (ENQUEUE -> collective.enqueue, anything else
+    -> collective.exec) with the collective's name as an arg."""
+    xname = f"hvd_tpu::{name}::{activity}" if _BRIDGE else False
+    if activity == "ENQUEUE":
+        return _trace.span("collective.enqueue", _xname=xname, name=name)
+    return _trace.span("collective.exec", _xname=xname, name=name)

@@ -41,6 +41,8 @@ import numpy as np
 
 import horovod_tpu as hvd
 from horovod_tpu import checkpoint as hvd_checkpoint
+from horovod_tpu import trace as hvd_trace
+from horovod_tpu.trace import export as trace_export
 
 
 def log(logdir, **kv):
@@ -112,15 +114,18 @@ def main():
             restart_total_s=(stats["total_s"] if stats else None))
         clean_inc = np.ones((), np.float32)
         while state.step < batches:
-            inc = clean_inc
-            if iguard.enabled:
-                # the guard.grad chaos site: a flipbit rule here IS the
-                # silent-corruption drill — the (possibly lying) value
-                # is what this "chip" hands the training step
-                inc = iguard.tap_grads(clean_inc)
-            state.weight = np.asarray(state.weight) + inc
-            state.step = int(state.step) + 1
-            state.commit()
+            # train.step spans with GLOBAL step args: the anchors the
+            # cross-rank trace merge aligns clocks on (docs/TRACING.md)
+            with hvd_trace.span("train.step", step=int(state.step) + 1):
+                inc = clean_inc
+                if iguard.enabled:
+                    # the guard.grad chaos site: a flipbit rule here IS
+                    # the silent-corruption drill — the (possibly lying)
+                    # value is what this "chip" hands the training step
+                    inc = iguard.tap_grads(clean_inc)
+                state.weight = np.asarray(state.weight) + inc
+                state.step = int(state.step) + 1
+                state.commit()
             if hvd.cross_rank() == 0:
                 # with the guard armed the ring must outlive a full
                 # agreement window: a rollback discards every
@@ -160,6 +165,15 @@ def main():
 
     final = train(state)
     assert abs(final - batches) < 1e-6, (final, batches)
+    # per-rank Chrome-trace dump: the soak's cross-rank merge input
+    # (tools/trace_collect.py; step-aligned in the corrupt-recover
+    # scenario's assertion)
+    wid = os.environ.get("HVD_TPU_ELASTIC_WORKER_ID", "na")
+    try:
+        trace_export.write_dump(
+            os.path.join(logdir, f"trace_{wid}.json"))
+    except OSError:
+        pass
     log(logdir, event="done", weight=final, step=int(state.step),
         world=hvd.cross_size(), rank=hvd.cross_rank())
 

@@ -104,6 +104,8 @@ def _discovery(tmp, slots, hosts_lines=None):
 def _read_events(logdir):
     events = []
     for name in sorted(os.listdir(logdir)):
+        if not (name.startswith("worker_") and name.endswith(".log")):
+            continue  # per-rank trace dumps share the directory
         with open(os.path.join(logdir, name)) as f:
             for line in f:
                 ev = json.loads(line)
@@ -132,16 +134,38 @@ def _run_job(tmp, *, np_, min_np, max_np, slots, batches, chaos, seed,
     return proc, _read_events(logdir)
 
 
+def _read_bundles(bdir, reason):
+    """Flight-recorder bundles of one trigger reason (may import the
+    package: the soak driver already does for other scenarios)."""
+    from horovod_tpu.trace.flight import read_bundle
+
+    if not os.path.isdir(bdir):
+        return []
+    return [read_bundle(os.path.join(bdir, n))
+            for n in sorted(os.listdir(bdir))
+            if n.startswith(f"bundle-{reason}-")]
+
+
+def _bundle_sites(bundle):
+    return [(e["name"], (e.get("args") or {}).get("site"))
+            for e in bundle["trace"]["traceEvents"]
+            if e.get("ph") in ("X", "i")]
+
+
 def scenario_kill_resume(batches, seed):
     """Worker killed at commit #K; the fresh replacement must resume from
-    the checkpoint, not step 0, and finish exactly."""
+    the checkpoint, not step 0, and finish exactly.  The dying worker's
+    flight recorder must leave a crash bundle carrying its final spans —
+    including the injected chaos event (the ISSUE-15 black-box drill)."""
     kill_at = max(3, batches // 3)
     with tempfile.TemporaryDirectory(prefix="chaos_soak_") as tmp:
         fuse = os.path.join(tmp, "kill.fuse")
+        bdir = os.path.join(tmp, "bundles")
         proc, events = _run_job(
             tmp, np_=1, min_np=1, max_np=1, slots=2, batches=batches,
             chaos=f"elastic.commit:kill,at={kill_at},rank=0,fuse={fuse}",
             seed=seed,
+            extra_env={"HVD_TPU_TRACE_BUNDLE_DIR": bdir},
         )
         assert proc.returncode == 0, (
             f"job failed rc={proc.returncode}\n{proc.stderr[-4000:]}")
@@ -159,8 +183,20 @@ def scenario_kill_resume(batches, seed):
         assert any(b["step"] >= kill_at - 1 and b["step"] > 0
                    for b in boots), \
             f"replacement did not auto-resume from checkpoint: {boots}"
+        # flight recorder: the killed worker dumped its black box BEFORE
+        # os._exit — final train.step spans + the chaos kill event at
+        # the elastic.commit site, attributed to the dying rank
+        bundles = _read_bundles(bdir, "chaos_kill")
+        assert bundles, f"no chaos_kill crash bundle in {bdir}"
+        b = bundles[0]
+        assert b["rank"] == 0 and b["extra"]["site"] == "elastic.commit", b
+        sites = _bundle_sites(b)
+        assert ("chaos.inject", "elastic.commit") in sites, sites
+        assert any(name == "train.step" for name, _ in sites), \
+            f"bundle carries no final train.step spans: {sites}"
         return {"kill_at": kill_at, "boots": boots,
-                "recovered_steps": dones[0]["step"]}
+                "recovered_steps": dones[0]["step"],
+                "bundle_events": len(b["trace"]["traceEvents"])}
 
 
 def scenario_corrupt_recover(batches, seed):
@@ -191,7 +227,47 @@ def scenario_corrupt_recover(batches, seed):
         assert resets, f"no reset epoch after the corrupted frame: {events}"
         assert "bad MAC" in proc.stderr or "chaos injecting" in \
             proc.stderr, "native chaos left no trace in stderr"
-        return {"resets": len(resets)}
+        # cross-rank trace merge (ISSUE-15): both finishers dumped their
+        # span rings; the collector must align their train.step clocks
+        # and produce one perfetto-loadable timeline with 2 rank lanes
+        logdir = os.path.join(tmp, "logs")
+        dumps = sorted(os.path.join(logdir, n) for n in os.listdir(logdir)
+                       if n.startswith("trace_") and n.endswith(".json"))
+        assert len(dumps) == 2, f"expected 2 per-rank trace dumps: {dumps}"
+        merged_path = os.path.join(tmp, "merged_trace.json")
+        subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "trace_collect.py")]
+            + dumps + ["-o", merged_path],
+            env=_env(), cwd=REPO, check=True, timeout=120,
+            capture_output=True)
+        with open(merged_path) as f:
+            merged = json.load(f)
+        pids = {e["pid"] for e in merged["traceEvents"]}
+        assert pids == {0, 1}, f"merged trace missing a rank lane: {pids}"
+        for ev in merged["traceEvents"]:
+            assert "name" in ev and "ph" in ev, ev
+        # step alignment: for steps BOTH ranks recorded, the shifted
+        # start deltas must be centred (median ~0 by construction) and
+        # bounded — the clocks really were put on one axis
+        per_rank = {}
+        for ev in merged["traceEvents"]:
+            if ev.get("name") == "train.step" and ev.get("ph") == "X":
+                step = (ev.get("args") or {}).get("step")
+                per_rank.setdefault(ev["pid"], {}).setdefault(
+                    step, ev["ts"])
+        common = set(per_rank.get(0, {})) & set(per_rank.get(1, {}))
+        assert common, "no common train.step anchors across ranks"
+        deltas = sorted(abs(per_rank[0][s] - per_rank[1][s])
+                        for s in common)
+        median_delta_us = deltas[len(deltas) // 2]
+        assert median_delta_us < 1e6, (
+            f"ranks' steps not aligned after merge: median "
+            f"|delta|={median_delta_us}us over {len(common)} steps")
+        return {"resets": len(resets), "merged_events":
+                len(merged["traceEvents"]),
+                "aligned_steps": len(common),
+                "median_step_delta_ms": round(median_delta_us / 1e3, 2)}
 
 
 def scenario_autoscale(batches, seed, peak=4):
@@ -294,6 +370,7 @@ def scenario_sdc(batches, seed, cadence=4):
     with tempfile.TemporaryDirectory(prefix="chaos_soak_") as tmp:
         fuse = os.path.join(tmp, "sdc.fuse")
         board = os.path.join(tmp, "board")
+        bdir = os.path.join(tmp, "bundles")
         proc, events = _run_job(
             tmp, np_=2, min_np=1, max_np=2, slots=2, batches=batches,
             hosts_lines=["localhost:1", "127.0.0.1:1"],
@@ -303,7 +380,8 @@ def scenario_sdc(batches, seed, cadence=4):
             seed=seed,
             extra_env={"HVD_TPU_GUARD": "1",
                        "HVD_TPU_GUARD_CADENCE": str(cadence),
-                       "HVD_TPU_GUARD_BOARD": board},
+                       "HVD_TPU_GUARD_BOARD": board,
+                       "HVD_TPU_TRACE_BUNDLE_DIR": bdir},
         )
         assert proc.returncode == 0, (
             f"job failed rc={proc.returncode}\n{proc.stderr[-4000:]}")
@@ -345,10 +423,24 @@ def scenario_sdc(batches, seed, cadence=4):
         assert len(dones) == 1, f"expected 1 finisher: {dones}"
         assert abs(dones[0]["weight"] - batches) < 1e-6, dones
         assert dones[0]["world"] == 1, dones
+        # flight recorder: the QUARANTINED rank (1) dumped its black box
+        # before exit 86 — final spans incl. the injected guard.grad
+        # flipbit event and the guard exchange that convicted it
+        bundles = _read_bundles(bdir, "quarantine")
+        assert bundles, f"no quarantine crash bundle in {bdir}"
+        qb = [b for b in bundles if b["rank"] == 1]
+        assert qb, f"quarantine bundle not from rank 1: " \
+            f"{[b['rank'] for b in bundles]}"
+        sites = _bundle_sites(qb[0])
+        assert ("chaos.inject", "guard.grad") in sites, sites
+        assert any(name == "guard.exchange" for name, _ in sites), sites
+        assert qb[0]["extra"]["step"] == detect_step, qb[0]["extra"]
         return {"flip_step": flip_step, "detect_step": detect_step,
                 "verified_step": verified,
                 "rollback_s": round(max(e["rollback_s"]
-                                        for e in done_rollbacks), 2)}
+                                        for e in done_rollbacks), 2),
+                "quarantine_bundle_events":
+                len(qb[0]["trace"]["traceEvents"])}
 
 
 def _replay_trace(tmp, tag, seed):

@@ -89,7 +89,19 @@ LEGS = {
 OVERLAP_LEGS = ("overlap", "overlap_autotune")
 
 
+_leg_t0 = time.time()
+
+
+def begin_leg():
+    """Stamp the wall-clock start of the next leg (emit() pairs it with
+    t_end so bench rows correlate with trace dumps from the same run)."""
+    global _leg_t0
+    _leg_t0 = time.time()
+
+
 def emit(rec, human=""):
+    rec.setdefault("t_start", round(_leg_t0, 3))
+    rec.setdefault("t_end", round(time.time(), 3))
     print(json.dumps(rec))
     if human:
         print(human, file=sys.stderr)
@@ -412,6 +424,7 @@ def main(argv=None):
         leg = leg.strip()
         if leg not in LEGS and leg not in OVERLAP_LEGS:
             ap.error(f"unknown leg {leg!r}")
+        begin_leg()
         try:
             if leg == "overlap":
                 for rec in run_overlap_legs(wmesh, world, args.smoke):

@@ -43,6 +43,10 @@ def main() -> int:
     x = jnp.arange(1 << 16, dtype=jnp.float32)
     hvd.allreduce(x, name="warmup")  # compile outside the capture
 
+    from horovod_tpu import trace
+    from horovod_tpu.trace import export as trace_export
+
+    since = trace.now()
     jax.profiler.start_trace(logdir)
     for i in range(8):
         y = hvd.allreduce(x, name=f"grad_{i % 4}")
@@ -51,7 +55,13 @@ def main() -> int:
     hvd.grouped_allreduce([x, x * 2, x * 3], name="bucket")
     jax.profiler.stop_trace()
     hvd.stop_timeline()
+    # ONE instrumentation point, two views (docs/TRACING.md): the same
+    # collective.enqueue/exec spans that just landed in the XPlane
+    # capture also export as standalone Chrome trace-event JSON
+    chrome = os.path.join(logdir, "hvd_framework_spans.json")
+    trace_export.write_dump(chrome, since=since)
     print(f"trace written under {logdir}/plugins/profile/")
+    print(f"framework spans (Chrome trace-event JSON): {chrome}")
     return 0
 
 

@@ -92,6 +92,8 @@ from horovod_tpu.ops.comm_model import (  # noqa: E402
 from horovod_tpu.serving import (  # noqa: E402
     Request, ServeConfig, ServingEngine, modeled_decode_read_bytes,
 )
+from horovod_tpu import trace  # noqa: E402
+from horovod_tpu.trace import export as trace_export  # noqa: E402
 
 
 def _percentile(xs, p):
@@ -140,12 +142,17 @@ def _ttfts(token_log):
 def _leg_stats(leg, token_log, wall_s, results):
     lats = [emit - arr for (_rid, emit, arr) in token_log]
     ttfts = _ttfts(token_log)
+    # wall-clock leg extents so bench rows correlate with trace dumps /
+    # flight bundles from the same run (epoch seconds, the export axis)
+    t_end = time.time()
     return {
         "bench": "serve",
         "leg": leg,
         "requests": len(results),
         "tokens": len(token_log),
         "wall_s": round(wall_s, 4),
+        "t_start": round(t_end - wall_s, 3),
+        "t_end": round(t_end, 3),
         "throughput_tokens_per_s": round(len(token_log) / wall_s, 2),
         "p50_token_latency_s": round(_percentile(lats, 50), 4),
         "p99_token_latency_s": round(_percentile(lats, 99), 4),
@@ -161,6 +168,7 @@ def run_continuous(eng, load, interarrival, leg="continuous", id_base=0):
     hits0 = eng.scheduler.prefix_hit_blocks
     look0 = eng.scheduler.prefix_lookup_blocks
     comp0 = eng.prefill_tokens_computed
+    trace_t0 = trace.now()
     t0 = time.perf_counter()
 
     def source():
@@ -190,6 +198,18 @@ def run_continuous(eng, load, interarrival, leg="continuous", id_base=0):
     hits = eng.scheduler.prefix_hit_blocks - hits0
     row["prefix_hit_rate"] = round(hits / lookups, 4) if lookups else 0.0
     row["prefill_tokens_computed"] = eng.prefill_tokens_computed - comp0
+    # per-request TTFT decomposition from the leg's OWN spans (queued +
+    # prefill chunks + first decode must sum to the measured TTFT —
+    # docs/TRACING.md; the CI smoke asserts the tolerance).  Requests
+    # whose early spans the ring already overwrote are skipped.
+    if trace.enabled():
+        recs = trace.snapshot(since=trace_t0)
+        decomp = [d for d in
+                  (trace_export.request_decomposition(recs, id_base + i)
+                   for i in range(len(load))) if d is not None]
+        row["ttft_decomp_requests"] = len(decomp)
+        row["ttft_decomp_max_err_s"] = (
+            round(max(d["err_s"] for d in decomp), 4) if decomp else None)
     return row, results
 
 
@@ -285,6 +305,8 @@ def kv_model_leg(cfg, serve_cfg, context_len, page_tiers):
     return {
         "bench": "serve",
         "leg": "kv_model",
+        "t_start": round(time.time(), 3),
+        "t_end": round(time.time(), 3),
         "context_len": context_len,
         "kv_occupancy": None,  # schema parity with the measured legs
         "throughput_tokens_per_s": None,
@@ -381,6 +403,8 @@ def run_multichip_leg(shards, n_requests, seed, write_json):
     row = {
         "bench": "serve",
         "leg": "multichip",
+        "t_start": round(time.time() - wall, 3),
+        "t_end": round(time.time(), 3),
         "n_devices": jax.device_count(),
         "shard_factor": shards,
         "requests": len(load),
@@ -448,6 +472,8 @@ def _fleet_row(leg, router, gids, wall):
     return {
         "bench": "serve",
         "leg": leg,
+        "t_start": round(time.time() - wall, 3),
+        "t_end": round(time.time(), 3),
         "requests": len(gids),
         "tokens": toks,
         "wall_s": round(wall, 4),

@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""Cross-rank trace collector: merge per-rank Chrome-trace dumps.
+
+The driver-side half of ``horovod_tpu.trace`` (docs/TRACING.md): each
+rank exports its span rings — ``GET /trace`` on its metrics endpoint,
+``trace.export.write_dump()``, or a flight-recorder bundle's ``trace``
+member — and this tool merges them onto ONE timeline with step-boundary
+clock alignment (every rank's ``train.step`` spans carry a global
+``step`` arg; the median per-step start delta against the first dump is
+that rank's clock offset).  The merged file loads in ui.perfetto.dev
+with one process lane per rank.
+
+Usage::
+
+    python tools/trace_collect.py rank0.json rank1.json -o merged.json
+    python tools/trace_collect.py --bundles /path/to/bundles -o merged.json
+
+Exit 0 on success; the merged JSON also prints a one-line summary to
+stderr (ranks, events, offsets).
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def _load(path: str) -> dict:
+    """One per-rank dump: a raw Chrome-trace JSON, or a flight bundle
+    (checksum-wrapped; its ``trace`` member is the dump)."""
+    from horovod_tpu.trace.flight import read_bundle
+
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (UnicodeDecodeError, ValueError):
+        doc = read_bundle(path)
+    if "traceEvents" in doc:
+        return doc
+    if "trace" in doc:  # a flight bundle
+        inner = doc["trace"]
+        inner.setdefault("metadata", {}).setdefault("rank", doc.get("rank", 0))
+        return inner
+    raise ValueError(f"{path}: neither a trace dump nor a flight bundle")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("dumps", nargs="*", help="per-rank trace dumps")
+    ap.add_argument("--bundles", default=None,
+                    help="directory of flight bundles to merge instead")
+    ap.add_argument("-o", "--out", default="merged_trace.json")
+    args = ap.parse_args(argv)
+
+    paths = list(args.dumps)
+    if args.bundles:
+        paths += sorted(glob.glob(os.path.join(args.bundles, "bundle-*.json")))
+    if not paths:
+        print("nothing to merge (pass dumps or --bundles)", file=sys.stderr)
+        return 2
+
+    from horovod_tpu.trace.export import merge_ranks
+
+    merged = merge_ranks([_load(p) for p in paths])
+    with open(args.out, "w") as f:
+        json.dump(merged, f)
+    md = merged["metadata"]
+    print(f"[trace_collect] {len(paths)} dump(s) -> {args.out}: "
+          f"ranks={md['ranks']} events={len(merged['traceEvents'])} "
+          f"offsets_us={md['clock_offsets_us']}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
