@@ -196,13 +196,14 @@ class ServingReplica:
     def submit(self, prompt, max_new_tokens: int, *, eos_id=None,
                arrival: Optional[float] = None,
                deadline_s: Optional[float] = None,
-               trace_id: Optional[str] = None) -> int:
+               trace_id: Optional[str] = None,
+               spec_k: Optional[int] = None) -> int:
         if not self.accepting:
             raise RuntimeError(
                 f"replica {self.name} is {self.state}, not accepting")
         return self.engine.submit(prompt, max_new_tokens, eos_id=eos_id,
                                   arrival=arrival, deadline_s=deadline_s,
-                                  trace_id=trace_id)
+                                  trace_id=trace_id, spec_k=spec_k)
 
     def step(self) -> bool:
         """One engine step; progress timestamps feed the heartbeat and

@@ -78,6 +78,10 @@ class _Placement:
     #: trace context born at submit — a re-route must carry it so the
     #: survivor's spans still correlate with the fleet.route event
     trace_id: Optional[str] = None
+    #: per-request speculative lookahead knob — re-routes carry it so a
+    #: survivor decodes the request under the same k (greedy outputs
+    #: are k-independent; the knob moves throughput/latency only)
+    spec_k: Optional[int] = None
     rerouted: bool = False
 
 _ROUTE_AFFINITY = _instr.FLEET_ROUTED.labels("affinity")
@@ -243,13 +247,16 @@ class FleetRouter:
 
     def submit(self, prompt, max_new_tokens: int, *, eos_id=None,
                arrival: Optional[float] = None,
-               deadline_s: Optional[float] = None) -> int:
+               deadline_s: Optional[float] = None,
+               spec_k: Optional[int] = None) -> int:
         """Place one request; returns a router-global id (key into
         :attr:`results`).  A replica whose ``submit`` raises books an
         error (SUSPECT + ejection at ``HVD_TPU_FLEET_REPLICA_ERRORS``
         consecutive) and THIS request retries on the next-best
         survivor — a raising replica can no longer keep winning
-        affinity for its cached templates."""
+        affinity for its cached templates.  ``spec_k`` is the
+        per-request speculative-lookahead knob, forwarded to whichever
+        replica wins placement (and to any later re-route)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         remaining = None
         if deadline_s and deadline_s > 0:
@@ -268,7 +275,7 @@ class FleetRouter:
             try:
                 rid = r.submit(prompt, max_new_tokens, eos_id=eos_id,
                                arrival=arrival, deadline_s=deadline_s,
-                               trace_id=tid)
+                               trace_id=tid, spec_k=spec_k)
                 r.note_ok()
             except ValueError:
                 # client-input validation (over-long prompt, zero
@@ -289,7 +296,8 @@ class FleetRouter:
             self._placed[gid] = _Placement(
                 replica=r, rid=rid, prompt=prompt,
                 max_new_tokens=int(max_new_tokens), eos_id=eos_id,
-                arrival=arrival, deadline_s=deadline_s, trace_id=tid)
+                arrival=arrival, deadline_s=deadline_s, trace_id=tid,
+                spec_k=spec_k)
             _trace.event("fleet.route", gid=gid, rid=rid,
                          replica=r.name, mode=self.mode, trace=tid)
             return gid
@@ -372,7 +380,7 @@ class FleetRouter:
                             p.prompt, p.max_new_tokens,
                             eos_id=p.eos_id, arrival=p.arrival,
                             deadline_s=p.deadline_s,
-                            trace_id=p.trace_id)
+                            trace_id=p.trace_id, spec_k=p.spec_k)
                         tgt.note_ok()
                         placed = (tgt, nrid)
                         break

@@ -321,6 +321,38 @@ SERVE_KV_BLOCKS_PER_SHARD = gauge(
     "KV blocks resident on each shard of the tensor-sharded pool",
 )
 
+#: Tokens proposed by the speculative drafter and fed to verify steps
+#: (docs/SERVING.md speculative section).
+SERVE_SPEC_DRAFTED = counter(
+    "hvd_tpu_serve_spec_drafted_tokens_total",
+    "Draft tokens fed to speculative verify steps",
+)
+
+#: Drafted tokens the greedy verifier accepted; accepted/drafted is the
+#: fleet-wide acceptance rate (each verify step also emits one
+#: non-drafted bonus token, so tokens/step = 1 + accepted/steps).
+SERVE_SPEC_ACCEPTED = counter(
+    "hvd_tpu_serve_spec_accepted_tokens_total",
+    "Draft tokens accepted by greedy verification",
+)
+
+#: Drafted tokens rejected by verification — their speculative KV tail
+#: is rolled back (block-aligned truncation; docs/SERVING.md).
+SERVE_SPEC_ROLLED_BACK = counter(
+    "hvd_tpu_serve_spec_rolled_back_tokens_total",
+    "Draft tokens rejected and rolled back from the paged KV tail",
+)
+
+#: Per-request draft acceptance rate (accepted/drafted over the
+#: request's lifetime), observed at completion for requests that ran
+#: at least one verify step — the distribution behind the when-does-
+#: speculation-pay threshold (docs/SERVING.md).
+SERVE_SPEC_ACCEPT_RATE = histogram(
+    "hvd_tpu_serve_spec_accept_rate",
+    "Per-request speculative-draft acceptance rate at completion",
+    buckets=(0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 1.0),
+)
+
 # -- fleet autoscaling + routing (fleet/ — docs/FLEET.md) --------------------
 
 #: Capacity the policy engine last decided the fleet should converge

@@ -10,8 +10,11 @@ in beside the decode batch), and tensor-sharded multi-chip serving
 head-sharded, Megatron FFN, per-chip decode reads cut by the shard
 factor — ``ServingEngine(mesh=...)`` / ``HVD_TPU_SERVE_SHARDS``),
 with decode/chunk attention driven through the repo's own flash
-kernels' ``kv_offset``/block-skip machinery — see docs/SERVING.md for
-the policy, tuning and exactness contract.
+kernels' ``kv_offset``/block-skip machinery, and speculative decoding
+on that same chunk machinery (multi-token decode steps: a prompt-lookup
+drafter proposes k tokens, one chunk row verifies them exactly —
+``HVD_TPU_SERVE_SPEC``) — see docs/SERVING.md for the policy, tuning
+and exactness contract.
 
 Not imported by ``import horovod_tpu`` (training jobs shouldn't pay the
 model-stack import); use ``from horovod_tpu import serving``.
@@ -26,16 +29,28 @@ from .kv_cache import (
     pool_bytes,
 )
 from .scheduler import ContinuousBatchingScheduler, Sequence
+from .speculative import (
+    Drafter,
+    ModelDrafter,
+    PromptLookupDrafter,
+    accept_greedy,
+    make_drafter,
+)
 
 __all__ = [
     "BlockAllocator",
     "ContinuousBatchingScheduler",
+    "Drafter",
+    "ModelDrafter",
     "PagedKVState",
+    "PromptLookupDrafter",
     "Request",
     "Sequence",
     "ServeConfig",
     "ServingEngine",
+    "accept_greedy",
     "blocks_for",
+    "make_drafter",
     "modeled_decode_read_bytes",
     "pool_bytes",
 ]

@@ -20,7 +20,9 @@ Ties the pieces together (docs/SERVING.md):
   max-context page tier instead of ``max_blocks``.  Every step's
   shapes pad up to a tier from a small static menu, so a lifetime of
   arbitrary request shapes compiles a BOUNDED set of programs —
-  ``|decode_tiers| × (|chunk_tiers| + |page_tiers|)`` — (the same
+  ``|decode_tiers| × (|chunk_tiers| + |page_tiers| +
+  spec·|page_tiers|)``, the last term the speculative verify programs
+  at ONE static chunk width (the k axis; docs/SERVING.md) — (the same
   executable-cache discipline as the ops engine's ``max_signatures``;
   hits/misses are mirrored into the PR-1
   ``hvd_tpu_executable_cache_total`` counters so the bound is
@@ -66,6 +68,7 @@ from .kv_cache import (
     BlockAllocator, PagedKVState, blocks_for, make_pools, pool_bytes,
 )
 from .scheduler import ContinuousBatchingScheduler, Request, Sequence
+from .speculative import Drafter, accept_greedy, make_drafter
 
 _CACHE_HIT = _instr.EXEC_CACHE.labels("hit")
 _CACHE_MISS = _instr.EXEC_CACHE.labels("miss")
@@ -73,6 +76,7 @@ _LAT_FIRST = _instr.SERVE_TOKEN_LATENCY.labels("first")
 _LAT_INTER = _instr.SERVE_TOKEN_LATENCY.labels("inter")
 _STEP_MIXED = _instr.SERVE_STEPS.labels("mixed")
 _STEP_DECODE = _instr.SERVE_STEPS.labels("decode")
+_STEP_SPEC = _instr.SERVE_STEPS.labels("spec")
 _REQ_SUBMITTED = _instr.SERVE_REQUESTS.labels("submitted")
 _REQ_COMPLETED = _instr.SERVE_REQUESTS.labels("completed")
 
@@ -170,6 +174,18 @@ class ServeConfig:
     #: num_kv_heads/num_heads/d_model*mlp_ratio — docs/SERVING.md).
     #: 1 = single-device; ignored when an explicit mesh is passed.
     shards: int = 1
+    #: speculative decoding (docs/SERVING.md speculative section):
+    #: draft up to ``spec_k`` tokens per decode step with
+    #: ``spec_drafter`` and verify them in ONE chunk-mode step — greedy
+    #: outputs stay BIT-IDENTICAL to plain decode (verification is
+    #: exact); acceptance rate moves throughput only.  k is a static
+    #: menu axis: pure-speculative steps always pad to one chunk width
+    #: (the next power of two >= spec_k + 1), so the compiled-program
+    #: set stays bounded.  Per-request ``submit(spec_k=...)`` clamps
+    #: below the engine's spec_k (0 = off for that request).
+    spec: bool = False
+    spec_k: int = 4
+    spec_drafter: str = "prompt_lookup"
 
     @classmethod
     def from_env(cls, **overrides) -> "ServeConfig":
@@ -204,6 +220,14 @@ class ServeConfig:
                                              base.deadline_s)
         if "shards" not in overrides:
             fields["shards"] = env_int("HVD_TPU_SERVE_SHARDS", base.shards)
+        if "spec" not in overrides:
+            fields["spec"] = bool(env_int("HVD_TPU_SERVE_SPEC",
+                                          int(base.spec)))
+        if "spec_k" not in overrides:
+            fields["spec_k"] = env_int("HVD_TPU_SERVE_SPEC_K", base.spec_k)
+        if "spec_drafter" not in overrides:
+            fields["spec_drafter"] = os.environ.get(
+                "HVD_TPU_SERVE_SPEC_DRAFTER", base.spec_drafter)
         return cls(**fields)
 
 
@@ -239,6 +263,7 @@ class ServingEngine:
     def __init__(self, cfg: TransformerConfig, params, *,
                  serve: Optional[ServeConfig] = None,
                  mesh: Optional[Mesh] = None,
+                 drafter: Optional[Drafter] = None,
                  clock=time.perf_counter):
         if cfg.attention_impl not in ("dot", "flash") or not cfg.causal:
             raise ValueError(
@@ -326,6 +351,29 @@ class ServingEngine:
             self.page_tiers = _pow2_tiers(1, self.max_blocks_per_seq)
         else:
             self.page_tiers = (self.max_blocks_per_seq,)
+        # -- speculative decoding (docs/SERVING.md): a drafter makes
+        # decode steps multi-token — k drafted tokens verify as ONE
+        # chunk row of width k+1 at the sequence tail.  k is a static
+        # menu axis: every pure-speculative step pads its q width to
+        # spec_w (next pow2 >= spec_k + 1), adding |page_tiers| mixed
+        # programs per batch tier to the warmup menu, nothing more.
+        self._drafter: Optional[Drafter] = drafter
+        if self._drafter is None and serve.spec:
+            self._drafter = make_drafter(serve.spec_drafter)
+        self.spec_w = 0
+        if self._drafter is not None:
+            if serve.spec_k < 1:
+                raise ValueError(
+                    f"spec_k must be >= 1 with speculation on, got "
+                    f"{serve.spec_k}")
+            self.spec_w = 1 << int(serve.spec_k).bit_length()  # >= k+1
+        #: lifetime speculative counters (bench leg columns; the
+        #: registry counters carry the production series)
+        self.spec_drafted_tokens = 0
+        self.spec_accepted_tokens = 0
+        self.spec_rolled_back_tokens = 0
+        self.spec_steps = 0
+        self.spec_verified_rows = 0
         self.k_pool, self.v_pool = make_pools(
             cfg.num_layers, num_blocks, bs, kv_heads, cfg.head_dim,
             cfg.dtype)
@@ -387,7 +435,8 @@ class ServingEngine:
         #: (modeled, == the lowered inventory; 0 unsharded)
         self.shard_psum_bytes = 0
         if self.mesh is None:
-            self._mixed_fn = jax.jit(self._mixed_step)
+            self._mixed_fn = jax.jit(self._mixed_step,
+                                     static_argnames=("pages",))
             self._decode_fn = jax.jit(self._decode_step,
                                       static_argnames=("pages",))
         else:
@@ -402,10 +451,18 @@ class ServingEngine:
             pspecs = self._pspecs
             pool = P(None, None, None, self.shard_axis, None)
             rep = P()
-            self._mixed_fn = jax.jit(jax.shard_map(
-                self._mixed_step, mesh=self.mesh,
-                in_specs=(pspecs, pool, pool, rep, rep, rep, rep),
-                out_specs=(rep, pool, pool), check_vma=False))
+
+            def _mixed_sharded(params, k, v, tables, lens, chunk_lens,
+                               tokens, pages):
+                return jax.shard_map(
+                    functools.partial(self._mixed_step, pages=pages),
+                    mesh=self.mesh,
+                    in_specs=(pspecs, pool, pool, rep, rep, rep, rep),
+                    out_specs=(rep, pool, pool), check_vma=False,
+                )(params, k, v, tables, lens, chunk_lens, tokens)
+
+            self._mixed_fn = jax.jit(_mixed_sharded,
+                                     static_argnames=("pages",))
 
             def _decode_sharded(params, k, v, tables, lens, last, pages):
                 return jax.shard_map(
@@ -446,24 +503,31 @@ class ServingEngine:
 
     # -- the two tiered program families ------------------------------------
 
-    def _mixed_step(self, params, k, v, tables, lens, chunk_lens, tokens):
+    def _mixed_step(self, params, k, v, tables, lens, chunk_lens, tokens,
+                    pages=None):
         """One mixed chunked-prefill + decode step: row i writes and
         attends ``chunk_lens[i]`` new tokens at global offset
         ``lens[i]`` — decode rows are chunks of length 1, prefill
-        chunks of any tail fill the rest of the batch.  Emits each
-        row's next token from its LAST valid position (meaningful for
-        decode rows and for chunks that complete their prompt; the
-        host discards the rest)."""
-        b, c = tokens.shape
+        chunks of any tail fill the rest of the batch, and a
+        SPECULATIVE verification row is a chunk of length k+1 at the
+        sequence tail (no new kernel — docs/SERVING.md).  Emits the
+        greedy token at EVERY position, (B, C): position j of a row is
+        the argmax after its tokens[:j+1] — a decode row reads column
+        0, a completing prefill chunk its last valid column, a
+        verification row all k+1 columns (the accept/reject inputs).
+        ``pages`` (static) bounds the unwindowed gather copy like the
+        decode step's page tier; None = the ``max_blocks``-wide copy
+        (the prefill-mixed default, whose offsets span the whole
+        table)."""
         state = PagedKVState(k=k, v=v, tables=tables, lens=lens,
-                             mode="chunk", chunk_lens=chunk_lens)
+                             mode="chunk", chunk_lens=chunk_lens,
+                             gather_pages=pages)
+        c = tokens.shape[1]
         positions = lens[:, None] + jnp.arange(c, dtype=jnp.int32)[None]
         logits, state = self._model.apply(
             {"params": params}, tokens, positions=positions, train=False,
             paged=state)
-        last = jnp.clip(chunk_lens - 1, 0, c - 1)
-        next_tok = jnp.argmax(
-            logits[jnp.arange(b), last].astype(jnp.float32), axis=-1)
+        next_tok = jnp.argmax(logits.astype(jnp.float32), axis=-1)
         return next_tok.astype(jnp.int32), state.k, state.v
 
     def _decode_step(self, params, k, v, tables, lens, last_tok, pages):
@@ -511,14 +575,16 @@ class ServingEngine:
 
     def warmup(self) -> int:
         """Compile the WHOLE tier menu up front — every (batch tier,
-        chunk tier) mixed program and every (batch tier, page tier)
-        decode program: ``|decode_tiers| × (|chunk_tiers| +
-        |page_tiers|)``.  The menu is what makes this possible (and
-        cheap to reason about): the compiled set is bounded by the tier
-        product, so a production engine pre-warms it and serves its
-        lifetime without a single mid-traffic XLA compile (a straggler
-        compile is a multi-second p99 spike — measured in
-        tools/serve_bench.py).
+        chunk tier) mixed program, every (batch tier, page tier) decode
+        program, and (speculation on) every (batch tier, spec width,
+        page tier) verification program: ``|decode_tiers| ×
+        (|chunk_tiers| + |page_tiers| + spec·|page_tiers|)``.  The menu
+        is what makes this possible (and cheap to reason about): the
+        compiled set is bounded by the tier product — k rides as ONE
+        static chunk width (``spec_w``), never a per-draft-length axis
+        — so a production engine pre-warms it and serves its lifetime
+        without a single mid-traffic XLA compile (a straggler compile
+        is a multi-second p99 spike — measured in tools/serve_bench.py).
 
         Side-effect-free by construction: the dummy steps run with
         all-zero block tables, so every write lands in the trash block
@@ -530,16 +596,24 @@ class ServingEngine:
             tb = jnp.broadcast_to(tables, (bt, self.max_blocks_per_seq))
             lens = jnp.ones((bt,), jnp.int32)
             for c in self.chunk_tiers:
-                self._book_program("mixed", bt, c)
+                self._book_program("mixed", bt, c, None)
                 self._mixed_fn(self.params, self.k_pool, self.v_pool,
                                tb, jnp.zeros((bt,), jnp.int32),
                                jnp.ones((bt,), jnp.int32),
-                               jnp.zeros((bt, c), jnp.int32))
+                               jnp.zeros((bt, c), jnp.int32), pages=None)
             for pt in self.page_tiers:
                 self._book_program("decode", bt, pt)
                 self._decode_fn(self.params, self.k_pool, self.v_pool,
                                 tb, lens, jnp.zeros((bt,), jnp.int32),
                                 pages=pt)
+            if self._drafter is not None:
+                for pt in self.page_tiers:
+                    self._book_program("mixed", bt, self.spec_w, pt)
+                    self._mixed_fn(self.params, self.k_pool, self.v_pool,
+                                   tb, jnp.zeros((bt,), jnp.int32),
+                                   jnp.ones((bt,), jnp.int32),
+                                   jnp.zeros((bt, self.spec_w), jnp.int32),
+                                   pages=pt)
         return len(self._progs) - before
 
     # -- request intake ------------------------------------------------------
@@ -566,17 +640,23 @@ class ServingEngine:
     def submit(self, prompt, max_new_tokens: int, *, eos_id=None,
                arrival: Optional[float] = None,
                deadline_s: Optional[float] = None,
-               trace_id: Optional[str] = None) -> int:
+               trace_id: Optional[str] = None,
+               spec_k: Optional[int] = None) -> int:
         """Enqueue one request; returns its id (key into ``results``).
         ``deadline_s`` overrides the engine's default latency budget
         (``ServeConfig.deadline_s``); past it the request is shed or
         cancelled and ``results`` carries whatever was generated.
         ``trace_id`` is the caller's trace context (the fleet router
         propagates its id here so the request's spans correlate across
-        router, engine and scheduler — docs/TRACING.md)."""
+        router, engine and scheduler — docs/TRACING.md).  ``spec_k``
+        overrides the engine's speculative lookahead for THIS request
+        (clamped to the engine's ``spec_k`` — the menu axis; 0 turns
+        speculation off for the request; None inherits)."""
         if not self.accepting:
             raise RuntimeError(
                 "engine is draining (accepting=False); submit rejected")
+        if spec_k is not None and spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {spec_k}")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         self._validate_request(len(prompt), max_new_tokens)
         if deadline_s is None:
@@ -587,7 +667,7 @@ class ServingEngine:
             arrival=self._clock() if arrival is None else arrival,
             deadline_s=deadline_s if deadline_s and deadline_s > 0
             else None,
-            trace_id=trace_id)
+            trace_id=trace_id, spec_k=spec_k)
         self._next_id += 1
         self._ids_seen.add(req.id)
         if req.deadline_s:
@@ -730,7 +810,9 @@ class ServingEngine:
         plus ``chunk_sel`` ([(seq, chunk_len)]) — the single program
         both the engine loop and the static baseline assemble through
         (the A/B must execute identical step programs).  Row order:
-        decode rows first, chunk rows after."""
+        decode rows first, chunk rows after.  Returns the (batch tier,
+        width) per-position argmax grid: a decode row's token is column
+        0, a chunk's first token column ``chunk_len - 1``."""
         n = len(decode_rows) + len(chunk_sel)
         bt = self._batch_tier(n)
         width = _tier_for(
@@ -755,13 +837,13 @@ class ServingEngine:
             tokens = jnp.stack([jnp.asarray(r) for r in rows])
         tables, lens = self._tables_lens(
             decode_rows + [s for s, _ in chunk_sel], bt, lens_list)
-        self._book_program("mixed", bt, width)
+        self._book_program("mixed", bt, width, None)
         self._book_psum_bytes(bt, width)
         tracing = trace.enabled()  # arg/list packing off the hot path
         t0 = trace.now() if tracing else 0.0
         next_tok, self.k_pool, self.v_pool = self._mixed_fn(
             self.params, self.k_pool, self.v_pool, tables, lens,
-            jnp.asarray(chunk_lens), tokens)
+            jnp.asarray(chunk_lens), tokens, pages=None)
         out = np.asarray(next_tok)  # device sync: the step's true extent
         if tracing:
             t1 = trace.now()
@@ -813,6 +895,112 @@ class ServingEngine:
         _STEP_DECODE.inc()
         return out, self._clock()
 
+    # -- speculative decode (docs/SERVING.md) --------------------------------
+
+    def _propose_draft(self, s: Sequence) -> None:
+        """Ask the drafter for this sequence's next-step lookahead.
+        The per-request ``spec_k`` clamps BELOW the engine's (the menu
+        width ``spec_w`` is sized for ``serve_cfg.spec_k``; a larger
+        request knob would widen the program key), and the draft is
+        capped so the verify step can never write past ``max_seq_len``
+        or draft tokens the generation budget would discard anyway.
+        An empty draft means the row decodes plain — drafting is
+        always best-effort."""
+        k = s.req.spec_k if s.req.spec_k is not None \
+            else self.serve_cfg.spec_k
+        remaining = s.req.max_new_tokens - (
+            len(s.generated) + (len(s.context) - len(s.req.prompt)))
+        k = min(int(k), self.serve_cfg.spec_k,
+                self.cfg.max_seq_len - s.length - 1, remaining - 1)
+        if k < 1:
+            s.draft = []
+            return
+        stream = s.context if not s.generated else np.concatenate(
+            [s.context, np.asarray(s.generated, np.int32)])
+        s.draft = [int(t) for t in self._drafter.draft(stream, k)][:k]
+
+    def _run_spec_step(self, rows: List[Sequence]):
+        """One pure-speculative mixed step over the decode batch: row i
+        feeds ``[last token] + draft`` as a chunk of length
+        ``1 + len(draft)`` at its tail offset (``lens = length - 1``,
+        exactly like plain decode), padded to the STATIC width
+        ``spec_w`` — draft length varies per row and per step, the
+        program key never does.  Draft-free rows ride as chunks of
+        length 1.  The gather copy is page-tiered like the decode
+        step's, over the batch's live context plus its speculative
+        tail."""
+        bt = self._batch_tier(len(rows))
+        width = self.spec_w
+        tokens_host = np.zeros((bt, width), np.int32)
+        chunk_lens = np.zeros((bt,), np.int32)
+        lens_list = []
+        for i, s in enumerate(rows):
+            fed = [s.generated[-1]] + s.draft
+            tokens_host[i, :len(fed)] = fed
+            chunk_lens[i] = len(fed)
+            lens_list.append(s.length - 1)
+        pages = self.max_blocks_per_seq
+        if self.cfg.window is None:
+            need = max(blocks_for(s.length + len(s.draft),
+                                  self.serve_cfg.block_size) for s in rows)
+            pages = _tier_for(self.page_tiers, need)
+        tables, lens = self._tables_lens(rows, bt, lens_list)
+        self._book_program("mixed", bt, width, pages)
+        self._book_psum_bytes(bt, width)
+        tracing = trace.enabled()
+        t0 = trace.now() if tracing else 0.0
+        next_tok, self.k_pool, self.v_pool = self._mixed_fn(
+            self.params, self.k_pool, self.v_pool, tables, lens,
+            jnp.asarray(chunk_lens), jnp.asarray(tokens_host), pages=pages)
+        out = np.asarray(next_tok)  # device sync: the step's true extent
+        if tracing:
+            t1 = trace.now()
+            self._last_step = ("spec", t0, t1)
+            trace.add_span("serve.step", t0, t1, kind="spec",
+                           batch=len(rows),
+                           drafted=int(sum(len(s.draft) for s in rows)),
+                           rids=[s.req.id for s in rows])
+        _STEP_SPEC.inc()
+        self.spec_steps += 1
+        return out, self._clock()
+
+    def _settle_spec(self, s: Sequence, row_argmax, now: float) -> List[int]:
+        """Greedy accept/reject one verification row, then roll the
+        speculative KV tail back: the sequence keeps the blocks its
+        post-acceptance length occupies and :meth:`truncate_tail`
+        releases the rest through the normal refcount path (a shared or
+        prefix-registered tail block survives under its other refs —
+        never a double free).  Positions past the accept point inside
+        the SURVIVING tail block hold rejected-draft K/V; they are
+        garbage the causal mask never attends (``lens`` = true length)
+        and the next step overwrites.  Returns the emitted tokens —
+        bit-identical to what plain greedy decode would emit, by the
+        acceptance rule (speculative.accept_greedy)."""
+        k = len(s.draft)
+        emitted, m = accept_greedy(s.draft, row_argmax[:k + 1])
+        rolled = k - m
+        s.spec_drafted += k
+        s.spec_accepted += m
+        self.spec_drafted_tokens += k
+        self.spec_accepted_tokens += m
+        self.spec_rolled_back_tokens += rolled
+        self.spec_verified_rows += 1
+        _instr.SERVE_SPEC_DRAFTED.inc(k)
+        _instr.SERVE_SPEC_ACCEPTED.inc(m)
+        if rolled:
+            _instr.SERVE_SPEC_ROLLED_BACK.inc(rolled)
+        new_len = s.length + len(emitted)
+        s.blocks = self.allocator.truncate_tail(s.blocks, new_len)
+        s.draft = []
+        if trace.enabled() and self._last_step is not None:
+            t0, t1 = self._last_step[1], self._last_step[2]
+            trace.add_span("serve.spec_verify", t0, t1, rid=s.req.id,
+                           drafted=k, accepted=m, trace=s.req.trace_id)
+            if rolled:
+                trace.event("serve.spec_rollback", rid=s.req.id,
+                            tokens=rolled, trace=s.req.trace_id)
+        return emitted
+
     # -- token emission ------------------------------------------------------
 
     def _observe_token(self, seq: Sequence, token: int, now: float) -> None:
@@ -829,11 +1017,12 @@ class ServingEngine:
                         ttft=now - seq.req.arrival,
                         trace=seq.req.trace_id)
             if self._last_step is not None and \
-                    self._last_step[0] == "decode":
+                    self._last_step[0] in ("decode", "spec"):
                 # the decode step that produced the first token — the
                 # last term of the TTFT decomposition (a first token
                 # emitted by the final prefill chunk is already covered
-                # by that chunk's span)
+                # by that chunk's span; a speculative step counts — it
+                # IS the decode step, verifying k+1 positions)
                 trace.add_span("serve.first_decode", self._last_step[1],
                                self._last_step[2], rid=seq.req.id,
                                trace=seq.req.trace_id)
@@ -849,6 +1038,9 @@ class ServingEngine:
             trace.event("serve.finish", rid=seq.req.id,
                         tokens=len(seq.generated),
                         trace=seq.req.trace_id)
+            if seq.spec_drafted:
+                _instr.SERVE_SPEC_ACCEPT_RATE.observe(
+                    seq.spec_accepted / seq.spec_drafted)
             self.scheduler.finish(seq)
             # the emitted stream: tokens folded into context by evictions
             # plus those generated since (an EOS always completes the
@@ -908,11 +1100,13 @@ class ServingEngine:
     # -- the scheduler loop --------------------------------------------------
 
     def step(self) -> bool:
-        """One iteration: drain staging, admit (prefix-matching), grow,
-        then run ONE program — a MIXED step whenever prefill work is
-        pending (chunks packed alongside the running decode batch, so a
-        streaming prompt never stalls decodes), a decode step
-        otherwise.  Returns False when there is nothing left to do."""
+        """One iteration: drain staging, admit (prefix-matching), draft
+        (speculation on, decode-only batches), grow, then run ONE
+        program — a MIXED step whenever prefill work is pending (chunks
+        packed alongside the running decode batch, so a streaming
+        prompt never stalls decodes), a SPECULATIVE verify step when
+        any draft is pending, a decode step otherwise.  Returns False
+        when there is nothing left to do."""
         idle = not self.scheduler.running and not self.scheduler.pending
         self._drain_staging(block=idle and not self._source_done)
         if self._any_deadline:
@@ -925,6 +1119,18 @@ class ServingEngine:
             self._finalize_shed()
         else:
             self.scheduler.admit()
+        if self._drafter is not None and all(
+                s.in_decode for s in self.scheduler.running):
+            # drafts propose BEFORE growth (grow_running books the
+            # speculative tail's blocks, shedding the draft first under
+            # pool pressure) and only for pure-decode batches: a mixed
+            # step's chunk width is the prefill tier axis, and riding
+            # drafts through it would cross the k axis into the chunk
+            # menu — a program-set product the bounded menu exists to
+            # avoid.  Prefill phases are short; decode is where the
+            # steps (and the HBM bytes) are.
+            for s in self.scheduler.running:
+                self._propose_draft(s)
         self.scheduler.grow_running()
         running = list(self.scheduler.running)
         decode_rows = [s for s in running if s.in_decode]
@@ -956,13 +1162,31 @@ class ServingEngine:
                 if s.blocks:
                     self.scheduler.publish_full_blocks(s)
             for i, s in enumerate(decode_rows):
-                self._emit(s, toks[i], now)
+                self._emit(s, toks[i, 0], now)
             base = len(decode_rows)
-            for j, (s, _c) in enumerate(sel):
+            for j, (s, c) in enumerate(sel):
                 if s.in_decode:  # prompt complete -> its first token
-                    self._emit(s, toks[base + j], now)
+                    self._emit(s, toks[base + j, c - 1], now)
             return True
         if decode_rows:
+            if any(s.draft for s in decode_rows):
+                out, now = self._run_spec_step(decode_rows)
+                # settle (accept + rollback) BEFORE publication: the
+                # published-block count is computed from tokens already
+                # in cache (which lags the step — see tokens_in_cache),
+                # so it can never reach into the truncated tail, and
+                # publication must never index rejected-draft blocks
+                emitted = [self._settle_spec(s, out[i], now) if s.draft
+                           else [int(out[i, 0])]
+                           for i, s in enumerate(decode_rows)]
+                for s in decode_rows:
+                    self.scheduler.publish_full_blocks(s)
+                for s, toks in zip(decode_rows, emitted):
+                    for t in toks:
+                        if s.done:  # eos/budget inside an accepted run:
+                            break   # the tail tokens were never real
+                        self._emit(s, t, now)
+                return True
             toks, now = self._decode_once(decode_rows)
             for s in decode_rows:
                 self.scheduler.publish_full_blocks(s)
@@ -1021,7 +1245,7 @@ class ServingEngine:
                 for j, (s, c) in enumerate(sel):
                     s.prefilled += c
                     if s.in_decode:
-                        self._static_emit(s, toks[j], now, results)
+                        self._static_emit(s, toks[j, c - 1], now, results)
             while not all(s.done for s in seqs):
                 toks, now = self._decode_once(seqs)
                 for i, s in enumerate(seqs):

@@ -193,6 +193,29 @@ class BlockAllocator:
                         self._drop_cache_entry(b)
                     self._free.append(b)
 
+    def truncate_tail(self, blocks: List[int], keep_tokens: int
+                      ) -> List[int]:
+        """Trim a sequence's block table down to the blocks its first
+        ``keep_tokens`` tokens occupy, releasing the tail references —
+        the speculative-decode rollback primitive (docs/SERVING.md).
+
+        Block-aligned by construction: a partially-filled surviving
+        block stays mapped (its stale positions ≥ ``keep_tokens`` are
+        masked by ``lens`` and overwritten before they are ever
+        attended).  Tail blocks go through :meth:`free`, so the
+        refcount/CoW rules hold unchanged — a shared or
+        prefix-registered tail block loses this sequence's one
+        reference and survives under any live ref (or parks on the
+        LRU), never a double-free; a block id of 0 in the tail (the
+        trash block) raises like any other out-of-range free.  Returns
+        the surviving prefix of ``blocks`` (a new list)."""
+        keep = blocks_for(keep_tokens, self.block_size) if keep_tokens > 0 \
+            else 0
+        if keep >= len(blocks):
+            return list(blocks)
+        self.free(blocks[keep:])
+        return list(blocks[:keep])
+
     # -- the prefix cache ----------------------------------------------------
 
     def register(self, block: int, parent_hash: int,

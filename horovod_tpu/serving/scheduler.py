@@ -85,6 +85,10 @@ class Request:
     #: scheduler): rides every span this request touches so one id
     #: follows it across components (docs/TRACING.md)
     trace_id: Optional[str] = None
+    #: per-request speculative lookahead override: None = the engine's
+    #: configured ``spec_k``, 0 = speculation off for this request, k>0
+    #: = draft up to k tokens per decode step (docs/SERVING.md)
+    spec_k: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -114,6 +118,15 @@ class Sequence:
     block_hashes: List[int] = dataclasses.field(default_factory=list)
     #: how many of ``blocks`` are published in the prefix index
     published: int = 0
+    #: pending speculative draft for the NEXT decode step (proposed by
+    #: the engine's drafter; empty = plain one-token decode).  Never
+    #: part of ``generated`` — draft tokens only join the stream after
+    #: greedy verification accepts them.
+    draft: List[int] = dataclasses.field(default_factory=list)
+    #: lifetime speculative counters (per-request accept-rate
+    #: histogram at finish; bench columns)
+    spec_drafted: int = 0
+    spec_accepted: int = 0
 
     @property
     def length(self) -> int:
@@ -129,8 +142,17 @@ class Sequence:
     def tokens_in_cache(self) -> int:
         """Tokens whose K/V are physically written (full blocks up to
         here are immutable and publishable): during prefill that is
-        ``prefilled``; during decode the newest generated token's K/V
-        lands only on the NEXT step, so it is ``length - 1``."""
+        ``prefilled``; during decode it is ``length - 1`` — the
+        *newest* generated token's K/V lands only on the NEXT step.
+        This lags-one invariant survives speculative decode unchanged,
+        for any number of tokens accepted per step: a verify step
+        feeds [last token, k drafts] and writes their K/V at positions
+        ``length-1 .. length-1+k``, but the LAST emitted token is
+        always the verifier's own bonus/correction token, whose K/V
+        the step never fed — it is written by the next step, exactly
+        like plain decode's newest token (positions beyond the accept
+        point hold rejected-draft garbage, masked by ``lens`` and
+        trimmed by rollback before they could ever publish)."""
         if not self.in_decode:
             return self.prefilled
         return len(self.context) + max(len(self.generated) - 1, 0)
@@ -237,6 +259,7 @@ class ContinuousBatchingScheduler:
         victim.cached_len = 0
         victim.published = 0
         victim.staged = None  # host re-pads/re-stages at re-admission
+        victim.draft = []  # re-drafted (identically) after re-prefill
         self.pending.appendleft(victim)
         self.evictions += 1
         _instr.SERVE_EVICTIONS.inc()
@@ -301,20 +324,30 @@ class ContinuousBatchingScheduler:
 
     def grow_running(self) -> None:
         """Before a decode step: every running sequence is about to gain
-        one token; allocate tail blocks, evicting LIFO when the pool is
-        dry.  A sequence evicted here simply re-queues — the decode step
-        then runs over whoever is left."""
+        at least one token — plus up to ``len(seq.draft)`` more when a
+        speculative draft is pending (the verify step writes draft K/V
+        at positions ``length-1 .. length-1+k`` and may emit k+1
+        tokens).  Allocate tail blocks, evicting LIFO when the pool is
+        dry — but speculation is strictly best-effort: a sequence whose
+        *draft* is what needs the extra blocks drops the draft (that
+        step decodes one token, plain) before anyone is evicted, so
+        speculative lookahead can never cause an eviction that plain
+        decode wouldn't have."""
         for seq in list(self.running):
             if seq not in self.running:
                 continue  # evicted by an earlier iteration
             while True:
-                need = blocks_for(seq.length + 1, self.allocator.block_size)
+                need = blocks_for(seq.length + 1 + len(seq.draft),
+                                  self.allocator.block_size)
                 if need <= len(seq.blocks):
                     break
                 got = self.allocator.alloc(need - len(seq.blocks))
                 if got is not None:
                     seq.blocks.extend(got)
                     break
+                if seq.draft:
+                    seq.draft = []  # shed the speculation, not a peer
+                    continue
                 if not self._evict_one() or seq not in self.running:
                     break
         self._book()

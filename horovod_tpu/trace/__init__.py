@@ -70,6 +70,8 @@ SITES = (
     "serve.first_decode",  # the decode step that emitted a first token
     "serve.first_token",   # first-token emission (instant; TTFT arg)
     "serve.finish",        # request completion (instant)
+    "serve.spec_verify",   # one request's speculative verify row scored
+    "serve.spec_rollback", # rejected-draft KV tail trimmed (instant)
     "fleet.route",         # router placement decision (instant)
     "fleet.scale",         # autoscaler applied a scale decision (instant)
     "fleet.preempt",       # preemption notice handled (instant)

@@ -22,6 +22,19 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Persistent XLA compilation cache: the suite builds hundreds of
+# fresh ServingEngine / mesh instances whose programs lower to
+# byte-identical HLO, and per-instance jit closures defeat jax's
+# in-memory cache — the disk cache dedupes the XLA compile step both
+# within a run and across runs on the same machine.  Semantics-free
+# (lowering, engine program counters, and StableHLO pins are all
+# upstream of the XLA compile).  Opt out: HVD_TPU_TEST_JAX_CACHE=0.
+if os.environ.get("HVD_TPU_TEST_JAX_CACHE", "1") != "0":
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR", "/tmp/hvd_tpu_xla_cache")
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

@@ -35,6 +35,18 @@ drives them through ``horovod_tpu.serving``:
                bytes and psum stream both modeled AND measured from
                the lowered StableHLO (modeled == measured or the leg
                fails).  The full run writes MULTICHIP_r06.json.
+  spec_base_* / spec_on_*
+               the round-15 speculative-decoding A/B: the same load
+               driven through a plain engine and one with the
+               prompt-lookup drafter on (fresh engines — the spec
+               menu differs), once on a TEMPLATE-HEAVY load (periodic
+               prompts, the n-gram drafter's home turf) and once on
+               ADVERSARIAL-RANDOM text (the drafter's worst case —
+               the bit-identity guarantee is the claim there).  Emits
+               ``acceptance_rate``, drafted/accepted/rolled-back
+               token counts, ``tokens_per_step`` and the verify-span
+               trace columns; byte-identical outputs asserted per
+               load before reporting.
 
 Greedy sampling everywhere, so the bench asserts token-for-token
 identical outputs across every A/B before it reports a single number
@@ -441,6 +453,110 @@ def run_multichip_leg(shards, n_requests, seed, write_json):
     return row
 
 
+def build_spec_loads(rs, n, *, motif, tiles, gen):
+    """The speculative A/B's two loads.  TEMPLATE-HEAVY: prompts are a
+    short motif tiled several times (the repetitive agent/template
+    traffic prompt-lookup drafting exists for — trailing n-grams recur,
+    so drafts come from the sequence's own history).  ADVERSARIAL-
+    RANDOM: i.i.d. uniform tokens — the drafter's worst case, and the
+    leg's claim is that outputs are STILL bit-identical (speculation
+    can waste compute, never move values; what acceptance survives
+    here comes from the generated tail, not the prompt)."""
+    motifs = [rs.randint(1, 120, size=motif).astype(np.int32)
+              for _ in range(3)]
+    template = []
+    for _ in range(n):
+        m = motifs[int(rs.randint(len(motifs)))]
+        prompt = np.tile(m, tiles)[:int(motif * tiles - rs.randint(3))]
+        template.append((prompt.astype(np.int32),
+                         int(rs.randint(gen // 2, gen + 1))))
+    random_load = [
+        (rs.randint(1, 120, size=int(rs.randint(8, motif * tiles))
+                    ).astype(np.int32),
+         int(rs.randint(gen // 2, gen + 1)))
+        for _ in range(n)]
+    return template, random_load
+
+
+def run_spec_leg(cfg, params, serve_cfg, load, leg, id_base):
+    """One speculative A/B leg on a FRESH engine (spec on adds the
+    verify-width programs to the menu, so the engines can't share a
+    warmup the way the prefix A/B does).  Arrivals are immediate
+    (interarrival 0): the A/B measures steps, not pacing."""
+    eng = ServingEngine(cfg, params, serve=serve_cfg)
+    warmed = eng.warmup()
+    trace_t0 = trace.now()
+    row, res = run_continuous(eng, load, 0.0, leg=leg, id_base=id_base)
+    row["compile_free"] = row.pop("compiled_programs") == warmed
+    row["drafted_tokens"] = eng.spec_drafted_tokens
+    row["accepted_tokens"] = eng.spec_accepted_tokens
+    row["rolled_back_tokens"] = eng.spec_rolled_back_tokens
+    row["acceptance_rate"] = round(
+        eng.spec_accepted_tokens / eng.spec_drafted_tokens, 4) \
+        if eng.spec_drafted_tokens else 0.0
+    # tokens emitted per verified row: 1 (the verifier's bonus or
+    # correction token) + the accepted run — the speculative claim in
+    # one number (1.0 exactly on the baseline legs)
+    row["tokens_per_step"] = round(
+        1.0 + eng.spec_accepted_tokens / eng.spec_verified_rows, 3) \
+        if eng.spec_verified_rows else 1.0
+    if trace.enabled():
+        spans = [r for r in trace.snapshot(since=trace_t0)
+                 if r[0] == "serve.spec_verify"]
+        rollbacks = [r for r in trace.snapshot(since=trace_t0)
+                     if r[0] == "serve.spec_rollback"]
+        row["spec_verify_spans"] = len(spans)
+        row["spec_verify_total_s"] = round(
+            sum(r[2] or 0.0 for r in spans), 4)
+        row["spec_rollback_events"] = len(rollbacks)
+    return row, res
+
+
+def run_spec_legs(args):
+    """The round-15 speculative A/B: spec off vs on, on template-heavy
+    and adversarial-random loads (build_spec_loads).  Asserts the
+    bit-identity oracle per load before reporting."""
+    if args.smoke:
+        n, gen, motif, tiles, k = 14, 48, 6, 5, 6
+    else:
+        n, gen, motif, tiles, k = 40, 80, 8, 6, 6
+    cfg = TransformerConfig(
+        vocab_size=128, num_layers=2, num_heads=4, num_kv_heads=2,
+        head_dim=16, max_seq_len=motif * tiles + gen + 16,
+        dtype=jnp.float32, attention_impl="dot", causal=True)
+    params = params_for(cfg)
+    # one decode tier and 16-token blocks keep each fresh engine's
+    # warmup menu tiny (the A/B pays it twice per load); generation
+    # dominates the leg, which is what speculation accelerates
+    serve_kw = dict(
+        block_size=16, num_blocks=0, token_budget=4 * cfg.max_seq_len,
+        watermark=2, prefill_tiers=(motif * tiles + 2,),
+        decode_tiers=(4,), prefill_chunk=0)
+    rs = np.random.RandomState(args.seed + 3)
+    template, random_load = build_spec_loads(
+        rs, n, motif=motif, tiles=tiles, gen=gen)
+
+    rows = []
+    for name, load, base in (("template", template, 600000),
+                             ("random", random_load, 700000)):
+        base_row, base_res = run_spec_leg(
+            cfg, params, ServeConfig(**serve_kw), load,
+            f"spec_base_{name}", base)
+        spec_row, spec_res = run_spec_leg(
+            cfg, params, ServeConfig(spec=True, spec_k=k, **serve_kw),
+            load, f"spec_on_{name}", base + 50000)
+        for i in range(n):  # drafts move compute, never values
+            if not np.array_equal(base_res[i], spec_res[i]):
+                print(f"SPEC ORACLE MISMATCH ({name}) on request {i}",
+                      file=sys.stderr)
+                return None
+        spec_row["speedup_vs_base"] = round(
+            spec_row["throughput_tokens_per_s"]
+            / max(base_row["throughput_tokens_per_s"], 1e-9), 2)
+        rows += [base_row, spec_row]
+    return rows
+
+
 def _drive_router(router, load, arrivals, t0=None):
     """Open-loop drive of a FleetRouter: submit each request at its
     arrival offset, stepping the fleet in between (the router is
@@ -761,8 +877,14 @@ def main():
             return 1
         mc_rows.append(mc)
 
+    # -- round 15: the speculative-decoding A/B -------------------------
+    spec_rows = run_spec_legs(args)
+    if spec_rows is None:
+        return 1
+
     for row in (cont_row, stat_row, prefix_rows[0], prefix_rows[1],
-                unchunked_row, chunked_row, kv_row, *mc_rows):
+                unchunked_row, chunked_row, kv_row, *mc_rows,
+                *spec_rows):
         print(json.dumps(row))
     on, off = prefix_rows[1], prefix_rows[0]
     print(
@@ -787,6 +909,18 @@ def main():
             f"{mc['psum_bytes_per_step_measured']} psum B/step on ICI; "
             f"oracle token-identical, compile_free={mc['compile_free']}",
             file=sys.stderr)
+    sp_t, sp_r = spec_rows[1], spec_rows[3]
+    print(
+        f"speculative: template-heavy "
+        f"{spec_rows[0]['throughput_tokens_per_s']} -> "
+        f"{sp_t['throughput_tokens_per_s']} tok/s "
+        f"({sp_t['speedup_vs_base']}x) at acceptance "
+        f"{sp_t['acceptance_rate']} "
+        f"({sp_t['tokens_per_step']} tok/step); adversarial-random "
+        f"{sp_r['speedup_vs_base']}x at acceptance "
+        f"{sp_r['acceptance_rate']} — bit-identical both ways, "
+        f"compile_free={sp_t['compile_free'] and sp_r['compile_free']}",
+        file=sys.stderr)
     return 0
 
 
