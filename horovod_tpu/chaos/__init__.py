@@ -76,6 +76,10 @@ SITES = (
     "guard.grad",              # per-step gradient tap (guard.py tap_grads)
     "guard.param",             # cadence param-fingerprint tap (guard.py)
     "checkpoint.payload",      # checkpoint bytes about to be published
+    "serve.dispatch",          # router->replica request hand-off
+    "serve.replica_step",      # one fleet replica's engine step
+    "serve.migrate",           # KV snapshot wire on the warm recovery path
+    "serve.snapshot",          # periodic in-flight KV export (replica)
 )
 
 

@@ -37,6 +37,7 @@ from typing import Callable, Optional, Sequence
 
 import numpy as np
 
+from .. import chaos as _chaos
 from ..common.retry import env_float, env_int, retry_call
 from ..metrics import instruments as _instr
 from ..metrics.exposition import (
@@ -62,6 +63,12 @@ ENV_SPAWN_RETRIES = "HVD_TPU_FLEET_REPLICA_SPAWN_RETRIES"
 #: router marks a replica SUSPECT — ejected from placement, in-flight
 #: work re-routed once (docs/FLEET.md)
 ENV_ERRORS = "HVD_TPU_FLEET_REPLICA_ERRORS"
+#: engine steps between periodic KV snapshots (0 = off): every N
+#: completed steps the replica exports its in-flight requests' verified
+#: streams + full-block pages (``engine.export_requests``) so the
+#: router has a warm migration source even when a replica dies without
+#: a drain handshake (docs/SERVING.md fault tolerance)
+ENV_SNAPSHOT_STEPS = "HVD_TPU_SERVE_SNAPSHOT_STEPS"
 
 
 class ServingReplica:
@@ -93,6 +100,12 @@ class ServingReplica:
         #: EMA of step wall time — the router's queue-delay estimate
         #: (deadline-aware placement) multiplies it by queue depth
         self.avg_step_s: Optional[float] = None
+        #: periodic KV snapshot cadence (steps; 0 = off) and the last
+        #: snapshot taken — the router's warm-migration fallback when
+        #: this replica dies without a drain handshake
+        self._snapshot_steps = max(0, env_int(ENV_SNAPSHOT_STEPS, 0))
+        self._steps_since_snapshot = 0
+        self.kv_snapshots: dict = {}
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -201,13 +214,22 @@ class ServingReplica:
         if not self.accepting:
             raise RuntimeError(
                 f"replica {self.name} is {self.state}, not accepting")
+        # a dropped/killed dispatch raises here — the router books it
+        # toward this replica's consecutive-error count and retries the
+        # request on the next-best survivor (docs/FAULT_TOLERANCE.md)
+        _chaos.raise_point("serve.dispatch")
         return self.engine.submit(prompt, max_new_tokens, eos_id=eos_id,
                                   arrival=arrival, deadline_s=deadline_s,
                                   trace_id=trace_id, spec_k=spec_k)
 
     def step(self) -> bool:
         """One engine step; progress timestamps feed the heartbeat and
-        the step-time EMA feeds the queue-delay estimate."""
+        the step-time EMA feeds the queue-delay estimate.  Chaos site
+        ``serve.replica_step`` fires BEFORE the engine steps — a raise
+        here books toward the consecutive-error threshold exactly like
+        a real step failure (the soak's replica-loss lever); a kill is
+        the process-death case the periodic snapshots exist for."""
+        _chaos.raise_point("serve.replica_step")
         t0 = self._clock()
         more = self.engine.step()
         now = self._clock()
@@ -215,7 +237,25 @@ class ServingReplica:
         self.avg_step_s = dt if self.avg_step_s is None else (
             0.8 * self.avg_step_s + 0.2 * dt)
         self._last_progress = now
+        if self._snapshot_steps > 0:
+            self._steps_since_snapshot += 1
+            if self._steps_since_snapshot >= self._snapshot_steps:
+                self._steps_since_snapshot = 0
+                self.snapshot_kv()
         return more
+
+    def snapshot_kv(self) -> None:
+        """Export every in-flight request's verified stream + full-block
+        pages (the router's warm-migration fallback source).  Chaos
+        site ``serve.snapshot``: a drop here skips THIS cadence — the
+        previous snapshot stays valid (recovery falls further behind
+        the stream, never wrong: the migrated prefix is still a
+        verified prefix and the survivor regenerates the rest)."""
+        try:
+            _chaos.raise_point("serve.snapshot")
+        except _chaos.ChaosInjected:
+            return
+        self.kv_snapshots = self.engine.export_requests()
 
     def est_queue_delay(self) -> float:
         """Rough seconds of queue ahead of a new request on this

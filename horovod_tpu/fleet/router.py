@@ -53,7 +53,11 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from .. import chaos as _chaos
+from .. import trace as _trace
+from ..common.retry import env_float, env_int
 from ..metrics import instruments as _instr
+from ..trace import flight as _flight
 from ..utils.logging import get_logger
 from .policy import TargetTrackingPolicy
 from .replica import DRAINING, PARKED, READY, RETIRED, ServingReplica
@@ -83,10 +87,30 @@ class _Placement:
     #: are k-independent; the knob moves throughput/latency only)
     spec_k: Optional[int] = None
     rerouted: bool = False
+    #: the emitted-token WATERMARK: tokens already generated before a
+    #: migration, carried in the re-submitted prompt.  ``prompt`` stays
+    #: the ORIGINAL client prompt for its whole life, so the collection
+    #: pass prepends this prefix to the survivor's output exactly once
+    #: — generated tokens are never emitted twice (docs/SERVING.md)
+    prefix: np.ndarray = dataclasses.field(
+        default_factory=lambda: np.zeros((0,), np.int32))
+    #: live hedged second dispatch, (replica, rid); first completion
+    #: wins, the loser is cancelled
+    hedge: Optional[Tuple[ServingReplica, int]] = None
+    #: a hedge decision was already taken for this placement (issued OR
+    #: suppressed) — each request is considered at most once
+    hedged: bool = False
+    #: router-clock stamp of the current dispatch (the hedge age base)
+    placed_at: Optional[float] = None
 
 _ROUTE_AFFINITY = _instr.FLEET_ROUTED.labels("affinity")
 _ROUTE_LEAST_QUEUE = _instr.FLEET_ROUTED.labels("least_queue")
 _ROUTE_RR = _instr.FLEET_ROUTED.labels("round_robin")
+_MIGRATE_WARM = _instr.SERVE_MIGRATIONS.labels("warm")
+_MIGRATE_COLD = _instr.SERVE_MIGRATIONS.labels("cold")
+_HEDGE_WON = _instr.SERVE_HEDGES.labels("won")
+_HEDGE_LOST = _instr.SERVE_HEDGES.labels("lost")
+_HEDGE_SUPPRESSED = _instr.SERVE_HEDGES.labels("suppressed")
 
 
 class FleetRouter:
@@ -136,6 +160,22 @@ class FleetRouter:
                              "round_robin": 0}
         #: applied scale actions, in order: (direction, new_size)
         self.scale_events: List[Tuple[str, int]] = []
+        #: hedged dispatch (docs/SERVING.md fault tolerance): a request
+        #: still waiting on its first token past the sliding p99 TTFT
+        #: gets a second, identical dispatch; first completion wins
+        self.hedge_enabled = bool(env_int("HVD_TPU_SERVE_HEDGE", 0))
+        #: lifetime hedge allowance as a fraction of submitted requests
+        #: — the retry budget that keeps hedging from amplifying an
+        #: overload past the deadline-shedding bar
+        self.hedge_budget = max(0.0, env_float(
+            "HVD_TPU_SERVE_HEDGE_BUDGET", 0.1))
+        self._submitted = 0
+        self._hedges_issued = 0
+        #: per-router hedge outcomes (the metric counters aggregate
+        #: across routers; the bench wants per-leg numbers)
+        self.hedges = {"won": 0, "lost": 0, "suppressed": 0}
+        #: per-recovery records ({gid, path, ms}) — bench columns
+        self.recovery: List[dict] = []
         for _ in range(replicas):
             self._spawn_replica()
         # warm spares: spawned + fully compiled now (before traffic),
@@ -266,8 +306,6 @@ class FleetRouter:
         # trace context is born HERE and propagates router -> replica
         # -> engine -> scheduler: every span the request touches
         # downstream carries this id (docs/TRACING.md)
-        from .. import trace as _trace
-
         tid = _trace.new_trace_id() if _trace.enabled() else None
         tried: List[ServingReplica] = []
         for _ in range(len(self.replicas) + 1):
@@ -293,11 +331,12 @@ class FleetRouter:
                 continue
             gid = self._next_gid
             self._next_gid += 1
+            self._submitted += 1
             self._placed[gid] = _Placement(
                 replica=r, rid=rid, prompt=prompt,
                 max_new_tokens=int(max_new_tokens), eos_id=eos_id,
                 arrival=arrival, deadline_s=deadline_s, trace_id=tid,
-                spec_k=spec_k)
+                spec_k=spec_k, placed_at=self._clock())
             _trace.event("fleet.route", gid=gid, rid=rid,
                          replica=r.name, mode=self.mode, trace=tid)
             return gid
@@ -341,62 +380,252 @@ class FleetRouter:
                 self.replicas.remove(r)
                 self.retired.append(r)
                 self._book_replica_gauges()
+        if self.hedge_enabled:
+            self._maybe_hedge()
         if self.policy is not None:
             self._maybe_scale()
         return busy
 
+    def _first_token_at(self, p: _Placement) -> Optional[float]:
+        """The placement's first-token timestamp on its primary, or
+        None while it is still in prefill (the hedgeable phase)."""
+        eng = p.replica.engine
+        if eng is None:
+            return None
+        for seq in eng.scheduler.running:
+            if seq.req.id == p.rid:
+                return seq.first_token_at
+        return None
+
+    def _maybe_hedge(self) -> None:
+        """Hedged dispatch (``HVD_TPU_SERVE_HEDGE``): a request still
+        waiting on its FIRST token past the sliding-window p99 TTFT
+        gets one identical second dispatch on the least-queue other
+        replica; whichever completes first wins and the loser is
+        cancelled (:meth:`_collect`).  Only prefill-phase requests
+        hedge — a decoding request's progress would be thrown away,
+        and decode stragglers are the ejection path's job.  The
+        ``HVD_TPU_SERVE_HEDGE_BUDGET`` fraction bounds total hedges so
+        tail-chasing cannot amplify an overload (The Tail at Scale)."""
+        if len(self._ttfts) < 16:
+            return  # no stable delay estimate yet
+        xs = sorted(self._ttfts)
+        delay = xs[min(len(xs) - 1, int(0.99 * len(xs)))]
+        now = self._clock()
+        for gid, p in list(self._placed.items()):
+            if p.hedged or p.rerouted or p.placed_at is None:
+                continue
+            if now - p.placed_at <= delay:
+                continue
+            if self._first_token_at(p) is not None:
+                p.hedged = True  # decoding: past the hedgeable phase
+                continue
+            if self._hedges_issued + 1 > self.hedge_budget * max(
+                    1, self._submitted):
+                p.hedged = True
+                self.hedges["suppressed"] += 1
+                _HEDGE_SUPPRESSED.inc()
+                continue
+            others = [x for x in self._accepting() if x is not p.replica]
+            tgt = min(others, key=lambda x: x.queue_depth(),
+                      default=None)
+            if tgt is None or tgt.est_queue_delay() > delay:
+                # no survivor could plausibly beat the primary —
+                # a hedge would only add load
+                p.hedged = True
+                self.hedges["suppressed"] += 1
+                _HEDGE_SUPPRESSED.inc()
+                continue
+            try:
+                hrid = tgt.submit(
+                    np.concatenate([p.prompt, p.prefix])
+                    if p.prefix.size else p.prompt,
+                    p.max_new_tokens - int(p.prefix.size),
+                    eos_id=p.eos_id, arrival=p.arrival,
+                    deadline_s=p.deadline_s, trace_id=p.trace_id,
+                    spec_k=p.spec_k)
+                tgt.note_ok()
+            except Exception as e:
+                get_logger().warning(
+                    "fleet: hedge to replica %s raised (%s: %s)",
+                    tgt.name, type(e).__name__, e)
+                tgt.note_error()
+                p.hedged = True
+                continue
+            p.hedged = True
+            p.hedge = (tgt, hrid)
+            self._hedges_issued += 1
+            _trace.event("serve.hedge", gid=gid,
+                         primary=p.replica.name, hedge=tgt.name,
+                         delay=delay, trace=p.trace_id)
+
     def _eject(self, r: ServingReplica) -> None:
         """A replica turned SUSPECT: collect what it already finished,
-        re-route its remaining work ONCE to the least-queue survivors
-        (a request whose survivor also fails completes empty rather
-        than ping-ponging), release its scheduler bookkeeping (blocks
-        free through the normal refcount path) and drain-retire it.  A
-        survivor crossing its own error threshold DURING the re-route
-        is ejected afterwards (bounded: each ejection removes a
-        replica).  A replica already DRAINING voluntarily (scale-down)
-        that then stalls still gets the full ejection — the guard is
-        the ``ejected`` flag, not the lifecycle state."""
+        migrate its remaining work ONCE to survivors (a request whose
+        survivor also fails completes with what it has rather than
+        ping-ponging), release its scheduler bookkeeping (blocks free
+        through the normal refcount path) and drain-retire it.
+
+        Recovery is loss-free and token-identical (docs/SERVING.md):
+
+        * the dying engine is asked to **export** its in-flight
+          requests (tokens generated so far + a KV block snapshot);
+          if it can't answer, the replica's last periodic
+          ``kv_snapshots`` (``HVD_TPU_SERVE_SNAPSHOT_STEPS``) stand in;
+        * **warm path** — the snapshot re-registers on the survivor
+          (``import_kv``) so the re-submitted request re-prefixes from
+          cache and pays no prefill recompute.  The snapshot crosses a
+          ``serve.migrate`` chaos point; a corrupted wire FAILS the
+          chain-hash verification and degrades to the cold path —
+          never into wrong tokens;
+        * **cold path** — re-submit ``prompt + generated-so-far``
+          (greedy decode is deterministic, so the survivor regenerates
+          the identical continuation);
+        * generated tokens are never emitted twice: the already-
+          generated prefix moves to ``p.prefix`` and the collection
+          pass prepends it exactly once.
+
+        A survivor crossing its own error threshold DURING the
+        re-route is ejected afterwards (bounded: each ejection removes
+        a replica).  A replica already DRAINING voluntarily
+        (scale-down) that then stalls still gets the full ejection —
+        the guard is the ``ejected`` flag, not the lifecycle state."""
         if r.ejected or r.state == RETIRED:
             return
         r.ejected = True
+        t0 = self._clock()
         self._collect(r)
+        # black box FIRST: the bundle must show the dying replica's
+        # final spans, not the recovery's
+        _flight.maybe_dump("replica_loss", extra={"replica": r.name})
+        # freshest stream state wins: a live (merely suspect) engine
+        # exports right now; a truly dead one falls back to its last
+        # periodic snapshot
+        handoff: Dict[int, tuple] = {}
+        if r.engine is not None:
+            try:
+                handoff = r.engine.export_requests()
+            except Exception as e:
+                get_logger().warning(
+                    "fleet: replica %s export failed (%s: %s) — "
+                    "using last periodic snapshot", r.name,
+                    type(e).__name__, e)
+        if not handoff:
+            handoff = dict(r.kv_snapshots)
         survivors = [x for x in self._accepting() if x is not r]
+        touched: List[ServingReplica] = []
         moved = dropped = 0
         for gid, p in list(self._placed.items()):
             if p.replica is not r:
+                # a hedge living on the dying replica is simply lost
+                if p.hedge is not None and p.hedge[0] is r:
+                    p.hedge = None
                 continue
-            placed = None
-            if not p.rerouted:
-                # walk EVERY accepting survivor least-queue-first: one
-                # survivor flaking must not drop a request another
-                # could serve — and its flake books toward its own
-                # suspect counter like any other submit error
-                for tgt in sorted(survivors,
-                                  key=lambda x: x.queue_depth()):
-                    if not tgt.accepting:
-                        continue
-                    try:
-                        nrid = tgt.submit(
-                            p.prompt, p.max_new_tokens,
-                            eos_id=p.eos_id, arrival=p.arrival,
-                            deadline_s=p.deadline_s,
-                            trace_id=p.trace_id, spec_k=p.spec_k)
-                        tgt.note_ok()
-                        placed = (tgt, nrid)
-                        break
-                    except Exception as e:
-                        get_logger().warning(
-                            "fleet: re-route to replica %s raised "
-                            "(%s: %s)", tgt.name, type(e).__name__, e)
-                        tgt.note_error()
-            if placed is None:
-                self.results[gid] = np.zeros((0,), np.int32)
+            # first-wins promotion: if the primary dies while a live
+            # hedge already carries this request elsewhere, the hedge
+            # BECOMES the placement — no re-dispatch needed
+            if p.hedge is not None and p.hedge[0] is not r \
+                    and p.hedge[0].engine is not None:
+                p.replica, p.rid = p.hedge
+                p.hedge = None
+                p.rerouted = True
+                moved += 1
+                continue
+            p.hedge = None
+            tokens, snap, arr = handoff.get(p.rid, (None, None, None))
+            if tokens is not None:
+                # the exported stream is context+generated of the
+                # CURRENT engine request, whose prompt already includes
+                # any earlier migration prefix — slicing past the
+                # ORIGINAL prompt therefore recovers the FULL generated
+                # run; never concat p.prefix on top of it
+                gen = np.asarray(tokens[len(p.prompt):], np.int32)
+            else:
+                gen = p.prefix
+            if p.rerouted:
+                # one-reroute bound: a twice-unlucky request completes
+                # with its watermark instead of ping-ponging
+                self.results[gid] = gen
                 del self._placed[gid]
                 dropped += 1
                 continue
-            self._placed[gid] = dataclasses.replace(
-                p, replica=placed[0], rid=placed[1], rerouted=True)
+            if p.eos_id is not None and gen.size:
+                hits = np.flatnonzero(gen == p.eos_id)
+                if hits.size:
+                    gen = gen[:int(hits[0]) + 1]
+            remaining = p.max_new_tokens - int(gen.size)
+            if remaining < 1 or (p.eos_id is not None and gen.size
+                                 and gen[-1] == p.eos_id):
+                # already done — the kill landed between the last
+                # token and collection
+                self.results[gid] = gen
+                del self._placed[gid]
+                continue
+            # warm-path wire: the snapshot's token stream crosses the
+            # serve.migrate chaos point as bytes (drop => cold path;
+            # corruption => chain-hash mismatch on import => cold path)
+            wire_snap = None
+            if snap is not None and survivors:
+                wire = np.asarray(snap["tokens"], np.int32).tobytes()
+                out = _chaos.point("serve.migrate", wire)
+                if out is not _chaos.DROP:
+                    wire_snap = dict(snap)
+                    wire_snap["tokens"] = np.frombuffer(out, np.int32)
+            placed = None
+            path = "cold"
+            # walk EVERY accepting survivor least-queue-first: one
+            # survivor flaking must not drop a request another could
+            # serve — and its flake books toward its own suspect
+            # counter like any other submit error
+            for tgt in sorted(survivors, key=lambda x: x.queue_depth()):
+                if not tgt.accepting:
+                    continue
+                try:
+                    path = "cold"
+                    if wire_snap is not None:
+                        try:
+                            tgt.engine.import_kv(wire_snap)
+                            path = "warm"
+                        except ValueError as e:
+                            get_logger().warning(
+                                "fleet: KV snapshot rejected for gid "
+                                "%d (%s) — cold re-prefill", gid, e)
+                            wire_snap = None  # bad wire: don't retry it
+                    nrid = tgt.submit(
+                        np.concatenate([p.prompt, gen])
+                        if gen.size else p.prompt,
+                        int(remaining), eos_id=p.eos_id,
+                        arrival=arr if arr is not None else p.arrival,
+                        deadline_s=p.deadline_s,
+                        trace_id=p.trace_id, spec_k=p.spec_k)
+                    tgt.note_ok()
+                    placed = (tgt, nrid)
+                    break
+                except Exception as e:
+                    get_logger().warning(
+                        "fleet: re-route to replica %s raised "
+                        "(%s: %s)", tgt.name, type(e).__name__, e)
+                    tgt.note_error()
+            if placed is None:
+                self.results[gid] = gen
+                del self._placed[gid]
+                dropped += 1
+                continue
+            p.replica, p.rid = placed
+            p.rerouted = True
+            p.prefix = gen
+            p.placed_at = self._clock()
             moved += 1
+            if placed[0] not in touched:
+                touched.append(placed[0])
+            (_MIGRATE_WARM if path == "warm" else _MIGRATE_COLD).inc()
+            dt = self._clock() - t0
+            _instr.SERVE_RECOVERY_SECONDS.observe(dt)
+            self.recovery.append({"gid": gid, "path": path,
+                                  "ms": dt * 1e3})
+            _trace.event("serve.migrate", gid=gid, src=r.name,
+                         dst=placed[0].name, path=path,
+                         carried=int(gen.size), trace=p.trace_id)
         if r.engine is not None:
             # abort everything the engine still holds (blocks release
             # through the normal refcount path; partial results publish
@@ -404,6 +633,12 @@ class FleetRouter:
             # placed and cannot re-route — complete empty instead of
             # leaving their pollers waiting forever)
             r.engine.cancel_all()
+        # arrival-order fairness: migrated requests joined the
+        # survivors' pending queues at the tail — re-sort by original
+        # arrival so ejection doesn't reorder admission
+        for tgt in touched:
+            if tgt.engine is not None:
+                tgt.engine.scheduler.resort_pending_by_arrival()
         get_logger().error(
             "fleet: ejected suspect replica %s (%d request(s) "
             "re-routed, %d dropped)", r.name, moved, dropped)
@@ -422,12 +657,37 @@ class FleetRouter:
         for _rid, ttft in r.ttft_samples()[self._ttft_seen.get(r, 0):]:
             self._ttfts.append(ttft)
             self._ttft_seen[r] = self._ttft_seen.get(r, 0) + 1
-        # map replica-local completions back to router-global ids
+        if r.engine is None:
+            return
+        # map replica-local completions back to router-global ids;
+        # hedged placements resolve FIRST-WINS (the loser cancels, its
+        # blocks free through the normal refcount path)
         for gid, p in list(self._placed.items()):
-            if p.replica is r and r.engine is not None \
-                    and p.rid in r.engine.results:
-                self.results[gid] = r.engine.results[p.rid]
-                del self._placed[gid]
+            primary_done = p.replica is r and p.rid in r.engine.results
+            hedge_done = (p.hedge is not None and p.hedge[0] is r
+                          and p.hedge[0].engine is not None
+                          and p.hedge[1] in p.hedge[0].engine.results)
+            if not primary_done and not hedge_done:
+                continue
+            if primary_done:
+                res = r.engine.results[p.rid]
+                if p.hedge is not None:
+                    loser, lrid = p.hedge
+                    if loser.engine is not None:
+                        loser.engine.cancel(lrid)
+                    self.hedges["lost"] += 1
+                    _HEDGE_LOST.inc()
+            else:
+                res = p.hedge[0].engine.results[p.hedge[1]]
+                if p.replica.engine is not None:
+                    p.replica.engine.cancel(p.rid)
+                self.hedges["won"] += 1
+                _HEDGE_WON.inc()
+            # prepend the pre-migration watermark exactly once
+            res = np.asarray(res, np.int32)
+            self.results[gid] = (np.concatenate([p.prefix, res])
+                                 if p.prefix.size else res)
+            del self._placed[gid]
 
     # -- SLO signals + scaling ----------------------------------------------
 
@@ -489,3 +749,15 @@ class FleetRouter:
     def all_compile_free(self) -> bool:
         return all(r.compile_free for r in self.replicas) and all(
             getattr(r, "_final_compile_free", True) for r in self.retired)
+
+    def hedge_rate(self) -> float:
+        """Hedges issued per submitted request (bench column; the
+        budget bounds it at ``hedge_budget``)."""
+        return self._hedges_issued / max(1, self._submitted)
+
+    def migration_ms(self) -> float:
+        """Mean detection-to-re-dispatch latency over this router's
+        recoveries, in milliseconds (0.0 when none happened)."""
+        if not self.recovery:
+            return 0.0
+        return sum(x["ms"] for x in self.recovery) / len(self.recovery)

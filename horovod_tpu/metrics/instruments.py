@@ -353,6 +353,36 @@ SERVE_SPEC_ACCEPT_RATE = histogram(
     buckets=(0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 1.0),
 )
 
+#: In-flight requests migrated off a lost replica, by recovery path:
+#: ``warm`` = a verified KV block chain re-registered on the survivor
+#: (chain hashes checked end to end), ``cold`` = prompt+generated
+#: re-prefilled through the prefix cache (docs/SERVING.md fault
+#: tolerance).
+SERVE_MIGRATIONS = counter(
+    "hvd_tpu_serve_migrations_total",
+    "Requests migrated to a surviving replica, by recovery path",
+    ["path"],  # warm / cold
+)
+
+#: Hedged-dispatch outcomes (``HVD_TPU_SERVE_HEDGE``): ``won`` = the
+#: hedge finished first (primary cancelled), ``lost`` = the primary
+#: finished first (hedge cancelled), ``suppressed`` = the retry budget
+#: or the target's load guard withheld the hedge.
+SERVE_HEDGES = counter(
+    "hvd_tpu_serve_hedges_total",
+    "Hedged dispatches by outcome",
+    ["outcome"],  # won / lost / suppressed
+)
+
+#: Wall seconds from detecting a replica loss to each of its requests
+#: being re-dispatched (or completed from its watermark) — the
+#: recovery-latency SLO the serve_bench ``migration_ms`` column reads.
+SERVE_RECOVERY_SECONDS = histogram(
+    "hvd_tpu_serve_recovery_seconds",
+    "Seconds from replica-loss detection to a request's re-dispatch",
+    buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0),
+)
+
 # -- fleet autoscaling + routing (fleet/ — docs/FLEET.md) --------------------
 
 #: Capacity the policy engine last decided the fleet should converge

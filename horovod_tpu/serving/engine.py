@@ -1097,6 +1097,123 @@ class ServingEngine:
         self._source_done = True
         sched._book()
 
+    # -- fault tolerance: KV snapshot / migration (docs/SERVING.md) ----------
+
+    def export_requests(self, rids: Optional[Iterable[int]] = None
+                        ) -> Dict[int, tuple]:
+        """Snapshot every in-flight request's recoverable state (the
+        fleet router's drain handshake and the replica's periodic
+        snapshot both call this): ``{rid: (tokens_so_far, snap,
+        arrival)}`` where ``tokens_so_far`` is the full VERIFIED
+        stream — prompt,
+        tokens folded into context by evictions, tokens generated
+        since — and ``snap`` (or None) serializes the stream's full,
+        written blocks with their K/V pages
+        (:meth:`BlockAllocator.export_blocks`).  Only verified
+        positions export: the partial tail and any unsettled
+        speculative garbage stay out by the ``tokens_in_cache``
+        invariant, so an importer's resumed decode is bit-identical.
+        ``arrival`` is the request's original arrival stamp — a
+        re-dispatch carries it so the survivor's queue keeps
+        arrival-order fairness (and TTFT/deadline accounting stays
+        measured from the true arrival).  Host-only work — one pool
+        pull shared across requests, zero compiles."""
+        want = set(rids) if rids is not None else None
+        out: Dict[int, tuple] = {}
+        bs = self.serve_cfg.block_size
+        k_host = v_host = None
+        for seq in list(self.scheduler.running) + \
+                list(self.scheduler.pending):
+            rid = seq.req.id
+            if want is not None and rid not in want:
+                continue
+            stream = seq.context if not seq.generated else np.concatenate(
+                [seq.context, np.asarray(seq.generated, np.int32)])
+            stream = np.asarray(stream, np.int32)
+            snap = None
+            n_full = min(seq.tokens_in_cache // bs, len(seq.blocks))
+            if n_full > 0 and self.allocator.prefix_cache:
+                if k_host is None:  # one transfer for the whole scan
+                    k_host = np.asarray(self.k_pool)
+                    v_host = np.asarray(self.v_pool)
+                blocks = seq.blocks[:n_full]
+                pages = [(np.array(k_host[:, b]), np.array(v_host[:, b]))
+                         for b in blocks]
+                snap = self.allocator.export_blocks(
+                    blocks, stream[:n_full * bs], pages)
+            out[rid] = (stream, snap, seq.req.arrival)
+        for req in list(self._staging_meta):  # staged: prompt-only (cold)
+            if want is None or req.id in want:
+                out[req.id] = (np.asarray(req.prompt, np.int32), None,
+                               req.arrival)
+        return out
+
+    def import_kv(self, snap: dict) -> int:
+        """Re-register a migrated block chain in THIS engine's
+        allocator and pools — the warm recovery path.  The chain
+        hashes verify first (:meth:`BlockAllocator.import_blocks`
+        raises ``ValueError`` on a corrupt snapshot before any state
+        changes: the ``serve.migrate`` corrupt-detection contract);
+        index hits cost nothing; fresh blocks get their pages written
+        host-side and put back under the pool's sharding.  The whole
+        chain then parks on the prefix-cache LRU, so the re-submitted
+        request's admission matches it like any other cached prefix —
+        zero new step programs, the compile-free contract holds on
+        the recovery path.  Returns the number of matchable blocks."""
+        blocks, fresh = self.allocator.import_blocks(snap)
+        try:
+            if fresh:
+                pages = snap.get("pages")
+                if not pages:
+                    raise ValueError(
+                        "snapshot carries no pages but its chain is not "
+                        "fully cached here — cannot warm-import")
+                k_host = np.array(self.k_pool)
+                v_host = np.array(self.v_pool)
+                for i, b in fresh:
+                    kp, vp = pages[i]
+                    k_host[:, b] = kp
+                    v_host[:, b] = vp
+                if self.mesh is not None:
+                    sharding = NamedSharding(
+                        self.mesh,
+                        P(None, None, None, self.shard_axis, None))
+                    self.k_pool = jax.device_put(k_host, sharding)
+                    self.v_pool = jax.device_put(v_host, sharding)
+                else:
+                    self.k_pool = jnp.asarray(k_host)
+                    self.v_pool = jnp.asarray(v_host)
+        except Exception:
+            # never leave a registered-but-pages-unwritten block
+            # matchable (it would serve garbage K/V)
+            for _i, b in fresh:
+                if b in self.allocator._meta:
+                    self.allocator._drop_cache_entry(b)
+            self.allocator.free(blocks)
+            raise
+        self.allocator.free(blocks)  # park the chain, matchable
+        return len(blocks)
+
+    def cancel(self, rid: int) -> bool:
+        """Abort ONE request without publishing a result (the hedged-
+        dispatch loser: its partial output must never race the
+        winner's into the router's collection).  A running sequence
+        releases its blocks through the normal refcount path; a
+        queued one just leaves.  Device-staged rows cannot be plucked
+        mid-stage — they drain, serve, and their result is ignored.
+        Returns whether the request was found and cancelled."""
+        sched = self.scheduler
+        for seq in list(sched.running):
+            if seq.req.id == rid:
+                sched.finish(seq)
+                return True
+        for seq in list(sched.pending):
+            if seq.req.id == rid:
+                sched.pending.remove(seq)
+                sched._book()
+                return True
+        return False
+
     # -- the scheduler loop --------------------------------------------------
 
     def step(self) -> bool:

@@ -229,6 +229,19 @@ class ContinuousBatchingScheduler:
         _instr.SERVE_KV_CACHED.set(
             self.allocator.cached_blocks / self.allocator.capacity)
 
+    def resort_pending_by_arrival(self) -> None:
+        """Re-establish arrival-order fairness in the pending queue —
+        the fleet router calls this after re-dispatching an ejected
+        replica's requests: the survivors' queues just absorbed
+        requests that may have arrived EARLIER than ones already
+        waiting, and appending them at the tail would charge the
+        crash's victims the whole queue again.  Stable sort: equal
+        arrivals (and the 0.0 default of bare submits) keep their
+        submission order, so a no-crash workload is a no-op."""
+        if len(self.pending) > 1:
+            self.pending = collections.deque(
+                sorted(self.pending, key=lambda s: s.req.arrival))
+
     def finish(self, seq: Sequence) -> None:
         """Release a completed sequence's blocks and batch slot (one
         reference each — shared prefix blocks stay alive for their

@@ -73,6 +73,8 @@ SITES = (
     "serve.spec_verify",   # one request's speculative verify row scored
     "serve.spec_rollback", # rejected-draft KV tail trimmed (instant)
     "fleet.route",         # router placement decision (instant)
+    "serve.migrate",       # one request's KV/stream handoff to a survivor
+    "serve.hedge",         # hedged second dispatch issued (instant)
     "fleet.scale",         # autoscaler applied a scale decision (instant)
     "fleet.preempt",       # preemption notice handled (instant)
     "guard.exchange",      # cross-rank digest/vote exchange (cadence)

@@ -19,6 +19,8 @@ Dump triggers (each passes its ``reason``, which labels the
 * ``rollback``    — a guard rollback discarding the poisoned window;
 * ``preempt``     — a handled preemption notice (fleet guard);
 * ``restart``     — any exec-restart (``_persist_and_exec``);
+* ``replica_loss``— the fleet router ejecting a serving replica
+  (before its in-flight requests migrate to survivors);
 * ``slo_breach``  — the fleet autoscaler applying a scale-out.
 
 Off by default: without ``HVD_TPU_TRACE_BUNDLE_DIR`` every trigger is

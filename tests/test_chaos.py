@@ -516,10 +516,13 @@ def test_chaos_soak_end_to_end():
     """Full recovery proof: kill + checkpoint auto-resume, native frame
     corruption + exec-restart recovery, the fleet autoscale 2->4->2
     plan under an injected kill, the fleet.preempt SIGTERM-grace leave,
-    seeded replay, idle overhead.  See tools/chaos_soak.py."""
+    the serve-recover replica-loss bit-identity drill (reduced load —
+    the 512-request default is the off-CI soak), seeded replay, idle
+    overhead.  See tools/chaos_soak.py."""
     proc = subprocess.run(
-        [sys.executable, os.path.join(REPO, "tools", "chaos_soak.py")],
-        cwd=REPO, timeout=900, capture_output=True, text=True,
+        [sys.executable, os.path.join(REPO, "tools", "chaos_soak.py"),
+         "--serve-requests", "96"],
+        cwd=REPO, timeout=1500, capture_output=True, text=True,
     )
     assert proc.returncode == 0, (
         f"chaos soak failed:\n{proc.stdout[-3000:]}\n{proc.stderr[-3000:]}")

@@ -803,3 +803,231 @@ def test_stalled_draining_replica_still_ejects(fleet_pieces,
     assert victim.suspect and victim.ejected
     assert victim.state == "retired"
     assert len(res) == 2 and all(res[g].size == 3 for g in gids)
+
+
+# -- crash-surviving requests: migration, hedging, chaos (ISSUE 18) ----------
+
+
+def _decode_until(router, victim, n, timeout_s=60):
+    """Step the fleet until every running request on ``victim`` has
+    generated >= n tokens (the mid-decode interruption point)."""
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        router.step()
+        seqs = list(victim.engine.scheduler.running)
+        if seqs and all(len(s.generated) >= n for s in seqs):
+            return
+    raise AssertionError("victim never reached the interruption point")
+
+
+def test_router_recovery_token_identical_and_warm(fleet_pieces,
+                                                  monkeypatch, tmp_path):
+    """The tentpole oracle: kill a replica mid-decode and every one of
+    its requests completes on a survivor with output bit-identical to
+    an unkilled control run — the already-generated prefix emitted
+    exactly once, the KV snapshot re-registered (warm path), zero
+    post-warmup compiles on the recovery path, and a replica_loss
+    flight bundle on disk."""
+    from horovod_tpu.fleet.router import FleetRouter
+    from horovod_tpu.trace import flight as _flight
+
+    monkeypatch.setenv("HVD_TPU_FLEET_REPLICA_ERRORS", "1")
+    monkeypatch.setenv("HVD_TPU_TRACE_BUNDLE_DIR", str(tmp_path))
+    _flight._last_dump.clear()  # another test's dump must not gate ours
+    _cfg, _params, build = fleet_pieces
+    rs = np.random.RandomState(18)
+    # 2 per replica: both of the victim's land IN DECODE (a 3rd would
+    # sit queued — no KV yet — and correctly migrate cold)
+    prompts = [rs.randint(1, 90, size=10).astype(np.int32)
+               for _ in range(4)]
+    ctrl = FleetRouter(build, replicas=2, mode="round_robin")
+    cgids = [ctrl.submit(p, 12) for p in prompts]
+    cres = ctrl.run_until_drained()
+    router = FleetRouter(build, replicas=2, mode="round_robin")
+    gids = [router.submit(p, 12) for p in prompts]
+    victim = router.replicas[0]
+    on_victim = [g for g, p in router._placed.items()
+                 if p.replica is victim]
+    assert on_victim, "round robin should have placed on both"
+    _decode_until(router, victim, 7)  # >= 1 full block generated
+
+    def boom():
+        raise RuntimeError("chip on fire")
+
+    victim.engine.step = boom
+    res = router.run_until_drained()
+    assert len(res) == 4
+    for g, cg in zip(gids, cgids):
+        np.testing.assert_array_equal(
+            res[g], cres[cg],
+            err_msg=f"gid {g} diverged from the unkilled control")
+    assert router.recovery, "ejection must book recovery records"
+    assert {x["path"] for x in router.recovery} == {"warm"}, \
+        "mid-decode requests with full blocks must migrate warm"
+    assert all(x["ms"] >= 0 for x in router.recovery)
+    assert router.migration_ms() > 0
+    assert victim.state == "retired"
+    assert router.all_compile_free(), \
+        "the recovery path must not compile on the survivor"
+    bundles = [p for p in os.listdir(tmp_path)
+               if p.startswith("bundle-replica_loss-")]
+    assert bundles, "replica loss must dump a flight-recorder bundle"
+
+
+def test_serve_migrate_corrupt_degrades_to_cold(fleet_pieces,
+                                                monkeypatch):
+    """Corrupt injection on the serve.migrate wire: the chain-hash
+    verification rejects the snapshot and recovery DEGRADES to the
+    cold path (re-prefill from tokens) — outputs stay exact, never
+    wrong tokens."""
+    from horovod_tpu import chaos
+    from horovod_tpu.fleet.router import FleetRouter
+
+    monkeypatch.setenv("HVD_TPU_FLEET_REPLICA_ERRORS", "1")
+    _cfg, _params, build = fleet_pieces
+    ref_eng = build()
+    rs = np.random.RandomState(19)
+    prompts = [rs.randint(1, 90, size=10).astype(np.int32)
+               for _ in range(4)]
+    rids = [ref_eng.submit(p, 12) for p in prompts]
+    ref = ref_eng.run()
+    chaos.configure("serve.migrate:corrupt,prob=1", seed=7)
+    try:
+        router = FleetRouter(build, replicas=2, mode="round_robin")
+        gids = [router.submit(p, 12) for p in prompts]
+        victim = router.replicas[0]
+        _decode_until(router, victim, 7)
+
+        def boom():
+            raise RuntimeError("chip on fire")
+
+        victim.engine.step = boom
+        res = router.run_until_drained()
+        fired = [t["site"] for t in chaos.injection_trace()]
+    finally:
+        chaos.clear()
+    assert "serve.migrate" in fired
+    assert router.recovery
+    assert {x["path"] for x in router.recovery} == {"cold"}, \
+        "a corrupt snapshot must fall back to cold re-prefill"
+    for g, rid in zip(gids, rids):
+        np.testing.assert_array_equal(res[g], ref[rid])
+
+
+def test_ejection_preserves_arrival_order(fleet_pieces, monkeypatch):
+    """Fairness satellite: requests migrated off a dead replica rejoin
+    the survivor's admission queue in ORIGINAL arrival order, not at
+    the tail behind later arrivals."""
+    from horovod_tpu.fleet.router import FleetRouter
+
+    monkeypatch.setenv("HVD_TPU_FLEET_REPLICA_ERRORS", "1")
+    _cfg, _params, build = fleet_pieces
+    router = FleetRouter(build, replicas=2, mode="round_robin")
+    gids = [router.submit(np.arange(1, 9, dtype=np.int32), 2,
+                          arrival=float(i)) for i in range(6)]
+    victim, survivor = router.replicas
+
+    def boom(*a, **k):
+        raise RuntimeError("chip on fire")
+
+    victim.engine.submit = boom
+    gids.append(router.submit(np.arange(1, 9, dtype=np.int32), 2,
+                              arrival=6.0))  # trips the ejection
+    assert victim.ejected
+    arrivals = [s.req.arrival for s in
+                survivor.engine.scheduler.pending]
+    assert arrivals == sorted(arrivals), \
+        f"migrated requests broke arrival order: {arrivals}"
+    assert set(arrivals) == {float(i) for i in range(7)}
+    res = router.run_until_drained()
+    assert len(res) == 7 and all(res[g].size == 2 for g in gids)
+
+
+def test_hedged_dispatch_first_wins_and_budget(fleet_pieces,
+                                               monkeypatch):
+    """HVD_TPU_SERVE_HEDGE: a prefill-phase request past the sliding
+    p99 TTFT gets one second dispatch; first completion wins, the
+    loser cancels (blocks freed, result never raced into collection);
+    HVD_TPU_SERVE_HEDGE_BUDGET=0 suppresses instead."""
+    from horovod_tpu.fleet.router import FleetRouter
+
+    monkeypatch.setenv("HVD_TPU_SERVE_HEDGE", "1")
+    # the default 0.1 budget would suppress the very first hedge
+    # (1 > 0.1 x 1 submitted) — that conservatism is the point of the
+    # budget, but here we want a hedge to actually fly
+    monkeypatch.setenv("HVD_TPU_SERVE_HEDGE_BUDGET", "1")
+    _cfg, _params, build = fleet_pieces
+    ref_eng = build()
+    prompt = np.arange(1, 9, dtype=np.int32)
+    rid = ref_eng.submit(prompt, 3)
+    ref = ref_eng.run()[rid]
+    t = [0.0]
+    router = FleetRouter(build, replicas=2, mode="round_robin",
+                         clock=lambda: t[0])
+    router._ttfts.extend([0.001] * 16)  # stable p99 estimate
+    g = router.submit(prompt, 3)
+    primary = router._placed[g].replica
+    t[0] = 1.0  # way past p99, still no first token: hedgeable
+    router._maybe_hedge()
+    p = router._placed[g]
+    assert p.hedged and p.hedge is not None
+    hedge_replica = p.hedge[0]
+    assert hedge_replica is not primary
+    assert router.hedge_rate() == pytest.approx(1.0)
+    res = router.run_until_drained()
+    np.testing.assert_array_equal(res[g], ref)
+    assert router.hedges["won"] + router.hedges["lost"] == 1
+    # the loser was cancelled: neither engine still holds the request
+    for r in router.replicas:
+        assert not r.engine.scheduler.running
+        assert not r.engine.scheduler.pending
+    assert router.all_compile_free()
+    # budget 0: the hedge decision books as suppressed, no dispatch
+    monkeypatch.setenv("HVD_TPU_SERVE_HEDGE_BUDGET", "0")
+    r2 = FleetRouter(build, replicas=2, mode="round_robin",
+                     clock=lambda: t[0])
+    r2._ttfts.extend([0.001] * 16)
+    t[0] = 2.0
+    g2 = r2.submit(prompt, 3)
+    t[0] = 3.0
+    r2._maybe_hedge()
+    assert r2._placed[g2].hedge is None and r2._placed[g2].hedged
+    assert r2.hedges == {"won": 0, "lost": 0, "suppressed": 1}
+    assert r2.hedge_rate() == 0.0
+    np.testing.assert_array_equal(r2.run_until_drained()[g2], ref)
+
+
+def test_periodic_snapshot_cadence_and_chaos_skip(fleet_pieces,
+                                                  monkeypatch):
+    """HVD_TPU_SERVE_SNAPSHOT_STEPS: the replica snapshots its
+    in-flight KV every N steps (the warm source when a dead engine
+    can't export); a chaos raise on serve.snapshot skips that beat
+    without failing the step."""
+    from horovod_tpu import chaos
+    from horovod_tpu.fleet.replica import ServingReplica
+
+    monkeypatch.setenv("HVD_TPU_SERVE_SNAPSHOT_STEPS", "2")
+    _cfg, _params, build = fleet_pieces
+    r = ServingReplica("snap", build)
+    r.spawn()
+    r.submit(np.arange(1, 9, dtype=np.int32), 8,
+             arrival=time.perf_counter())
+    r.step()
+    assert not r.kv_snapshots, "cadence 2 must not snapshot on step 1"
+    r.step()
+    assert r.kv_snapshots, "cadence 2 must snapshot on step 2"
+    rid, (tokens, _snap, _arr) = next(iter(r.kv_snapshots.items()))
+    assert tokens.size >= 8
+    # chaos raise on the snapshot site: the beat skips, the step lives
+    chaos.configure("serve.snapshot:raise,prob=1", seed=3)
+    try:
+        r.kv_snapshots = {}
+        r.step()
+        r.step()
+        assert not r.kv_snapshots, "chaos raise must skip the beat"
+    finally:
+        chaos.clear()
+    while r.has_work:
+        r.step()
+    r.drain()
+    r.retire()

@@ -41,6 +41,18 @@ workers' weight bookkeeping.  Scenarios:
                   VERIFIED checkpoint — discarding the poisoned-window
                   checkpoints — then re-runs to the exact final count
                   with bounded recovery_seconds{phase="rollback"}.
+  serve-recover   crash-surviving SERVING requests (docs/SERVING.md
+                  fault tolerance): a 3-replica fleet router under a
+                  templated request load loses one replica mid-burst
+                  (chaos raise at serve.replica_step with
+                  HVD_TPU_FLEET_REPLICA_ERRORS=1).  The router dumps a
+                  replica_loss flight bundle, re-disperses the
+                  victim's in-flight work — warm KV migration where
+                  verified blocks exist, cold re-prefill otherwise —
+                  and every request must complete with output
+                  BIT-IDENTICAL to an unkilled control run: zero lost
+                  requests, zero duplicated emissions, zero
+                  post-warmup compiles on the survivors.
   replay          the same HVD_TPU_CHAOS_SEED must reproduce the same
                   injection trace, event for event.
   overhead        chaos OFF must cost one module-bool per injection point
@@ -54,7 +66,9 @@ exec-restart, checkpoint auto-resume) is identical; only the cross-worker
 state broadcast is skipped.  On a TPU fleet run without it.
 
 Usage: python tools/chaos_soak.py [--batches N] [--seed S]
-       [--scenario all|kill-resume|corrupt-recover|replay|overhead]
+       [--serve-requests N]
+       [--scenario all|kill-resume|corrupt-recover|autoscale|preempt
+                  |sdc|serve-recover|replay|overhead]
 Exit code 0 = every scenario passed.  Marked `slow` in the test suite
 (tests/test_chaos.py wraps it); a full run is a few minutes of real
 process churn.
@@ -443,6 +457,77 @@ def scenario_sdc(batches, seed, cadence=4):
                 len(qb[0]["trace"]["traceEvents"])}
 
 
+def scenario_serve_recover(n_requests, seed):
+    """The ISSUE-18 serving drill: kill a serving replica mid-burst and
+    prove no request is lost, duplicated, or altered.  Two runs of
+    tests/integration/serve_fleet_worker.py on the SAME seeded load:
+    a fault-free control, then a chaotic run where the K-th
+    serve.replica_step raises (one strike ejects).  The chaotic run's
+    streams must be bit-identical to the control's, with >= 1 recorded
+    migration, a replica_loss flight bundle, and compile-free
+    survivors (recovery re-registers KV pages / re-prefills — it never
+    compiles a new program)."""
+    worker = os.path.join(REPO, "tests", "integration",
+                          "serve_fleet_worker.py")
+    # mid-burst: the victim has served ~kill_at steps of a load that is
+    # still mostly in flight, so it holds running requests (warm
+    # migrations) AND queued ones (cold re-dispatch)
+    kill_at = max(24, n_requests // 8)
+    with tempfile.TemporaryDirectory(prefix="chaos_soak_") as tmp:
+        bdir = os.path.join(tmp, "bundles")
+        fuse = os.path.join(tmp, "serve.fuse")
+        ctl_path = os.path.join(tmp, "control.json")
+        cha_path = os.path.join(tmp, "chaotic.json")
+        subprocess.run(
+            [sys.executable, worker, ctl_path, str(n_requests), str(seed)],
+            env=_env(), cwd=REPO, check=True, timeout=900,
+            capture_output=True)
+        proc = subprocess.run(
+            [sys.executable, worker, cha_path, str(n_requests), str(seed)],
+            env=_env({
+                "HVD_TPU_CHAOS":
+                    f"serve.replica_step:raise,at={kill_at},fuse={fuse}",
+                "HVD_TPU_CHAOS_SEED": str(seed),
+                "HVD_TPU_FLEET_REPLICA_ERRORS": "1",
+                "HVD_TPU_SERVE_SNAPSHOT_STEPS": "8",
+                "HVD_TPU_SERVE_HEDGE": "1",
+                "HVD_TPU_TRACE_BUNDLE_DIR": bdir,
+            }), cwd=REPO, timeout=900, capture_output=True, text=True)
+        assert proc.returncode == 0, (
+            f"chaotic serve run failed rc={proc.returncode}\n"
+            f"{proc.stderr[-4000:]}")
+        assert os.path.exists(fuse), "chaos replica loss never fired"
+        with open(ctl_path) as f:
+            ctl = json.load(f)
+        with open(cha_path) as f:
+            cha = json.load(f)
+        assert ctl["lost"] == [], f"control run lost requests: {ctl['lost']}"
+        assert cha["lost"] == [], f"requests lost in recovery: {cha['lost']}"
+        assert set(cha["results"]) == set(ctl["results"]), \
+            "chaotic run's request ids diverged from control"
+        mismatch = [g for g in ctl["results"]
+                    if ctl["results"][g] != cha["results"][g]]
+        assert not mismatch, (
+            f"{len(mismatch)} of {n_requests} streams not bit-identical "
+            f"after recovery: {mismatch[:5]}")
+        assert cha["replicas_retired"] >= 1, "no replica was ejected"
+        assert cha["recovery"], "ejection recorded no migrations"
+        assert cha["migration_ms"] > 0, cha["migration_ms"]
+        assert ctl["compile_free"] and cha["compile_free"], \
+            "recovery compiled a new program post-warmup"
+        # the black box: _eject dumps BEFORE touching any state
+        bundles = _read_bundles(bdir, "replica_loss")
+        assert bundles, f"no replica_loss flight bundle in {bdir}"
+        warm = sum(1 for x in cha["recovery"] if x["path"] == "warm")
+        return {"requests": n_requests, "kill_at": kill_at,
+                "migrations": len(cha["recovery"]), "warm": warm,
+                "cold": len(cha["recovery"]) - warm,
+                "migration_ms": round(cha["migration_ms"], 2),
+                "hedge_rate": round(cha["hedge_rate"], 4),
+                "bundle_events":
+                len(bundles[0]["trace"]["traceEvents"])}
+
+
 def _replay_trace(tmp, tag, seed):
     trace = os.path.join(tmp, f"trace_{tag}.jsonl")
     code = (
@@ -497,10 +582,13 @@ def main(argv=None):
     ap.add_argument("--seed", type=int, default=42)
     ap.add_argument("--scenario", default="all",
                     choices=["all", "kill-resume", "corrupt-recover",
-                             "autoscale", "preempt", "sdc", "replay",
-                             "overhead"])
+                             "autoscale", "preempt", "sdc",
+                             "serve-recover", "replay", "overhead"])
     ap.add_argument("--peak", type=int, default=4,
                     help="autoscale scenario's peak world (CI smoke: 3)")
+    ap.add_argument("--serve-requests", type=int, default=512,
+                    help="serve-recover scenario's request count "
+                         "(CI smoke: 96)")
     args = ap.parse_args(argv)
 
     runs = {
@@ -511,6 +599,8 @@ def main(argv=None):
                                                 peak=args.peak),
         "preempt": lambda: scenario_preempt(args.batches, args.seed),
         "sdc": lambda: scenario_sdc(args.batches, args.seed),
+        "serve-recover": lambda: scenario_serve_recover(
+            args.serve_requests, args.seed),
         "replay": lambda: scenario_replay(args.seed),
         "overhead": scenario_overhead,
     }

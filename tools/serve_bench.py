@@ -707,6 +707,49 @@ def run_fleet_legs(args):
                   file=sys.stderr)
             return None
     rows.append(row)
+
+    # -- the ISSUE-18 recovery leg: same load, one replica killed
+    # mid-ramp.  One strike ejects (HVD_TPU_FLEET_REPLICA_ERRORS=1);
+    # in-flight work migrates warm off the live KV export, queued work
+    # re-disperses cold, hedging is armed.  The oracle stays
+    # token-identical vs the fault-free legs and the row carries the
+    # recovery columns CI asserts (migration_ms, hedge_rate).
+    os.environ["HVD_TPU_FLEET_REPLICA_ERRORS"] = "1"
+    os.environ["HVD_TPU_SERVE_HEDGE"] = "1"
+    try:
+        router = FleetRouter(build_engine, replicas=replicas,
+                             mode="affinity")
+        victim = router.replicas[0]
+        orig_step = victim.engine.step
+        state = {"n": 0}
+
+        def flaky_step(*a, **k):
+            state["n"] += 1
+            if state["n"] == 25:  # mid-ramp: the victim is mid-decode
+                raise RuntimeError("bench-injected replica loss")
+            return orig_step(*a, **k)
+
+        victim.engine.step = flaky_step
+        gids, wall = _drive_router(router, load, arrivals)
+    finally:
+        os.environ.pop("HVD_TPU_FLEET_REPLICA_ERRORS", None)
+        os.environ.pop("HVD_TPU_SERVE_HEDGE", None)
+    row = _fleet_row("fleet_recovery", router, gids, wall)
+    row["migrations"] = len(router.recovery)
+    row["migrations_warm"] = sum(
+        1 for x in router.recovery if x["path"] == "warm")
+    row["migration_ms"] = round(router.migration_ms(), 3)
+    row["hedge_rate"] = round(router.hedge_rate(), 4)
+    if not router.recovery:
+        print("FLEET RECOVERY LEG: the ejection migrated nothing",
+              file=sys.stderr)
+        return None
+    for i, out in enumerate(outs["fleet_rr"]):
+        if not np.array_equal(out, router.results[gids[i]]):
+            print(f"FLEET RECOVERY ORACLE MISMATCH on request {i}",
+                  file=sys.stderr)
+            return None
+    rows.append(row)
     return rows
 
 
@@ -734,7 +777,7 @@ def main():
             return 1
         for row in rows:
             print(json.dumps(row))
-        rr, aff, sc = rows[0], rows[1], rows[2]
+        rr, aff, sc, rec = rows[0], rows[1], rows[2], rows[3]
         print(
             f"fleet x{rr['replicas']}: affinity hit rate "
             f"{aff['prefix_hit_rate']} vs rr {rr['prefix_hit_rate']} "
@@ -743,8 +786,11 @@ def main():
             f"({aff['affinity_vs_rr']['ttft_p99_x']}x); scale leg "
             f"peaked at {sc['max_replicas']} replicas "
             f"({sc['scale_out_events']} out / "
-            f"{sc['scale_in_events']} in), oracle token-identical, "
-            f"all replicas compile-free={aff['compile_free'] and rr['compile_free'] and sc['compile_free']}",
+            f"{sc['scale_in_events']} in); recovery leg migrated "
+            f"{rec['migrations']} requests ({rec['migrations_warm']} warm) "
+            f"in {rec['migration_ms']}ms avg at hedge rate "
+            f"{rec['hedge_rate']}; oracle token-identical, "
+            f"all replicas compile-free={aff['compile_free'] and rr['compile_free'] and sc['compile_free'] and rec['compile_free']}",
             file=sys.stderr)
         return 0
 
