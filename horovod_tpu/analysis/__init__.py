@@ -8,17 +8,21 @@ registries.  Drift between them is a *silent-crash* class: a wrong
 ``argtypes`` corrupts the native stack at call time, an uncatalogued
 chaos site is a fault rule that never fires, an undocumented knob is a
 knob nobody finds.  This package checks all of it in milliseconds with
-five stdlib-only passes:
+stdlib-only passes — seven bare-box AST/regex passes plus one
+jax-gated program verifier:
 
-====== =====================================================
-pass   contract
-====== =====================================================
-c-api  c_api.cc declarations == every ctypes restype/argtypes
-env    HVD_TPU_* reads == docs/running.md rows; no raw parses
-metrics code-built names ⊆ instruments.py ⊆ docs/METRICS.md
-chaos  point() sites == native Decide sites == doc site table
-trace  span/event sites == trace SITES == docs/TRACING.md
-====== =====================================================
+=========== =====================================================
+pass        contract
+=========== =====================================================
+c-api       c_api.cc declarations == every ctypes restype/argtypes
+env         HVD_TPU_* reads == docs/running.md rows; no raw parses
+metrics     code-built names ⊆ instruments.py ⊆ docs/METRICS.md
+chaos       point() sites == native Decide sites == doc site table
+trace       span/event sites == trace SITES == docs/TRACING.md
+locks       lock-order acyclic; no mixed guarded/unguarded writes
+collectives no rank-gated collectives; raw lax.p* only in ops//parallel/
+programs    lowered-program invariants (jax; HVD_TPU_VERIFY_PROGRAMS=1)
+=========== =====================================================
 
 Run it::
 
@@ -37,7 +41,8 @@ import sys
 import time
 from typing import Callable, Dict, List, Optional, Sequence
 
-from . import c_api, chaos_sites, envvars, metrics_catalogue, trace_sites
+from . import (c_api, chaos_sites, collectives, envvars, locks,
+               metrics_catalogue, programs, trace_sites)
 from ._common import Finding, Suppressions
 
 __all__ = ["Finding", "PASSES", "run_all", "main"]
@@ -48,6 +53,9 @@ PASSES: Dict[str, Callable[[str], List[Finding]]] = {
     "metrics": metrics_catalogue.run,
     "chaos": chaos_sites.run,
     "trace": trace_sites.run,
+    "locks": locks.run,
+    "collectives": collectives.run,
+    "programs": programs.run,
 }
 
 

@@ -1,0 +1,181 @@
+"""Collective-discipline lint (pass #7, ``collectives``).
+
+Two classes of drift that deadlock or silently mis-account a
+distributed program, both checkable from the AST:
+
+* **rank-gated collective** — a collective call (``allreduce*``,
+  ``allgather*``, ``psum``, ``ppermute``, ``all_to_all``, ...) inside
+  control flow conditioned on the caller's rank / process identity.
+  Collectives are rendezvous points: if rank 0 takes the branch and
+  rank 1 does not, the fleet hangs at the next matched call — the
+  classic mismatched-collective deadlock, invisible until the branch
+  actually diverges.  Branching on *world size* is fine (every rank
+  agrees on it); branching on *rank* is not.
+* **raw lax collective outside ops//parallel/** — ``jax.lax.psum`` and
+  friends called directly from other layers bypass the public API's
+  reduction-op semantics, hierarchical routing, and byte accounting
+  (``ops/comm_model``'s modeled == measured discipline assumes the
+  ``ops``/``parallel`` entry points are the only collective authors).
+
+Suppress a justified exception with ``contract-ok: collectives --
+<why>`` (docs/ANALYSIS.md); a legitimate rank branch must explain why
+every rank still reaches a matched call.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Optional, Set
+
+from ._common import Finding, iter_py_files, read_text
+
+CHECK = "collectives"
+
+#: directories whose modules ARE the public collective layer.
+_COLLECTIVE_LAYERS = ("horovod_tpu/ops/", "horovod_tpu/parallel/")
+
+#: terminal call names that are collective rendezvous points.
+_COLLECTIVE_PREFIXES = (
+    "allreduce", "allgather", "alltoall", "all_to_all", "reducescatter",
+    "reduce_scatter", "hierarchical_allreduce", "grouped_allreduce",
+)
+_COLLECTIVE_NAMES = {
+    "psum", "psum_scatter", "pmean", "pmax", "pmin", "ppermute",
+    "pbroadcast", "all_gather", "broadcast", "barrier",
+}
+#: non-collective lookalikes the prefix match must not trip on.
+_FALSE_FRIENDS = {
+    "broadcast_to", "broadcast_arrays", "broadcast_shapes",
+    "broadcast_in_dim", "barrier_wait",
+}
+
+#: lax primitives only ops//parallel/ may author.
+_LAX_COLLECTIVES = {
+    "psum", "psum_scatter", "pmean", "pmax", "pmin", "ppermute",
+    "all_gather", "all_to_all", "pbroadcast",
+}
+
+#: identifiers whose value diverges per rank — branching on them gates
+#: the branch body per rank.
+_RANK_TOKENS = {
+    "rank", "local_rank", "node_rank", "cross_rank", "cross_size_rank",
+    "process_index", "process_id", "rank_id", "my_rank", "worker_index",
+    "task_index",
+}
+
+
+def _dotted(node: ast.AST) -> str:
+    parts: List[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+    return ".".join(reversed(parts))
+
+
+def _is_collective_call(name: str) -> bool:
+    terminal = name.rsplit(".", 1)[-1]
+    if terminal in _FALSE_FRIENDS:
+        return False
+    return (terminal in _COLLECTIVE_NAMES
+            or terminal.startswith(_COLLECTIVE_PREFIXES))
+
+
+def _rank_token_in(test: ast.AST) -> Optional[str]:
+    """The first rank-valued identifier the branch condition reads."""
+    for node in ast.walk(test):
+        name = None
+        if isinstance(node, ast.Name):
+            name = node.id
+        elif isinstance(node, ast.Attribute):
+            name = node.attr
+        if name is not None and name.lstrip("_") in _RANK_TOKENS:
+            return name
+    return None
+
+
+class _Scan(ast.NodeVisitor):
+    def __init__(self, rel: str, findings: List[Finding]):
+        self.rel = rel
+        self.findings = findings
+        self.in_layer = rel.startswith(_COLLECTIVE_LAYERS)
+        self._rank_gate: List[str] = []
+
+    def _visit_gated(self, node: ast.stmt, bodies) -> None:
+        token = _rank_token_in(node.test)
+        if token is None:
+            self.visit(node.test)
+            for body in bodies:
+                for stmt in body:
+                    self.visit(stmt)
+            return
+        self.visit(node.test)
+        self._rank_gate.append(token)
+        for body in bodies:
+            for stmt in body:
+                self.visit(stmt)
+        self._rank_gate.pop()
+
+    def visit_If(self, node: ast.If) -> None:
+        # both arms diverge per rank: the else of `if rank() == 0` is
+        # exactly as rank-conditional as the body
+        self._visit_gated(node, (node.body, node.orelse))
+
+    def visit_While(self, node: ast.While) -> None:
+        self._visit_gated(node, (node.body, node.orelse))
+
+    def visit_IfExp(self, node: ast.IfExp) -> None:
+        token = _rank_token_in(node.test)
+        if token is None:
+            self.generic_visit(node)
+            return
+        self.visit(node.test)
+        self._rank_gate.append(token)
+        self.visit(node.body)
+        self.visit(node.orelse)
+        self._rank_gate.pop()
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = _dotted(node.func)
+        terminal = name.rsplit(".", 1)[-1]
+        if self._rank_gate and _is_collective_call(name):
+            self.findings.append(Finding(
+                CHECK, self.rel, node.lineno, terminal,
+                f"collective {terminal!r} under rank-conditional control "
+                f"flow (branch tests {self._rank_gate[-1]!r}): ranks that "
+                "skip the branch never reach the rendezvous — the "
+                "mismatched-collective deadlock; hoist the call out of "
+                "the branch or mask its inputs instead",
+            ))
+        parent = name.rsplit(".", 2)
+        if (not self.in_layer
+                and terminal in _LAX_COLLECTIVES
+                and len(parent) >= 2 and parent[-2] == "lax"):
+            self.findings.append(Finding(
+                CHECK, self.rel, node.lineno, f"lax.{terminal}",
+                f"raw lax.{terminal} outside ops//parallel/ bypasses the "
+                "public collective API (reduce-op semantics, hierarchical "
+                "routing, comm_model byte accounting) — call the "
+                "horovod_tpu.ops spelling instead",
+            ))
+        self.generic_visit(node)
+
+
+def run(root: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for rel in iter_py_files(root):
+        text = read_text(os.path.join(root, rel))
+        if text is None:
+            continue
+        try:
+            tree = ast.parse(text)
+        except SyntaxError as e:
+            findings.append(Finding(
+                CHECK, rel, e.lineno or 0, "syntax",
+                f"unparseable module: {e.msg}"))
+            continue
+        _Scan(rel, findings).visit(tree)
+    return findings

@@ -31,6 +31,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..ops import spmd_ops
+from ..ops.reduce_ops import Sum
+
 
 # Named activation-remat policies for the decoder blocks (Chen et al.,
 # 2016 sublinear memory; jax.checkpoint / jax.checkpoint_policies).  What
@@ -367,7 +370,7 @@ class Attention(nn.Module):
             # local head slice (the kernel is an (H/tp, d, D) row slice
             # of the global one); ONE psum reassembles the sublayer —
             # the first of Megatron's two collectives per block
-            out = jax.lax.psum(out, cfg.shard_axis)
+            out = spmd_ops.allreduce(out, op=Sum, axis=cfg.shard_axis)
         return out
 
 
@@ -391,7 +394,7 @@ class MlpBlock(nn.Module):
             cfg.d_model, dtype=cfg.dtype, use_bias=False, name="down"
         )(nn.silu(gate) * up)
         if tp > 1:
-            out = jax.lax.psum(out, cfg.shard_axis)
+            out = spmd_ops.allreduce(out, op=Sum, axis=cfg.shard_axis)
         return out
 
 

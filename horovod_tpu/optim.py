@@ -767,15 +767,8 @@ def ZeroSpmdOptimizer(
             if not feedback:
                 new_residual = None
         else:
-            def rs(buf):
-                r = jax.lax.psum_scatter(
-                    buf, axis, scatter_dimension=0, tiled=True
-                )
-                if op == ReduceOp.AVERAGE:
-                    r = r / jnp.asarray(world, r.dtype)
-                return r
-
-            g_shards = [rs(buf) for buf in g_bufs]
+            g_shards = [spmd_ops.reducescatter(buf, op=op, axis=axis)
+                        for buf in g_bufs]
         p_bufs = plan.flatten(jax.tree_util.tree_leaves(params))
         p_shards = _slice_shards(plan, p_bufs, me)
         u_shards, new_inner = optimizer.update(
@@ -789,9 +782,7 @@ def ZeroSpmdOptimizer(
                 for u in u_shards
             ]
         else:
-            u_bufs = [
-                jax.lax.all_gather(u, axis, tiled=True) for u in u_shards
-            ]
+            u_bufs = [spmd_ops.allgather(u, axis=axis) for u in u_shards]
         updates = jax.tree_util.tree_unflatten(
             treedef, plan.unflatten(u_bufs)
         )

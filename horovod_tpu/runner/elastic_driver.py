@@ -422,6 +422,7 @@ class ElasticDriver:
                         # a preempted worker left on its own (unlike a
                         # driver-ordered scale-down, where the epoch ran
                         # first): the survivors need a planned reset epoch
+                        # contract-ok: locks -- _observe_exits runs with self._cv held (docstring contract; every caller acquires it)
                         self._leaver_exited = True
                     if code != 0:
                         log.warning(

@@ -573,6 +573,23 @@ class ServingEngine:
             jnp.ones((bt,), jnp.int32), jnp.zeros((bt,), jnp.int32),
             pages=pt).as_text()
 
+    def lowered_mixed_text(self, batch_tier: Optional[int] = None,
+                           chunk_tier: Optional[int] = None,
+                           pages: Optional[int] = None) -> str:
+        """StableHLO text of ONE mixed-step program (smallest batch and
+        chunk tiers by default; ``pages=None`` = the prefill-mixed
+        ``max_blocks``-wide gather).  The mixed/speculative twin of
+        :meth:`lowered_decode_text` — the ``programs`` contract pass
+        runs the same collective inventories over every program FAMILY
+        the engine dispatches, not just plain decode."""
+        bt = batch_tier or self.decode_tiers[0]
+        c = chunk_tier or self.chunk_tiers[0]
+        tables = jnp.zeros((bt, self.max_blocks_per_seq), jnp.int32)
+        return self._mixed_fn.lower(
+            self.params, self.k_pool, self.v_pool, tables,
+            jnp.zeros((bt,), jnp.int32), jnp.ones((bt,), jnp.int32),
+            jnp.zeros((bt, c), jnp.int32), pages=pages).as_text()
+
     def warmup(self) -> int:
         """Compile the WHOLE tier menu up front — every (batch tier,
         chunk tier) mixed program, every (batch tier, page tier) decode

@@ -88,10 +88,7 @@ def _overlap_bucket_reduce(axis, op, world):
     steps stay bit-equal."""
 
     def bucket_reduce(buf):
-        red = jax.lax.psum(buf, axis)
-        if op == ReduceOp.AVERAGE:
-            red = red / jnp.asarray(world, red.dtype)
-        return red
+        return spmd_ops.allreduce(buf, op=op, axis=axis)
 
     return bucket_reduce
 
@@ -359,6 +356,7 @@ def zero_train_setup(
         # a tuple axis (the hierarchical fabric mesh) means over both
         if isinstance(axis, tuple):
             return jax.tree_util.tree_map(
+                # contract-ok: collectives -- unconditional scalar loss mean over BOTH fabric axes; the single-axis public API cannot spell a tuple-axis psum
                 lambda t: jax.lax.psum(t, axis)
                 / jnp.asarray(world, t.dtype),
                 x,
@@ -386,9 +384,7 @@ def zero_train_setup(
                 padded, ICI_AXIS, DCN_AXIS, dcn_compression, None
             )
         else:
-            shard = jax.lax.psum_scatter(
-                padded, axis, scatter_dimension=0, tiled=True
-            )
+            shard = spmd_ops.reducescatter(padded, axis=axis)
         if op == ReduceOp.AVERAGE:
             shard = shard / jnp.asarray(world, shard.dtype)
         if hierarchical:
@@ -404,7 +400,7 @@ def zero_train_setup(
                 shard, ICI_AXIS, DCN_AXIS, None
             )
         else:
-            red = jax.lax.all_gather(shard, axis, tiled=True)
+            red = spmd_ops.allgather(shard, axis=axis)
         return red[: buf.size] if pad else red
 
     def _zero_diag(loss, updates):

@@ -394,6 +394,282 @@ def test_chaos_native_divergence_caught(tmp_path):
     assert findings[0].file.endswith("t.h")
 
 
+# -- locks pass on synthetic trees -------------------------------------------
+
+_SYN_LOCKS_HEAD = "import threading\n\n\n"
+
+
+def test_locks_clean_tree_passes(tmp_path):
+    _write(tmp_path, "horovod_tpu/pool.py", _SYN_LOCKS_HEAD + (
+        "class Pool:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._items = []\n"
+        "        threading.Thread(target=self._worker).start()\n\n"
+        "    def _worker(self):\n"
+        "        with self._lock:\n"
+        "            self._items = []\n\n"
+        "    def add(self, x):\n"
+        "        with self._lock:\n"
+        "            self._items = [x]\n"
+    ))
+    assert analysis.run_all(str(tmp_path), ["locks"]) == []
+
+
+def test_locks_order_inversion_caught(tmp_path):
+    _write(tmp_path, "horovod_tpu/pair.py", _SYN_LOCKS_HEAD + (
+        "class Pair:\n"
+        "    def __init__(self):\n"
+        "        self._a = threading.Lock()\n"
+        "        self._b = threading.Lock()\n\n"
+        "    def one(self):\n"
+        "        with self._a:\n"
+        "            with self._b:\n"
+        "                pass\n\n"
+        "    def two(self):\n"
+        "        with self._b:\n"
+        "            with self._a:\n"
+        "                pass\n"
+    ))
+    findings = analysis.run_all(str(tmp_path), ["locks"])
+    assert [f.file for f in findings] == ["horovod_tpu/pair.py"]
+    assert findings[0].key == "Pair._a->Pair._b->Pair._a"
+    assert "inversion" in findings[0].message
+
+
+def test_locks_interprocedural_inversion_caught(tmp_path):
+    """One level of same-class calls: a method holding A calls a method
+    that takes B (and vice versa) — the same deadlock, split across
+    method bodies."""
+    _write(tmp_path, "horovod_tpu/indirect.py", _SYN_LOCKS_HEAD + (
+        "class Indirect:\n"
+        "    def __init__(self):\n"
+        "        self._a = threading.Lock()\n"
+        "        self._b = threading.Lock()\n\n"
+        "    def take_b(self):\n"
+        "        with self._b:\n"
+        "            pass\n\n"
+        "    def take_a(self):\n"
+        "        with self._a:\n"
+        "            pass\n\n"
+        "    def one(self):\n"
+        "        with self._a:\n"
+        "            self.take_b()\n\n"
+        "    def two(self):\n"
+        "        with self._b:\n"
+        "            self.take_a()\n"
+    ))
+    findings = analysis.run_all(str(tmp_path), ["locks"])
+    assert [f.key for f in findings] == ["Indirect._a->Indirect._b->"
+                                         "Indirect._a"]
+
+
+def test_locks_mixed_guarded_unguarded_write_caught(tmp_path):
+    _write(tmp_path, "horovod_tpu/counter.py", _SYN_LOCKS_HEAD + (
+        "class Counter:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.n = 0\n"
+        "        threading.Thread(target=self._run).start()\n\n"
+        "    def _run(self):\n"
+        "        with self._lock:\n"
+        "            self.n = 1\n\n"
+        "    def reset(self):\n"
+        "        self.n = 0\n"
+    ))
+    findings = analysis.run_all(str(tmp_path), ["locks"])
+    assert [(f.key, f.file) for f in findings] == [
+        ("Counter.n", "horovod_tpu/counter.py")]
+    assert "races every guarded reader" in findings[0].message
+
+
+def test_locks_thread_target_write_race_caught(tmp_path):
+    _write(tmp_path, "horovod_tpu/racer.py", _SYN_LOCKS_HEAD + (
+        "class Racer:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.state = 0\n"
+        "        threading.Thread(target=self._run).start()\n\n"
+        "    def _run(self):\n"
+        "        self.state = 1\n\n"
+        "    def poke(self):\n"
+        "        self.state = 2\n"
+    ))
+    findings = analysis.run_all(str(tmp_path), ["locks"])
+    assert [f.key for f in findings] == ["Racer.state"]
+    assert "write/write race" in findings[0].message
+
+
+def test_locks_unthreaded_class_not_flagged(tmp_path):
+    """A class that never spawns threads may write freely — the
+    shared-state rules only engage once concurrency exists."""
+    _write(tmp_path, "horovod_tpu/single.py", _SYN_LOCKS_HEAD + (
+        "class Single:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.n = 0\n\n"
+        "    def guarded(self):\n"
+        "        with self._lock:\n"
+        "            self.n = 1\n\n"
+        "    def bare(self):\n"
+        "        self.n = 2\n"
+    ))
+    assert analysis.run_all(str(tmp_path), ["locks"]) == []
+
+
+def test_locks_inline_marker_suppresses(tmp_path):
+    _write(tmp_path, "horovod_tpu/counter.py", _SYN_LOCKS_HEAD + (
+        "class Counter:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.n = 0\n"
+        "        threading.Thread(target=self._run).start()\n\n"
+        "    def _run(self):\n"
+        "        with self._lock:\n"
+        "            self.n = 1\n\n"
+        "    def reset(self):\n"
+        "        # contract-ok: locks -- reset only runs pre-start\n"
+        "        self.n = 0\n"
+    ))
+    assert analysis.run_all(str(tmp_path), ["locks"]) == []
+
+
+# -- collectives pass on synthetic trees --------------------------------------
+
+
+def test_collectives_clean_tree_passes(tmp_path):
+    # raw lax inside ops/ is the public layer's own right; world-size
+    # branches agree on every rank; broadcast_to is a false friend
+    _write(tmp_path, "horovod_tpu/ops/spmd.py",
+           "import jax\ndef f(x):\n    return jax.lax.psum(x, 'w')\n")
+    _write(tmp_path, "horovod_tpu/mod.py", (
+        "import jax.numpy as jnp\n"
+        "def g(x, size):\n"
+        "    if size > 1:\n"
+        "        x = allreduce(x)\n"
+        "    return jnp.broadcast_to(x, (2,) + x.shape)\n"
+    ))
+    assert analysis.run_all(str(tmp_path), ["collectives"]) == []
+
+
+def test_collectives_rank_gated_allreduce_caught(tmp_path):
+    _write(tmp_path, "horovod_tpu/mod.py", (
+        "def g(x, rank):\n"
+        "    if rank == 0:\n"
+        "        x = allreduce(x)\n"
+        "    return x\n"
+    ))
+    findings = analysis.run_all(str(tmp_path), ["collectives"])
+    assert [(f.key, f.file, f.line) for f in findings] == [
+        ("allreduce", "horovod_tpu/mod.py", 3)]
+    assert "rendezvous" in findings[0].message
+
+
+def test_collectives_rank_gated_else_arm_caught(tmp_path):
+    """The else of a rank branch is exactly as rank-conditional as the
+    body — a collective there diverges the same way."""
+    _write(tmp_path, "horovod_tpu/mod.py", (
+        "def g(x):\n"
+        "    if process_index() == 0:\n"
+        "        pass\n"
+        "    else:\n"
+        "        x = barrier(x)\n"
+        "    return x\n"
+    ))
+    findings = analysis.run_all(str(tmp_path), ["collectives"])
+    assert [f.key for f in findings] == ["barrier"]
+
+
+def test_collectives_raw_lax_outside_ops_caught(tmp_path):
+    _write(tmp_path, "horovod_tpu/train2.py", (
+        "import jax\n"
+        "def step(g):\n"
+        "    return jax.lax.psum(g, 'world')\n"
+    ))
+    findings = analysis.run_all(str(tmp_path), ["collectives"])
+    assert [(f.key, f.line) for f in findings] == [("lax.psum", 3)]
+    assert "bypasses the public collective API" in findings[0].message
+
+
+def test_collectives_inline_marker_suppresses(tmp_path):
+    _write(tmp_path, "horovod_tpu/train2.py", (
+        "import jax\n"
+        "def step(g, axes):\n"
+        "    # contract-ok: collectives -- tuple-axis psum the public "
+        "API cannot spell\n"
+        "    return jax.lax.psum(g, axes)\n"
+    ))
+    assert analysis.run_all(str(tmp_path), ["collectives"]) == []
+
+
+# -- programs pass: gate + pure check helpers ---------------------------------
+
+_SYN_HLO_LOCAL = (
+    '%1 = "stablehlo.all_reduce"(%0) {replica_groups = '
+    "dense<[[0, 1]]> : tensor<1x2xi64>} : "
+    "(tensor<128xf32>) -> tensor<128xf32>\n"
+)
+_SYN_HLO_SPANNING = (
+    '%1 = "stablehlo.all_reduce"(%0) {replica_groups = '
+    "dense<[[0, 4]]> : tensor<1x2xi64>} : "
+    "(tensor<128xf32>) -> tensor<128xf32>\n"
+)
+_TWO_SLICES = [0, 0, 0, 0, 1, 1, 1, 1]
+
+
+def test_programs_pass_is_gated_off_bare(monkeypatch):
+    from horovod_tpu.analysis import programs
+
+    monkeypatch.delenv(programs.ENV_GATE, raising=False)
+    assert programs.run(REPO) == []
+    assert set(analysis.PASSES) == {
+        "c-api", "env", "metrics", "chaos", "trace", "locks",
+        "collectives", "programs"}
+
+
+def test_programs_dcn_exclusion_helper():
+    from horovod_tpu.analysis import programs
+
+    assert programs.check_dcn_exclusion(
+        "decode:b1", _SYN_HLO_LOCAL, _TWO_SLICES) == []
+    findings = programs.check_dcn_exclusion(
+        "decode:b1", _SYN_HLO_SPANNING, _TWO_SLICES)
+    assert [f.key for f in findings] == ["serve-dcn:decode:b1:all_reduce"]
+    assert "spans >1 slice" in findings[0].message
+
+
+def test_programs_byte_identity_and_collective_budget_helpers():
+    from horovod_tpu.analysis import programs
+
+    assert programs.check_byte_identical("guard", _SYN_HLO_LOCAL,
+                                         _SYN_HLO_LOCAL) == []
+    drift = programs.check_byte_identical(
+        "guard", _SYN_HLO_LOCAL, _SYN_HLO_LOCAL + _SYN_HLO_LOCAL)
+    assert [f.key for f in drift] == ["byte-identical:guard"]
+    assert "+1 collective" in drift[0].message
+    assert programs.check_added_collectives(
+        "guard", _SYN_HLO_LOCAL, _SYN_HLO_LOCAL) == []
+    grew = programs.check_added_collectives(
+        "guard", _SYN_HLO_LOCAL, _SYN_HLO_LOCAL + _SYN_HLO_SPANNING)
+    assert [f.key for f in grew] == ["added-collectives:guard"]
+
+
+def test_programs_menu_and_model_helpers():
+    from horovod_tpu.analysis import programs
+
+    warmed = {("decode", 1, 8), ("mixed", 1, 8, None)}
+    assert programs.check_menu_keys("e", warmed, set(warmed)) == []
+    off = programs.check_menu_keys(
+        "e", warmed, warmed | {("decode", 16, 8)})
+    assert [f.key for f in off] == ["off-menu:e:decode-16-8"]
+    assert "never warmed" in off[0].message
+    assert programs.check_modeled_measured(
+        "h", {"ici": 10, "dcn": 2}, {"ici": 10, "dcn": 2}) == []
+    bad = programs.check_modeled_measured(
+        "h", {"ici": 10, "dcn": 2}, {"ici": 10, "dcn": 0})
+    assert [f.key for f in bad] == ["model-mismatch:h:dcn"]
+
+
 # -- suppression machinery ----------------------------------------------------
 
 

@@ -4,9 +4,10 @@
 Loads ``horovod_tpu.analysis`` WITHOUT executing the package's
 ``__init__`` (which imports jax) by pre-registering a stub parent
 package — so this runs on a bare CI box with nothing installed, in
-well under a second::
+seconds (the jax-gated ``programs`` pass reports empty here; run it
+via tools/verify_programs.py)::
 
-    python tools/check.py              # all four passes
+    python tools/check.py              # all eight passes (7 live bare-box)
     python tools/check.py env chaos    # a subset
     python tools/check.py --list-c-symbols   # for rebuild_native.sh
 
