@@ -80,6 +80,7 @@ SITES = (
     "serve.replica_step",      # one fleet replica's engine step
     "serve.migrate",           # KV snapshot wire on the warm recovery path
     "serve.snapshot",          # periodic in-flight KV export (replica)
+    "serve.handoff",           # kvsnap wire at the prefill->decode boundary
 )
 
 

@@ -184,6 +184,10 @@ class EndpointSignalSource:
       step_time     q0.50 of ``hvd_tpu_step_duration_seconds`` deltas
       throughput    rate of ``hvd_tpu_serve_steps_total`` between
                     scrapes
+      decode_tokens_per_s
+                    emitted-token rate (token-latency ``_count``
+                    deltas) per scraped endpoint — the disaggregated
+                    decode tier's throughput-floor signal
 
     Unreachable endpoints contribute nothing (the policy holds on "no
     watched signals" rather than act on a partial picture when every
@@ -280,6 +284,19 @@ class EndpointSignalSource:
                 prev_steps = sum(v for (n, _l), v in self._prev.items()
                                  if n == self.STEPS_TOTAL)
                 out["throughput"] = max(0.0, steps - prev_steps) / dt
+                # decode-tier throughput per scraped endpoint: the
+                # token-latency histogram's _count is one observation
+                # per emitted token, so its scrape-to-scrape rate is
+                # tokens/s — divided per endpoint it is the
+                # decode_tokens_per_s floor signal the disaggregated
+                # router's decode policy watches (docs/FLEET.md)
+                toks = sum(v for (n, _l), v in cur.items()
+                           if n == self.LATENCY + "_count")
+                prev_toks = sum(v for (n, _l), v in self._prev.items()
+                                if n == self.LATENCY + "_count")
+                out["decode_tokens_per_s"] = (
+                    max(0.0, toks - prev_toks) / dt
+                    / max(1, len(self.urls)))
         self._prev, self._prev_at = cur, now
         return out
 

@@ -58,7 +58,7 @@ from ..common.retry import env_float, env_int
 
 __all__ = [
     "Decision", "SchedulePolicy", "Target", "TargetTrackingPolicy",
-    "histogram_quantile", "snapshot_signals",
+    "decode_policy_from_env", "histogram_quantile", "snapshot_signals",
 ]
 
 # the SLO knobs (docs/running.md): a target is armed iff its variable
@@ -67,6 +67,11 @@ ENV_TTFT_SLO = "HVD_TPU_FLEET_TTFT_SLO"
 ENV_QUEUE_SLO = "HVD_TPU_FLEET_QUEUE_SLO"
 ENV_STEP_TIME_SLO = "HVD_TPU_FLEET_STEP_TIME_SLO"
 ENV_THROUGHPUT_FLOOR = "HVD_TPU_FLEET_THROUGHPUT_FLOOR"
+#: decode-tier throughput floor (tokens/s per accepting decode
+#: replica) for the disaggregated serving fleet — kept OUT of
+#: :meth:`TargetTrackingPolicy.from_env` so setting it never arms a
+#: decode target on a training fleet's policy (docs/FLEET.md)
+ENV_DECODE_TPS_FLOOR = "HVD_TPU_FLEET_DECODE_TPS_FLOOR"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -412,6 +417,30 @@ def snapshot_signals(snap: dict, prev: Optional[dict] = None,
                 _series_sum(prev_e) if prev_e else 0.0)
             out["throughput"] = max(0.0, delta) / dt
     return out
+
+
+def decode_policy_from_env() -> Optional["TargetTrackingPolicy"]:
+    """The disaggregated router's decode-tier policy
+    (``HVD_TPU_FLEET_DECODE_TPS_FLOOR``, docs/FLEET.md): a floor-style
+    target on ``decode_tokens_per_s`` — per-replica decode throughput
+    UNDER the floor reads as overload (too few decode replicas for the
+    handoff inflow), so the decode tier scales out; comfortably above
+    it, the hysteresis/cooldown dampers let it shed.  Returns None
+    unless the floor is set positive.  The prefill tier keeps the
+    generic :meth:`TargetTrackingPolicy.from_env` (TTFT-shaped — time
+    to first token is decided entirely before the handoff)."""
+    floor = env_float(ENV_DECODE_TPS_FLOOR, 0.0)
+    if floor <= 0:
+        return None
+    return TargetTrackingPolicy(
+        [Target("decode_tokens_per_s", floor, invert=True)],
+        min_size=env_int("HVD_TPU_FLEET_MIN", 1),
+        max_size=env_int("HVD_TPU_FLEET_MAX", 8),
+        deadband=env_float("HVD_TPU_FLEET_DEADBAND", 0.1),
+        scale_in_at=env_float("HVD_TPU_FLEET_SCALE_IN_AT", 0.5),
+        hysteresis=env_int("HVD_TPU_FLEET_HYSTERESIS", 3),
+        cooldown_s=env_float("HVD_TPU_FLEET_COOLDOWN", 30.0),
+    )
 
 
 ENV_PLAN = "HVD_TPU_FLEET_PLAN"

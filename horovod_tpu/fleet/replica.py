@@ -75,10 +75,17 @@ class ServingReplica:
     """One engine's lifecycle wrapper (module docstring)."""
 
     def __init__(self, name: str, build_fn: Callable[[], object], *,
-                 clock=time.perf_counter):
+                 tier: str = "mixed", clock=time.perf_counter):
         self.name = str(name)
         self._build = build_fn
         self._clock = clock
+        #: placement tier in a disaggregated fleet: ``"prefill"``
+        #: (engine role ``prefill`` — requests leave at the handoff
+        #: boundary), ``"decode"`` (full-menu engine that receives the
+        #: migrated KV), or ``"mixed"`` (the single-tier default; both
+        #: phases on every replica).  Pure routing metadata — the
+        #: lifecycle below is tier-blind (docs/FLEET.md).
+        self.tier = str(tier)
         self.state = NEW
         self.engine = None
         self.warmed_programs = 0
@@ -126,6 +133,10 @@ class ServingReplica:
             attempts=max(1, env_int(ENV_SPAWN_RETRIES, 3)),
             describe=f"serving replica {self.name} build",
         )
+        # every kvsnap this engine exports names its sender, so a
+        # chain-hash reject on the far side of a handoff or migration
+        # points at the originating replica (satellite: kvsnap source)
+        self.engine.snap_source = self.name
         self.warmed_programs = self.engine.warmup()
         self.engine.token_log = []
         self.state = PARKED if park else READY
@@ -151,8 +162,15 @@ class ServingReplica:
 
     @property
     def drained(self) -> bool:
-        """True once nothing is left in flight (the teardown gate)."""
-        return self.engine is None or not self.has_work
+        """True once nothing is left in flight (the teardown gate).
+        A parked handoff counts as in flight: the snapshot only lives
+        in this engine until the router's next collection pass, so a
+        prefill-tier replica retiring mid-drain must hold its engine
+        until every handoff has been picked up."""
+        if self.engine is None:
+            return True
+        return not self.has_work and not getattr(
+            self.engine, "handoffs", None)
 
     def retire(self) -> None:
         """Release the engine (params + KV pools) and health source.

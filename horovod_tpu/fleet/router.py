@@ -37,6 +37,25 @@ contract); scale-in picks the accepting replica with the least queued
 work, **drains** it (no new placements; in-flight and queued
 sequences step to completion) and retires it only once empty.
 
+**Disaggregation** (``prefill_replicas > 0`` /
+``HVD_TPU_FLEET_PREFILL_REPLICAS``; ROADMAP item 2, the Splitwise /
+DistServe shape): the fleet splits into a **prefill tier** (engines
+built with ``role="prefill"`` — mixed chunk programs only, requests
+leave at the handoff boundary) and a **decode tier** (full-menu
+engines).  A request routes into the prefill tier, chunks its prompt
+there, and at prefill completion its paged-KV block chain crosses the
+tier boundary as a ``kvsnap/1`` snapshot (chaos site
+``serve.handoff``): chain-hash verified re-registration on a decode
+replica (**warm** — decode re-prefixes from cache, zero prefill
+recompute) or, when the wire drops/corrupts, a deterministic cold
+re-prefill.  Decode steps never share a batch with prefill chunks
+again — the interference chunking only *bounded* is structurally
+gone.  Each tier scales on its own signal: TTFT drives the prefill
+tier (``policy``), per-replica decode tokens/s drives the decode tier
+(``decode_policy`` / ``HVD_TPU_FLEET_DECODE_TPS_FLOOR``).  Placement
+still moves time, never values — the handoff is the PR-18 migration
+machinery on the happy path, so outputs stay token-identical.
+
 The router is single-threaded and in-process: callers drive it with
 :meth:`submit` + :meth:`step` (or :meth:`run_until_drained`), the
 same way the engine itself is driven.  That is the bench/CI shape;
@@ -48,6 +67,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import inspect
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -57,9 +77,10 @@ from .. import chaos as _chaos
 from .. import trace as _trace
 from ..common.retry import env_float, env_int
 from ..metrics import instruments as _instr
+from ..ops.comm_model import measured_kvsnap_bytes
 from ..trace import flight as _flight
 from ..utils.logging import get_logger
-from .policy import TargetTrackingPolicy
+from .policy import TargetTrackingPolicy, decode_policy_from_env
 from .replica import DRAINING, PARKED, READY, RETIRED, ServingReplica
 
 __all__ = ["FleetRouter"]
@@ -102,6 +123,11 @@ class _Placement:
     hedged: bool = False
     #: router-clock stamp of the current dispatch (the hedge age base)
     placed_at: Optional[float] = None
+    #: which tier the request currently lives on: ``"mixed"`` (the
+    #: single-tier fleet), ``"prefill"`` (disagg, pre-handoff) or
+    #: ``"decode"`` (disagg, post-handoff) — hedging and ejection
+    #: survivor walks stay within the placement's tier
+    tier: str = "mixed"
 
 _ROUTE_AFFINITY = _instr.FLEET_ROUTED.labels("affinity")
 _ROUTE_LEAST_QUEUE = _instr.FLEET_ROUTED.labels("least_queue")
@@ -111,6 +137,13 @@ _MIGRATE_COLD = _instr.SERVE_MIGRATIONS.labels("cold")
 _HEDGE_WON = _instr.SERVE_HEDGES.labels("won")
 _HEDGE_LOST = _instr.SERVE_HEDGES.labels("lost")
 _HEDGE_SUPPRESSED = _instr.SERVE_HEDGES.labels("suppressed")
+_HANDOFF_WARM = _instr.SERVE_HANDOFFS.labels("warm")
+_HANDOFF_COLD = _instr.SERVE_HANDOFFS.labels("cold")
+
+#: prefill-tier replica count: > 0 turns disaggregation on (the
+#: ``replicas`` argument then sizes the decode tier); 0 (default)
+#: keeps the classic single-tier fleet (docs/FLEET.md).
+ENV_PREFILL_REPLICAS = "HVD_TPU_FLEET_PREFILL_REPLICAS"
 
 
 class FleetRouter:
@@ -125,14 +158,29 @@ class FleetRouter:
                  policy: Optional[TargetTrackingPolicy] = None,
                  spares: int = 0, max_skew: int = 32,
                  ttft_window: int = 64,
+                 prefill_replicas: Optional[int] = None,
+                 decode_policy: Optional[TargetTrackingPolicy] = None,
                  clock=time.perf_counter):
         if mode not in ("affinity", "round_robin"):
             raise ValueError(f"unknown routing mode {mode!r}")
         if replicas < 1:
             raise ValueError(f"need >= 1 replica, got {replicas}")
+        if prefill_replicas is None:
+            prefill_replicas = env_int(ENV_PREFILL_REPLICAS, 0)
+        if prefill_replicas < 0:
+            raise ValueError(
+                f"need >= 0 prefill replicas, got {prefill_replicas}")
         self._build = build_engine
         self.mode = mode
         self.policy = policy
+        #: disaggregated two-tier fleet (module docstring): ``replicas``
+        #: sizes the decode tier, ``prefill_replicas`` the prefill tier
+        self.disagg = int(prefill_replicas) > 0
+        #: decode-tier scale policy (tokens/s-per-replica floor); the
+        #: generic ``policy`` drives the prefill tier in disagg mode
+        self.decode_policy = decode_policy
+        if self.disagg and self.decode_policy is None:
+            self.decode_policy = decode_policy_from_env()
         #: cache affinity yields to load balance past this queue skew:
         #: when the cache-best replica's queue exceeds the fleet
         #: minimum by more than ``max_skew``, the request routes
@@ -158,8 +206,9 @@ class FleetRouter:
         #: across routers/legs; the bench wants per-leg numbers)
         self.route_counts = {"affinity": 0, "least_queue": 0,
                              "round_robin": 0}
-        #: applied scale actions, in order: (direction, new_size)
-        self.scale_events: List[Tuple[str, int]] = []
+        #: applied scale actions, in order: (direction, new_size) —
+        #: disagg entries carry a third element, the resized tier
+        self.scale_events: List[tuple] = []
         #: hedged dispatch (docs/SERVING.md fault tolerance): a request
         #: still waiting on its first token past the sliding p99 TTFT
         #: gets a second, identical dispatch; first completion wins
@@ -176,20 +225,80 @@ class FleetRouter:
         self.hedges = {"won": 0, "lost": 0, "suppressed": 0}
         #: per-recovery records ({gid, path, ms}) — bench columns
         self.recovery: List[dict] = []
-        for _ in range(replicas):
-            self._spawn_replica()
+        #: tier-handoff outcome counts (disagg; bench columns)
+        self.handoffs = {"warm": 0, "cold": 0}
+        #: per-handoff records ({gid, path, ms, bytes, blocks}) — the
+        #: bench's modeled==measured migrated-bytes evidence
+        self.handoff_records: List[dict] = []
+        #: kvsnap bytes that crossed a replica boundary warm (handoffs
+        #: + loss migrations) — mirrors the registry counter per router
+        self.migrated_bytes = 0
+        #: EMA of handoff wall time — the two-hop deadline filter's
+        #: middle term (prefill delay + THIS + decode delay)
+        self._handoff_ema: Optional[float] = None
+        self._decode_tokens = 0
+        self._tok_rate_prev: Optional[Tuple[float, int]] = None
+        if self.disagg:
+            for _ in range(replicas):
+                self._spawn_replica(tier="decode")
+            for _ in range(int(prefill_replicas)):
+                self._spawn_replica(tier="prefill")
+        else:
+            for _ in range(replicas):
+                self._spawn_replica()
         # warm spares: spawned + fully compiled now (before traffic),
         # activated instantly at scale-out — building an engine
         # mid-traffic is seconds of XLA compile the SLO can't absorb
+        # (disagg: spares join the decode tier — prefill scale-out is
+        # the cheaper compile, its menu is the mixed chunk family only)
         for _ in range(max(0, int(spares))):
-            self._spawn_replica(park=True)
+            self._spawn_replica(park=True,
+                                tier="decode" if self.disagg else "mixed")
         if self.policy is not None:
             self.policy.min_size = max(1, self.policy.min_size)
+        if self.decode_policy is not None:
+            self.decode_policy.min_size = max(
+                1, self.decode_policy.min_size)
 
     # -- replica lifecycle ---------------------------------------------------
 
-    def _spawn_replica(self, park: bool = False) -> ServingReplica:
-        r = ServingReplica(str(self._next_name), self._build,
+    def _build_for(self, tier: str) -> Callable[[], object]:
+        """The engine factory for one tier.  A prefill-tier engine must
+        be built with ``role="prefill"`` BEFORE warmup (the role decides
+        the program menu): a ``build_engine`` that takes a ``role``
+        kwarg gets it passed; otherwise the built engine's role is
+        flipped post-construction (warmup runs later, in
+        :meth:`ServingReplica.spawn`, so the menu still comes out
+        right) and its drafter dropped — speculation is a decode
+        accelerator the prefill tier can never use."""
+        if tier != "prefill":
+            return self._build
+        build = self._build
+        try:
+            params = inspect.signature(build).parameters.values()
+            takes_role = any(
+                p.name == "role"
+                or p.kind is inspect.Parameter.VAR_KEYWORD
+                for p in params)
+        except (TypeError, ValueError):
+            takes_role = False
+        if takes_role:
+            return lambda: build(role="prefill")
+
+        def build_prefill():
+            eng = build()
+            eng.role = "prefill"
+            eng._drafter = None
+            return eng
+        return build_prefill
+
+    def _spawn_replica(self, park: bool = False,
+                       tier: str = "mixed") -> ServingReplica:
+        # tier-prefixed names in disagg mode ("prefill0"/"decode1") so
+        # logs, health sources and kvsnap source tags read at a glance
+        name = f"{tier}{self._next_name}" if tier != "mixed" \
+            else str(self._next_name)
+        r = ServingReplica(name, self._build_for(tier), tier=tier,
                            clock=self._clock)
         self._next_name += 1
         r.spawn(park=park)
@@ -203,29 +312,41 @@ class FleetRouter:
             _instr.FLEET_REPLICAS.labels(state).set(
                 sum(1 for r in self.replicas if r.state == state))
 
-    def _accepting(self) -> List[ServingReplica]:
-        return [r for r in self.replicas if r.accepting]
+    def _accepting(self, tier: Optional[str] = None
+                   ) -> List[ServingReplica]:
+        return [r for r in self.replicas if r.accepting
+                and (tier is None or r.tier == tier)]
 
     @property
     def size(self) -> int:
         """Accepting replicas — what the policy scales."""
         return len(self._accepting())
 
-    def scale_to(self, n: int) -> bool:
+    def tier_size(self, tier: str) -> int:
+        """Accepting replicas of one tier (the per-tier policies'
+        ``current`` in disagg mode)."""
+        return len(self._accepting(tier))
+
+    def scale_to(self, n: int, tier: Optional[str] = None) -> bool:
         """Converge the accepting-replica count to ``n``: unpark warm
         spares (instant) or spawn+warm new replicas to grow, drain the
         least-loaded (retired once empty, by :meth:`step`) to shrink.
-        Returns True when the resize was applied."""
+        ``tier`` scopes the resize to one tier of a disaggregated
+        fleet (spares only unpark into their own tier — a parked
+        decode engine has the wrong menu for prefill duty).  Returns
+        True when the resize was applied."""
         n = max(1, int(n))
-        acc = self._accepting()
+        acc = self._accepting(tier)
         if n > len(acc):
             for _ in range(n - len(acc)):
                 spare = next((r for r in self.replicas
-                              if r.state == PARKED), None)
+                              if r.state == PARKED
+                              and (tier is None or r.tier == tier)),
+                             None)
                 if spare is not None:
                     spare.unpark()
                 else:
-                    self._spawn_replica()
+                    self._spawn_replica(tier=tier or "mixed")
             self._book_replica_gauges()
             return True
         while len(acc) > n and len(acc) > 1:
@@ -235,17 +356,31 @@ class FleetRouter:
                 "fleet: draining replica %s (queue %d)", victim.name,
                 victim.queue_depth())
             victim.drain()
-            acc = self._accepting()
+            acc = self._accepting(tier)
         self._book_replica_gauges()
         return True
 
     # -- placement -----------------------------------------------------------
 
+    def _two_hop_overhead(self) -> float:
+        """Estimated seconds a disaggregated request spends AFTER its
+        prefill replica's queue: handoff (EMA) + the best decode-tier
+        queue delay.  The deadline filter must charge the full two-hop
+        path — judging a prefill replica by its own queue alone admits
+        requests whose budget the handoff + decode hop then eats
+        (the satellite-2 fix; 0.0 for a single-tier fleet)."""
+        if not self.disagg:
+            return 0.0
+        dq = min((x.est_queue_delay()
+                  for x in self._accepting("decode")), default=0.0)
+        return (self._handoff_ema or 0.0) + dq
+
     def _route(self, prompt: np.ndarray,
                remaining_budget: Optional[float] = None,
-               exclude: Tuple[ServingReplica, ...] = ()
-               ) -> ServingReplica:
-        acc = [r for r in self._accepting() if r not in exclude]
+               exclude: Tuple[ServingReplica, ...] = (),
+               tier: Optional[str] = None,
+               extra_delay: float = 0.0) -> ServingReplica:
+        acc = [r for r in self._accepting(tier) if r not in exclude]
         if not acc:
             raise RuntimeError("no accepting replicas")
         if self.mode == "round_robin":
@@ -259,9 +394,12 @@ class FleetRouter:
             # delay already exceeds the request's remaining budget
             # would only produce a shed — skip it while ANY viable
             # replica exists (all over budget: route normally and let
-            # the engine's own deadline machinery shed honestly)
+            # the engine's own deadline machinery shed honestly).
+            # ``extra_delay`` charges the hops PAST this replica (the
+            # two-hop handoff + decode delay in a disaggregated fleet)
             viable = [r for r in acc
-                      if r.est_queue_delay() <= remaining_budget]
+                      if r.est_queue_delay() + extra_delay
+                      <= remaining_budget]
             if viable:
                 acc = viable
         scores = [(r.cached_prefix_blocks(prompt), r) for r in acc]
@@ -307,9 +445,14 @@ class FleetRouter:
         # -> engine -> scheduler: every span the request touches
         # downstream carries this id (docs/TRACING.md)
         tid = _trace.new_trace_id() if _trace.enabled() else None
+        # disagg: a fresh request always enters through the prefill
+        # tier, and its viability filter charges the whole two-hop path
+        tier = "prefill" if self.disagg else None
+        extra = self._two_hop_overhead()
         tried: List[ServingReplica] = []
         for _ in range(len(self.replicas) + 1):
-            r = self._route(prompt, remaining, exclude=tuple(tried))
+            r = self._route(prompt, remaining, exclude=tuple(tried),
+                            tier=tier, extra_delay=extra)
             try:
                 rid = r.submit(prompt, max_new_tokens, eos_id=eos_id,
                                arrival=arrival, deadline_s=deadline_s,
@@ -336,7 +479,8 @@ class FleetRouter:
                 replica=r, rid=rid, prompt=prompt,
                 max_new_tokens=int(max_new_tokens), eos_id=eos_id,
                 arrival=arrival, deadline_s=deadline_s, trace_id=tid,
-                spec_k=spec_k, placed_at=self._clock())
+                spec_k=spec_k, placed_at=self._clock(),
+                tier=tier or "mixed")
             _trace.event("fleet.route", gid=gid, rid=rid,
                          replica=r.name, mode=self.mode, trace=tid)
             return gid
@@ -380,9 +524,18 @@ class FleetRouter:
                 self.replicas.remove(r)
                 self.retired.append(r)
                 self._book_replica_gauges()
+        if self.disagg:
+            # AFTER the per-replica pass: every prefill replica that
+            # crossed the handoff boundary this step has parked its
+            # snapshots by now; a handoff is only parked by a replica
+            # that stepped (busy=True), so run_until_drained cannot
+            # exit with one pending.  DRAINING prefill replicas hold
+            # their engines until this pass empties them (the
+            # handoff-aware ``drained`` gate).
+            self._collect_handoffs()
         if self.hedge_enabled:
             self._maybe_hedge()
-        if self.policy is not None:
+        if self.policy is not None or self.decode_policy is not None:
             self._maybe_scale()
         return busy
 
@@ -426,7 +579,12 @@ class FleetRouter:
                 self.hedges["suppressed"] += 1
                 _HEDGE_SUPPRESSED.inc()
                 continue
-            others = [x for x in self._accepting() if x is not p.replica]
+            # tier-matched: a hedge is an identical dispatch, and only
+            # the placement's own tier has the menu to serve it (in a
+            # single-tier fleet every replica is "mixed", so this is
+            # the old all-replicas set)
+            others = [x for x in self._accepting(p.tier)
+                      if x is not p.replica]
             tgt = min(others, key=lambda x: x.queue_depth(),
                       default=None)
             if tgt is None or tgt.est_queue_delay() > delay:
@@ -458,6 +616,166 @@ class FleetRouter:
             _trace.event("serve.hedge", gid=gid,
                          primary=p.replica.name, hedge=tgt.name,
                          delay=delay, trace=p.trace_id)
+
+    # -- the tier boundary (disagg): prefill -> decode handoff ---------------
+
+    def _collect_handoffs(self) -> None:
+        """Drain every prefill replica's parked handoffs (requests
+        whose prefill just completed) into the decode tier."""
+        for r in list(self.replicas):
+            if r.tier != "prefill" or r.engine is None:
+                continue
+            pending = getattr(r.engine, "handoffs", None)
+            if not pending:
+                continue
+            for rid in list(pending):
+                stream, snap, arr = pending.pop(rid)
+                self._dispatch_handoff(r, rid, stream, snap, arr)
+
+    def _dispatch_handoff(self, src: ServingReplica, rid: int,
+                          stream, snap: Optional[dict],
+                          arr: Optional[float]) -> None:
+        """Move ONE prefill-complete request across the tier boundary:
+        its ``kvsnap/1`` block chain crosses the ``serve.handoff``
+        chaos point and re-registers on a decode replica
+        (:meth:`ServingEngine.import_kv` — **warm**: the re-submitted
+        request re-prefixes the whole prompt + first token from cache,
+        zero prefill recompute on the decode tier); a dropped or
+        corrupted wire degrades to **cold** (the decode replica
+        re-prefills — deterministic, never wrong, exactly the PR-18
+        migration contract).  The first token the prefill tier emitted
+        becomes the placement's watermark, so collection prepends it
+        exactly once and TTFT stays a prefill-tier measurement."""
+        gid = p = None
+        via_hedge = False
+        for g, cand in self._placed.items():
+            if cand.replica is src and cand.rid == rid:
+                gid, p = g, cand
+                break
+            if cand.hedge is not None and cand.hedge[0] is src \
+                    and cand.hedge[1] == rid:
+                gid, p, via_hedge = g, cand, True
+                break
+        if p is None:
+            return  # cancelled / already resolved elsewhere
+        t0 = self._clock()
+        # hedged prefill resolves FIRST-HANDOFF-WINS: both dispatches
+        # of a hedged pair prefill independently and each would park a
+        # handoff — the first one collected carries the request across,
+        # the loser cancels AND its (possibly already-parked) handoff
+        # is discarded so the request cannot cross the boundary twice
+        if via_hedge:
+            loser, lrid = p.replica, p.rid
+            p.replica, p.rid = src, rid
+            p.hedge = None
+            if loser.engine is not None:
+                loser.engine.cancel(lrid)
+                getattr(loser.engine, "handoffs", {}).pop(lrid, None)
+            self.hedges["won"] += 1
+            _HEDGE_WON.inc()
+        elif p.hedge is not None:
+            loser, lrid = p.hedge
+            p.hedge = None
+            if loser.engine is not None:
+                loser.engine.cancel(lrid)
+                getattr(loser.engine, "handoffs", {}).pop(lrid, None)
+            self.hedges["lost"] += 1
+            _HEDGE_LOST.inc()
+        # the engine request's prompt is p.prompt (+ any earlier
+        # migration watermark), so slicing past the ORIGINAL prompt
+        # recovers the full generated run — the _eject idiom
+        gen = np.asarray(stream[len(p.prompt):], np.int32)
+        if p.eos_id is not None and gen.size:
+            hits = np.flatnonzero(gen == p.eos_id)
+            if hits.size:
+                gen = gen[:int(hits[0]) + 1]
+        remaining = p.max_new_tokens - int(gen.size)
+        if remaining < 1 or (p.eos_id is not None and gen.size
+                             and gen[-1] == p.eos_id):
+            # done AT the boundary (eos or budget on the first token):
+            # no decode tier needed
+            self.results[gid] = gen
+            del self._placed[gid]
+            return
+        wire_snap = None
+        if snap is not None:
+            wire = np.asarray(snap["tokens"], np.int32).tobytes()
+            out = _chaos.point("serve.handoff", wire)
+            if out is not _chaos.DROP:
+                wire_snap = dict(snap)
+                wire_snap["tokens"] = np.frombuffer(out, np.int32)
+        remaining_budget = None
+        if p.deadline_s and p.deadline_s > 0:
+            base = arr if arr is not None else (
+                p.arrival if p.arrival is not None else t0)
+            remaining_budget = max(0.0, p.deadline_s - (t0 - base))
+        full = np.concatenate([p.prompt, gen]) if gen.size else p.prompt
+        placed = None
+        path = "cold"
+        nbytes = 0
+        tried: List[ServingReplica] = []
+        for _ in range(len(self._accepting("decode")) + 1):
+            try:
+                tgt = self._route(full, remaining_budget,
+                                  exclude=tuple(tried), tier="decode")
+            except RuntimeError:
+                break  # decode tier empty / exhausted
+            try:
+                path = "cold"
+                if wire_snap is not None:
+                    try:
+                        tgt.engine.import_kv(wire_snap)
+                        path = "warm"
+                        nbytes = measured_kvsnap_bytes(wire_snap)
+                    except ValueError as e:
+                        get_logger().warning(
+                            "fleet: handoff snapshot rejected for gid "
+                            "%d (%s) — cold re-prefill", gid, e)
+                        wire_snap = None  # bad wire: don't retry it
+                nrid = tgt.submit(
+                    full, int(remaining), eos_id=p.eos_id,
+                    arrival=arr if arr is not None else p.arrival,
+                    deadline_s=p.deadline_s, trace_id=p.trace_id,
+                    spec_k=p.spec_k)
+                tgt.note_ok()
+                placed = (tgt, nrid)
+                break
+            except Exception as e:
+                get_logger().warning(
+                    "fleet: handoff to replica %s raised (%s: %s)",
+                    tgt.name, type(e).__name__, e)
+                if tgt.note_error():
+                    self._eject(tgt)
+                tried.append(tgt)
+        if placed is None:
+            # no decode replica accepted: complete with the watermark
+            # (the boundary token) rather than wedge the request
+            self.results[gid] = gen
+            del self._placed[gid]
+            return
+        p.replica, p.rid = placed
+        p.tier = "decode"
+        p.prefix = gen
+        p.placed_at = self._clock()
+        p.hedged = True  # past the hedgeable (prefill) phase
+        if placed[0].engine is not None:
+            placed[0].engine.scheduler.resort_pending_by_arrival()
+        dt = self._clock() - t0
+        self._handoff_ema = dt if self._handoff_ema is None else (
+            0.8 * self._handoff_ema + 0.2 * dt)
+        self.handoffs[path] += 1
+        (_HANDOFF_WARM if path == "warm" else _HANDOFF_COLD).inc()
+        _instr.SERVE_HANDOFF_SECONDS.observe(dt)
+        if path == "warm" and nbytes:
+            _instr.SERVE_MIGRATED_BYTES.inc(nbytes)
+            self.migrated_bytes += nbytes
+        self.handoff_records.append({
+            "gid": gid, "path": path, "ms": dt * 1e3, "bytes": nbytes,
+            "blocks": len(snap["hashes"]) if snap else 0})
+        _trace.add_span("serve.handoff", t0, self._clock(), gid=gid,
+                        src=src.name, dst=placed[0].name, path=path,
+                        bytes=nbytes, carried=int(gen.size),
+                        trace=p.trace_id)
 
     def _eject(self, r: ServingReplica) -> None:
         """A replica turned SUSPECT: collect what it already finished,
@@ -498,6 +816,17 @@ class FleetRouter:
         # black box FIRST: the bundle must show the dying replica's
         # final spans, not the recovery's
         _flight.maybe_dump("replica_loss", extra={"replica": r.name})
+        # a dying prefill replica's parked handoffs dispatch to the
+        # decode tier NOW (their prefill work is done and exported —
+        # losing it to the cancel_all below would waste it); the moved
+        # placements then read ``p.replica is not r`` and skip the
+        # migration loop.  Only the VICTIM's handoffs: a full
+        # _collect_handoffs here could recurse through a decode
+        # ejection back into this frame.
+        if self.disagg and r.engine is not None:
+            for hrid in list(getattr(r.engine, "handoffs", None) or ()):
+                h_stream, h_snap, h_arr = r.engine.handoffs.pop(hrid)
+                self._dispatch_handoff(r, hrid, h_stream, h_snap, h_arr)
         # freshest stream state wins: a live (merely suspect) engine
         # exports right now; a truly dead one falls back to its last
         # periodic snapshot
@@ -512,7 +841,18 @@ class FleetRouter:
                     type(e).__name__, e)
         if not handoff:
             handoff = dict(r.kv_snapshots)
-        survivors = [x for x in self._accepting() if x is not r]
+        # disagg: survivors stay within the victim's tier — a decode
+        # request re-routed onto a prefill engine would find no decode
+        # programs.  The one safe crossing is prefill -> decode (a
+        # "both"-role menu is a superset), taken only when the prefill
+        # tier has no survivor left.
+        if self.disagg:
+            survivors = [x for x in self._accepting(r.tier) if x is not r]
+            if not survivors and r.tier == "prefill":
+                survivors = [x for x in self._accepting("decode")
+                             if x is not r]
+        else:
+            survivors = [x for x in self._accepting() if x is not r]
         touched: List[ServingReplica] = []
         moved = dropped = 0
         for gid, p in list(self._placed.items()):
@@ -586,6 +926,9 @@ class FleetRouter:
                         try:
                             tgt.engine.import_kv(wire_snap)
                             path = "warm"
+                            nb = measured_kvsnap_bytes(wire_snap)
+                            _instr.SERVE_MIGRATED_BYTES.inc(nb)
+                            self.migrated_bytes += nb
                         except ValueError as e:
                             get_logger().warning(
                                 "fleet: KV snapshot rejected for gid "
@@ -612,6 +955,7 @@ class FleetRouter:
                 dropped += 1
                 continue
             p.replica, p.rid = placed
+            p.tier = placed[0].tier  # prefill->decode fallback crossing
             p.rerouted = True
             p.prefix = gen
             p.placed_at = self._clock()
@@ -654,9 +998,16 @@ class FleetRouter:
         return self.results
 
     def _collect(self, r: ServingReplica) -> None:
-        for _rid, ttft in r.ttft_samples()[self._ttft_seen.get(r, 0):]:
-            self._ttfts.append(ttft)
-            self._ttft_seen[r] = self._ttft_seen.get(r, 0) + 1
+        # disagg: only prefill-tier first tokens feed the TTFT window —
+        # a decode replica's "first token" is the handed-off request's
+        # first DECODE emission, stamped from the original arrival; it
+        # measures the whole two-hop path and would poison the hedging
+        # delay estimate and the prefill tier's p99_ttft signal
+        if not self.disagg or r.tier == "prefill":
+            for _rid, ttft in r.ttft_samples()[
+                    self._ttft_seen.get(r, 0):]:
+                self._ttfts.append(ttft)
+                self._ttft_seen[r] = self._ttft_seen.get(r, 0) + 1
         if r.engine is None:
             return
         # map replica-local completions back to router-global ids;
@@ -685,6 +1036,11 @@ class FleetRouter:
                 _HEDGE_WON.inc()
             # prepend the pre-migration watermark exactly once
             res = np.asarray(res, np.int32)
+            if self.disagg and r.tier == "decode":
+                # tokens this decode replica generated (the watermark
+                # came from the prefill tier) — the decode tier's
+                # tokens/s throughput-floor numerator
+                self._decode_tokens += int(res.size)
             self.results[gid] = (np.concatenate([p.prefix, res])
                                  if p.prefix.size else res)
             del self._placed[gid]
@@ -704,20 +1060,62 @@ class FleetRouter:
             idx = min(len(xs) - 1, int(0.99 * len(xs)))
             out["p99_ttft"] = xs[idx]
             _instr.FLEET_ROUTER_P99_TTFT.set(out["p99_ttft"])
+        if self.disagg:
+            # decode tokens/s per accepting decode replica, rated
+            # between signal reads — the decode tier's throughput
+            # floor (the first read only pins the baseline)
+            now = self._clock()
+            if self._tok_rate_prev is not None:
+                t_prev, n_prev = self._tok_rate_prev
+                dt = now - t_prev
+                if dt > 0:
+                    out["decode_tokens_per_s"] = (
+                        (self._decode_tokens - n_prev) / dt
+                        / max(1, len(self._accepting("decode"))))
+            self._tok_rate_prev = (now, self._decode_tokens)
         return out
 
     def _maybe_scale(self) -> None:
-        d = self.policy.evaluate(self.signals(), self.size,
-                                 self._clock())
-        _instr.FLEET_DESIRED_SIZE.labels("serve").set(d.desired)
-        if d.direction != "hold" and d.desired != self.size:
-            get_logger().info("fleet: serve scale %s %d -> %d (%s)",
-                              d.direction, self.size, d.desired, d.reason)
-            if self.scale_to(d.desired):
-                _instr.FLEET_SCALE_EVENTS.labels(
-                    "serve", d.direction).inc()
-                self.scale_events.append((d.direction, d.desired))
-                self.policy.note_applied(self._clock())
+        sig = self.signals()
+        now = self._clock()
+        if not self.disagg:
+            if self.policy is None:
+                return
+            d = self.policy.evaluate(sig, self.size, now)
+            _instr.FLEET_DESIRED_SIZE.labels("serve").set(d.desired)
+            if d.direction != "hold" and d.desired != self.size:
+                get_logger().info(
+                    "fleet: serve scale %s %d -> %d (%s)",
+                    d.direction, self.size, d.desired, d.reason)
+                if self.scale_to(d.desired):
+                    _instr.FLEET_SCALE_EVENTS.labels(
+                        "serve", d.direction).inc()
+                    self.scale_events.append((d.direction, d.desired))
+                    self.policy.note_applied(now)
+            return
+        # disagg: each tier scales on its own signal — TTFT is decided
+        # entirely before the handoff (prefill capacity), decode
+        # tokens/s entirely after it (decode capacity); scale_events
+        # entries grow a tier field so the bench can tell them apart
+        for pol, tier, kind in ((self.policy, "prefill",
+                                 "serve_prefill"),
+                                (self.decode_policy, "decode",
+                                 "serve_decode")):
+            if pol is None:
+                continue
+            cur = self.tier_size(tier)
+            d = pol.evaluate(sig, cur, now)
+            _instr.FLEET_DESIRED_SIZE.labels(kind).set(d.desired)
+            if d.direction != "hold" and d.desired != cur:
+                get_logger().info(
+                    "fleet: %s tier scale %s %d -> %d (%s)", tier,
+                    d.direction, cur, d.desired, d.reason)
+                if self.scale_to(d.desired, tier=tier):
+                    _instr.FLEET_SCALE_EVENTS.labels(
+                        kind, d.direction).inc()
+                    self.scale_events.append(
+                        (d.direction, d.desired, tier))
+                    pol.note_applied(now)
 
     # -- bench/introspection columns -----------------------------------------
 

@@ -383,6 +383,34 @@ SERVE_RECOVERY_SECONDS = histogram(
     buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0),
 )
 
+#: Prefill→decode tier handoffs in the disaggregated fleet, by path:
+#: ``warm`` = the kvsnap chain re-registered on the decode replica (its
+#: decode re-prefixes from cache), ``cold`` = the snapshot was dropped
+#: or rejected and the decode replica re-prefilled (docs/FLEET.md).
+SERVE_HANDOFFS = counter(
+    "hvd_tpu_serve_handoffs_total",
+    "Prefill-to-decode tier handoffs, by transfer path",
+    ["path"],  # warm / cold
+)
+
+#: Wall time of one tier handoff: prefill-complete pickup to the
+#: request queued on its decode replica (chain verify + page write +
+#: re-submit) — the latency the two-hop deadline filter budgets for.
+SERVE_HANDOFF_SECONDS = histogram(
+    "hvd_tpu_serve_handoff_seconds",
+    "Seconds from prefill-complete pickup to decode-tier re-dispatch",
+    buckets=(0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0),
+)
+
+#: Paged-KV payload bytes that crossed a replica boundary warm (tier
+#: handoffs and replica-loss migrations): K/V pages + token streams as
+#: measured on the wire — the number ``modeled_kvsnap_bytes`` must
+#: reproduce exactly (modeled == measured, comm_model idiom).
+SERVE_MIGRATED_BYTES = counter(
+    "hvd_tpu_serve_migrated_kv_bytes_total",
+    "Paged-KV snapshot bytes moved between replicas on warm paths",
+)
+
 # -- fleet autoscaling + routing (fleet/ — docs/FLEET.md) --------------------
 
 #: Capacity the policy engine last decided the fleet should converge

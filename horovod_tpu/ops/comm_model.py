@@ -352,6 +352,55 @@ def modeled_serve_psum_bytes(
     }
 
 
+def modeled_kvsnap_bytes(
+    num_blocks: int,
+    block_size: int,
+    num_layers: int,
+    kv_heads: int,
+    head_dim: int,
+    dtype: str = "float32",
+) -> dict:
+    """Modeled wire bytes of ONE ``kvsnap/1`` paged-KV snapshot of
+    ``num_blocks`` full blocks — the prefill→decode handoff (and
+    replica-loss migration) payload the disaggregated fleet moves
+    between replicas.  Per block the snapshot carries one K page and
+    one V page of ``(num_layers, block_size, kv_heads, head_dim)``
+    each, plus the block's verified int32 token run.  Pages export
+    host-side from the FULL pool (``export_requests`` pulls the whole
+    pool, so a sharded engine's page still carries every kv head —
+    the model is shard-independent by construction, exactly like the
+    measured ``nbytes`` of the exported arrays).  Returns
+    ``{"page_bytes", "token_bytes", "wire_bytes"}`` (ints);
+    ``tools/serve_bench.py --disagg`` asserts modeled == measured
+    over the leg's handoff records (the PR-7 idiom)."""
+    if num_blocks < 0 or block_size < 1:
+        raise ValueError(
+            f"need num_blocks >= 0 and block_size >= 1, got "
+            f"{num_blocks}/{block_size}")
+    page = (2 * int(num_layers) * int(block_size) * int(kv_heads)
+            * int(head_dim) * _itemsize(dtype))
+    toks = int(num_blocks) * int(block_size) * 4  # int32 token runs
+    return {
+        "page_bytes": int(num_blocks) * page,
+        "token_bytes": toks,
+        "wire_bytes": int(num_blocks) * page + toks,
+    }
+
+
+def measured_kvsnap_bytes(snap: dict) -> int:
+    """MEASURED wire bytes of one ``kvsnap/1`` snapshot: the K/V page
+    arrays' ``nbytes`` plus the int32 token stream as actually
+    serialized — :func:`modeled_kvsnap_bytes`'s measured twin (the
+    router books it into ``hvd_tpu_serve_migrated_kv_bytes_total`` on
+    every warm handoff/migration)."""
+    toks = snap.get("tokens")
+    n = len(toks) if toks is not None else 0  # may be an ndarray:
+    total = n * 4                             # never bool() it
+    for kp, vp in snap.get("pages") or ():
+        total += int(np.asarray(kp).nbytes) + int(np.asarray(vp).nbytes)
+    return total
+
+
 _GATHER_RE = re.compile(r"\"?stablehlo\.(?:dynamic_)?gather\"?\(")
 
 

@@ -66,6 +66,7 @@ from ..ops.comm_model import modeled_serve_psum_bytes
 from ..utils.logging import get_logger
 from .kv_cache import (
     BlockAllocator, PagedKVState, blocks_for, make_pools, pool_bytes,
+    snap_origin,
 )
 from .scheduler import ContinuousBatchingScheduler, Request, Sequence
 from .speculative import Drafter, accept_greedy, make_drafter
@@ -264,14 +265,36 @@ class ServingEngine:
                  serve: Optional[ServeConfig] = None,
                  mesh: Optional[Mesh] = None,
                  drafter: Optional[Drafter] = None,
+                 role: str = "both",
                  clock=time.perf_counter):
         if cfg.attention_impl not in ("dot", "flash") or not cfg.causal:
             raise ValueError(
                 "serving requires a causal 'dot' or 'flash' config, got "
                 f"attention_impl={cfg.attention_impl!r} causal={cfg.causal}")
+        if role not in ("both", "prefill"):
+            raise ValueError(
+                f"role must be 'both' or 'prefill', got {role!r}")
         self.cfg = cfg
         self.serve_cfg = serve = serve or ServeConfig.from_env()
         self._clock = clock
+        #: "both" (default) runs the full prefill+decode loop.
+        #: "prefill" is the disaggregated fleet's prefill tier
+        #: (docs/SERVING.md): the engine stops each request at the
+        #: HANDOFF BOUNDARY — the step its prompt completes and the
+        #: first token emits — parking an exported ``kvsnap/1`` record
+        #: in :attr:`handoffs` instead of ever dispatching a decode (or
+        #: speculative) program.  Warmup therefore compiles the mixed
+        #: chunk menu ONLY, so decode programs do not merely go unused
+        #: on this tier: they never exist.
+        self.role = role
+        #: rid -> (stream, snap, arrival) records parked at the handoff
+        #: boundary for the fleet router to carry to a decode-tier
+        #: replica (prefill role only; empty on "both" engines)
+        self.handoffs: Dict[int, tuple] = {}
+        #: replica name stamped into every kvsnap export's ``source``
+        #: tag (the fleet replica sets it at spawn) so a rejecting
+        #: importer names the sender; None = untagged
+        self.snap_source: Optional[str] = None
         # -- tensor sharding (docs/SERVING.md): one model over the ICI
         # mesh — kv heads + the paged pool head-sharded, Megatron FFN,
         # scheduler/allocator untouched (their decisions are a pure
@@ -360,6 +383,10 @@ class ServingEngine:
         self._drafter: Optional[Drafter] = drafter
         if self._drafter is None and serve.spec:
             self._drafter = make_drafter(serve.spec_drafter)
+        if self.role == "prefill":
+            # speculation is a decode accelerator; the prefill tier
+            # never decodes (requests leave at the handoff boundary)
+            self._drafter = None
         self.spec_w = 0
         if self._drafter is not None:
             if serve.spec_k < 1:
@@ -606,7 +633,14 @@ class ServingEngine:
         Side-effect-free by construction: the dummy steps run with
         all-zero block tables, so every write lands in the trash block
         and no real sequence's cache is touched.  Returns the number of
-        programs compiled."""
+        programs compiled.
+
+        A ``role="prefill"`` engine warms the mixed chunk menu ONLY —
+        ``|decode_tiers| × |chunk_tiers|`` programs.  Its requests
+        leave at the handoff boundary, so the decode and speculative
+        families would be dead weight; not compiling them is both the
+        smaller menu the disaggregated prefill tier is for and the
+        structural proof it can never run a decode step."""
         before = len(self._progs)
         tables = jnp.zeros((1, self.max_blocks_per_seq), jnp.int32)
         for bt in self.decode_tiers:
@@ -618,6 +652,8 @@ class ServingEngine:
                                tb, jnp.zeros((bt,), jnp.int32),
                                jnp.ones((bt,), jnp.int32),
                                jnp.zeros((bt, c), jnp.int32), pages=None)
+            if self.role == "prefill":
+                continue  # decode/spec programs never exist on this tier
             for pt in self.page_tiers:
                 self._book_program("decode", bt, pt)
                 self._decode_fn(self.params, self.k_pool, self.v_pool,
@@ -1157,7 +1193,8 @@ class ServingEngine:
                 pages = [(np.array(k_host[:, b]), np.array(v_host[:, b]))
                          for b in blocks]
                 snap = self.allocator.export_blocks(
-                    blocks, stream[:n_full * bs], pages)
+                    blocks, stream[:n_full * bs], pages,
+                    source=self.snap_source)
             out[rid] = (stream, snap, seq.req.arrival)
         for req in list(self._staging_meta):  # staged: prompt-only (cold)
             if want is None or req.id in want:
@@ -1184,7 +1221,8 @@ class ServingEngine:
                 if not pages:
                     raise ValueError(
                         "snapshot carries no pages but its chain is not "
-                        "fully cached here — cannot warm-import")
+                        "fully cached here — cannot warm-import"
+                        + snap_origin(snap))
                 k_host = np.array(self.k_pool)
                 v_host = np.array(self.v_pool)
                 for i, b in fresh:
@@ -1230,6 +1268,24 @@ class ServingEngine:
                 sched._book()
                 return True
         return False
+
+    def _handoff(self, seq: Sequence) -> None:
+        """Park a prefill-complete request for the fleet's tier
+        boundary (``role="prefill"`` only).  The request exports like
+        a migration — full VERIFIED stream plus the ``kvsnap/1``
+        chain — BEFORE it leaves the scheduler, so the snapshot sees
+        its blocks while they are still owned.  ``finish`` then frees
+        them through the normal refcount path, which PARKS the full
+        chain on the prefix-cache LRU: a repeated template's next
+        prefill still matches it here, even though the request itself
+        decodes on another replica.  The router drains
+        ``self.handoffs`` every fleet step and re-registers the chain
+        in a decode-tier replica."""
+        rid = seq.req.id
+        rec = self.export_requests(rids=[rid]).get(rid)
+        self.scheduler.finish(seq)
+        if rec is not None:
+            self.handoffs[rid] = rec
 
     # -- the scheduler loop --------------------------------------------------
 
@@ -1301,6 +1357,14 @@ class ServingEngine:
             for j, (s, c) in enumerate(sel):
                 if s.in_decode:  # prompt complete -> its first token
                     self._emit(s, toks[base + j, c - 1], now)
+            if self.role == "prefill":
+                # the handoff boundary: a request that just crossed
+                # into decode leaves NOW — this engine has no decode
+                # programs to run it with (done rows already finished
+                # inside _emit and publish their result normally)
+                for s, _c in sel:
+                    if s.in_decode and not s.done:
+                        self._handoff(s)
             return True
         if decode_rows:
             if any(s.draft for s in decode_rows):

@@ -75,6 +75,14 @@ def chain_hash(parent_hash: int, tokens: Tuple[int, ...]) -> int:
     return hash((parent_hash, tokens))
 
 
+def snap_origin(snap: dict) -> str:
+    """`` (from replica <source>)`` when the snapshot carries its
+    optional ``source`` tag, else an empty string — the suffix every
+    import rejection appends so a bad wire names its sender."""
+    src = snap.get("source")
+    return f" (from replica {src})" if src else ""
+
+
 class BlockAllocator:
     """Refcounted allocator over the pool's block ids, with a prefix
     cache (host side).
@@ -329,7 +337,8 @@ class BlockAllocator:
     SNAP_FORMAT = "horovod_tpu.serve.kvsnap/1"
 
     def export_blocks(self, blocks: Sequence[int], tokens: Sequence[int],
-                      pages: Optional[list] = None) -> dict:
+                      pages: Optional[list] = None,
+                      source: Optional[str] = None) -> dict:
         """Serialize a sequence's FULL-block chain for migration: the
         covered token ids, the chain hashes recomputed from
         :data:`PREFIX_HASH_ROOT` (the importer re-verifies them — the
@@ -338,6 +347,10 @@ class BlockAllocator:
         cover exactly ``len(blocks) * block_size`` positions — only
         written, verified positions belong in a snapshot (the caller
         excludes the partial tail and any unsettled draft tokens).
+        ``source`` optionally names the exporting replica; importers
+        fold it into their rejection errors so a corrupt or foreign
+        snapshot names where it came from (a snapshot without the key
+        imports exactly as before — the format stays ``kvsnap/1``).
         Returns a plain dict (host data only, process-portable given
         the same ``hash_fn``)."""
         bs = self.block_size
@@ -351,13 +364,16 @@ class BlockAllocator:
         for i in range(len(blocks)):
             parent = self.hash_fn(parent, tuple(toks[i * bs:(i + 1) * bs]))
             hashes.append(parent)
-        return {
+        snap = {
             "format": self.SNAP_FORMAT,
             "block_size": bs,
             "tokens": toks,
             "hashes": hashes,
             "pages": list(pages) if pages is not None else None,
         }
+        if source is not None:
+            snap["source"] = str(source)
+        return snap
 
     def import_blocks(self, snap: dict
                       ) -> Tuple[List[int], List[Tuple[int, int]]]:
@@ -378,14 +394,20 @@ class BlockAllocator:
         returned blocks carry one reference owned by the caller (park
         them via :meth:`free` once pages are written, or hand them to a
         sequence).  All-or-nothing: a pool too small mid-chain rolls
-        back every reference and registration taken so far."""
+        back every reference and registration taken so far.
+
+        Rejection errors name the exporting replica when the snapshot
+        carries a ``source`` tag (a two-tier fleet's handoff wire can
+        cross any prefill→decode pair — "corrupt snapshot" without a
+        sender is undebuggable)."""
+        who = snap_origin(snap) if isinstance(snap, dict) else ""
         if snap.get("format") != self.SNAP_FORMAT:
             raise ValueError(
-                f"unknown KV snapshot format {snap.get('format')!r}")
+                f"unknown KV snapshot format {snap.get('format')!r}{who}")
         if int(snap.get("block_size", -1)) != self.block_size:
             raise ValueError(
                 f"snapshot block_size {snap.get('block_size')} != "
-                f"allocator block_size {self.block_size}")
+                f"allocator block_size {self.block_size}{who}")
         if not self.prefix_cache:
             raise ValueError(
                 "import_blocks needs the prefix cache (registered blocks "
@@ -396,7 +418,7 @@ class BlockAllocator:
         if len(toks) != len(carried) * bs:
             raise ValueError(
                 f"snapshot carries {len(carried)} hashes but "
-                f"{len(toks)} tokens (need {len(carried) * bs})")
+                f"{len(toks)} tokens (need {len(carried) * bs}){who}")
         # integrity gate: recompute the whole chain BEFORE touching state
         parent = PREFIX_HASH_ROOT
         parents: List[int] = []
@@ -406,7 +428,7 @@ class BlockAllocator:
             if want != h:
                 raise ValueError(
                     f"KV snapshot chain-hash mismatch at block {i}: "
-                    f"corrupt or foreign snapshot rejected")
+                    f"corrupt or foreign snapshot rejected{who}")
             parent = h
         blocks: List[int] = []
         fresh: List[Tuple[int, int]] = []
@@ -429,7 +451,7 @@ class BlockAllocator:
                 if got is None:
                     raise ValueError(
                         f"pool exhausted importing block {i} of "
-                        f"{len(carried)}")
+                        f"{len(carried)}{who}")
                 nb = got[0]
                 if h not in self._index:
                     self._index[h] = nb

@@ -75,6 +75,7 @@ SITES = (
     "fleet.route",         # router placement decision (instant)
     "serve.migrate",       # one request's KV/stream handoff to a survivor
     "serve.hedge",         # hedged second dispatch issued (instant)
+    "serve.handoff",       # prefill->decode tier handoff (disagg fleet)
     "fleet.scale",         # autoscaler applied a scale decision (instant)
     "fleet.preempt",       # preemption notice handled (instant)
     "guard.exchange",      # cross-rank digest/vote exchange (cadence)

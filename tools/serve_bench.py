@@ -753,6 +753,161 @@ def run_fleet_legs(args):
     return rows
 
 
+def _fleet_decode_gaps(router):
+    """p99 inter-token gap across the fleet, from each engine's own
+    token log (request ids are engine-local, and gaps are intra-id, so
+    per-engine logs compose without remapping).  In the disaggregated
+    fleet a request's first token lands in the prefill engine's log
+    and the rest in the decode engine's — the one-token prefill-side
+    entry contributes no gap, which is exactly right: the metric is
+    the cadence a decode user FEELS, and the handoff pause shows up as
+    the decode engine's first intra-id gap measured from arrival."""
+    gaps = []
+    for r in router.replicas + router.retired:
+        if r.engine is not None and r.engine.token_log:
+            gaps.extend(_decode_gaps(
+                r.engine.token_log,
+                {rid for rid, _e, _a in r.engine.token_log}))
+    return gaps
+
+
+def _arm_token_logs(router):
+    for r in router.replicas:
+        r.engine.token_log = []
+
+
+def run_disagg_legs(args):
+    """The disaggregated prefill/decode A/B (ROADMAP item 2,
+    docs/FLEET.md): the same templated open-loop load through a
+    classic mixed fleet of N replicas and a two-tier fleet that puts
+    a prefill replica IN FRONT of the same N as a decode tier —
+    iso-decode-capacity, the Splitwise framing: the claim under test
+    is that offloading prompt work to a prefill tier keeps the decode
+    cadence flat without costing aggregate tokens/s, so the A/B holds
+    the decode fleet fixed and disaggregation adds its tier the way a
+    deployment would.  The load is decode-heavy (long generations
+    under a prompt-arrival ramp — prompts keep landing while earlier
+    requests decode, the interference regime chunking only bounds).
+    Asserted before a single number prints: token-identity across the
+    legs, zero post-warmup compiles on BOTH tiers, and the warm
+    handoff bytes' modeled == measured equality (comm_model idiom).
+    With ``--shards 2`` a second pair reruns both legs with every
+    tier tensor-sharded over 2 virtual chips and re-asserts identity
+    against its own sharded mixed baseline."""
+    from horovod_tpu.fleet.router import FleetRouter
+    from horovod_tpu.ops.comm_model import modeled_kvsnap_bytes
+
+    if args.smoke:
+        n, decode_replicas, templates, t_len, s_hi = 48, 2, 6, 48, 8
+        gen_lo, gen_hi = 12, 24
+        rate_lo, rate_hi = 60.0, 400.0
+    else:
+        n, decode_replicas, templates, t_len, s_hi = 160, 3, 8, 96, 12
+        gen_lo, gen_hi = 16, 32
+        rate_lo, rate_hi = 40.0, 300.0
+    cfg = TransformerConfig(
+        vocab_size=128, num_layers=2, num_heads=4, num_kv_heads=2,
+        head_dim=16, max_seq_len=2 * t_len, dtype=jnp.float32,
+        attention_impl="dot", causal=True)
+    params = params_for(cfg)
+    serve_kw = dict(block_size=16, num_blocks=0, token_budget=256,
+                    watermark=2, prefill_tiers=(t_len + 16,),
+                    decode_tiers=(1, 2, 4), prefill_chunk=16)
+
+    rs = np.random.RandomState(args.seed)
+    temps = [rs.randint(1, 120, size=t_len).astype(np.int32)
+             for _ in range(templates)]
+    load = []
+    for _ in range(n):
+        t = temps[int(rs.randint(templates))]
+        sfx = rs.randint(1, 120,
+                         size=int(rs.randint(2, s_hi + 1))).astype(np.int32)
+        load.append((np.concatenate([t, sfx]),
+                     int(rs.randint(gen_lo, gen_hi + 1))))
+    arrivals = []
+    t = 0.0
+    for i in range(n):
+        rate = rate_lo + (rate_hi - rate_lo) * i / max(n - 1, 1)
+        t += 1.0 / rate
+        arrivals.append(t)
+
+    def legs_for(shards, suffix):
+        def build(role="both"):
+            return ServingEngine(
+                cfg, params, serve=ServeConfig(shards=shards, **serve_kw),
+                role=role)
+
+        # mixed baseline: the decode tier's size, classic single tier
+        router = FleetRouter(build, replicas=decode_replicas,
+                             mode="affinity")
+        _arm_token_logs(router)
+        gids, wall = _drive_router(router, load, arrivals)
+        mixed = _fleet_row(f"fleet_mixed{suffix}", router, gids, wall)
+        mixed["p99_decode_gap_s"] = round(
+            _percentile(_fleet_decode_gaps(router), 99), 4)
+        mixed_out = [router.results[g] for g in gids]
+
+        # the disaggregated fleet: 1 prefill + N decode
+        router = FleetRouter(build, replicas=decode_replicas,
+                             mode="affinity", prefill_replicas=1)
+        _arm_token_logs(router)
+        gids, wall = _drive_router(router, load, arrivals)
+        row = _fleet_row(f"fleet_disagg{suffix}", router, gids, wall)
+        row["p99_decode_gap_s"] = round(
+            _percentile(_fleet_decode_gaps(router), 99), 4)
+        row["handoffs"] = router.handoffs["warm"] + router.handoffs["cold"]
+        row["handoffs_warm"] = router.handoffs["warm"]
+        hand_ms = [x["ms"] for x in router.handoff_records]
+        row["handoff_ms_p50"] = round(_percentile(hand_ms, 50), 3)
+        row["handoff_ms_p99"] = round(_percentile(hand_ms, 99), 3)
+        row["migrated_kv_bytes"] = router.migrated_bytes
+        modeled = sum(
+            modeled_kvsnap_bytes(
+                x["blocks"], serve_kw["block_size"], cfg.num_layers,
+                cfg.num_kv_heads, cfg.head_dim, "float32")["wire_bytes"]
+            for x in router.handoff_records if x["path"] == "warm")
+        row["migrated_kv_bytes_modeled"] = modeled
+        pre = [r for r in router.replicas + router.retired
+               if r.tier == "prefill"]
+        dec = [r for r in router.replicas + router.retired
+               if r.tier == "decode"]
+        row["compile_free_prefill"] = all(r.compile_free for r in pre)
+        row["compile_free_decode"] = all(r.compile_free for r in dec)
+        row["compile_free"] = (row["compile_free_prefill"]
+                               and row["compile_free_decode"])
+        disagg_out = [router.results[g] for g in gids]
+
+        for i, (a, b) in enumerate(zip(mixed_out, disagg_out)):
+            if not np.array_equal(a, b):  # tiers move time, not tokens
+                print(f"DISAGG ORACLE MISMATCH{suffix} on request {i}",
+                      file=sys.stderr)
+                return None
+        if row["handoffs_warm"] < 1:
+            print(f"DISAGG LEG{suffix}: no warm handoff crossed the wire",
+                  file=sys.stderr)
+            return None
+        if row["migrated_kv_bytes"] != modeled:
+            print(f"DISAGG KVSNAP BYTES{suffix}: measured "
+                  f"{row['migrated_kv_bytes']} != modeled {modeled}",
+                  file=sys.stderr)
+            return None
+        if not row["compile_free"]:
+            print(f"DISAGG LEG{suffix}: a tier compiled post-warmup",
+                  file=sys.stderr)
+            return None
+        return [mixed, row]
+
+    rows = legs_for(1, "")
+    if rows is None:
+        return None
+    if args.shards and args.shards > 1:
+        more = legs_for(args.shards, f"_shard{args.shards}")
+        if more is None:
+            return None
+        rows += more
+    return rows
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--smoke", action="store_true",
@@ -768,8 +923,34 @@ def main():
     ap.add_argument("--fleet", action="store_true",
                     help="run ONLY the fleet router legs (rr vs "
                          "prefix-affinity A/B + SLO scale leg)")
+    ap.add_argument("--disagg", action="store_true",
+                    help="run ONLY the disaggregated prefill/decode "
+                         "A/B (mixed vs two-tier fleet; --shards 2 "
+                         "adds a tensor-sharded pair)")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
+
+    if args.disagg:
+        rows = run_disagg_legs(args)
+        if rows is None:
+            return 1
+        for row in rows:
+            print(json.dumps(row))
+        mixed, dis = rows[0], rows[1]
+        print(
+            f"disagg 1+{dis['replicas'] - 1}: "
+            f"{dis['handoffs_warm']}/{dis['handoffs']} handoffs warm, "
+            f"p50 {dis['handoff_ms_p50']}ms, "
+            f"{dis['migrated_kv_bytes']} KV B migrated "
+            f"(modeled == measured); decode-gap p99 "
+            f"{dis['p99_decode_gap_s']}s vs mixed "
+            f"{mixed['p99_decode_gap_s']}s at "
+            f"{dis['throughput_tokens_per_s']} vs "
+            f"{mixed['throughput_tokens_per_s']} tok/s; oracle "
+            f"token-identical, prefill/decode compile-free="
+            f"{dis['compile_free_prefill']}/{dis['compile_free_decode']}",
+            file=sys.stderr)
+        return 0
 
     if args.fleet:
         rows = run_fleet_legs(args)
